@@ -1,0 +1,107 @@
+"""paddle_tpu: a TPU-native deep-learning framework.
+
+Brand-new design with the capabilities of the PaddlePaddle reference
+(see SURVEY.md): an imperative (dygraph) Tensor/nn/optimizer API whose every
+op is a pure XLA computation, a trace-and-compile path (``jit.to_static``)
+that fuses whole training steps into single XLA programs, and a first-class
+distributed stack built on ``jax.sharding`` meshes + XLA collectives instead
+of NCCL.
+
+Top-level namespace mirrors the reference's ``import paddle`` surface.
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# int64/float64 support (paddle's default index dtype is int64; reference
+# DenseTensor supports fp64 on CPU). TPU code paths use explicit fp32/bf16.
+_jax.config.update("jax_enable_x64", True)
+
+# float32 matmuls stay true float32 (reference cublas fp32 semantics; OpTest
+# 1e-5 tolerance class). TPU MXU speed comes from bf16 DTYPES via amp — not
+# from silently degrading fp32 math.
+_jax.config.update("jax_default_matmul_precision", "highest")
+
+from . import core  # noqa: E402
+from .core import dtype as _dtype  # noqa: E402
+from .core.dtype import (  # noqa: E402,F401
+    bfloat16,
+    bool_ as bool8,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+)
+from .core.place import (  # noqa: E402,F401
+    CPUPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .core.flags import get_flags, set_flags  # noqa: E402,F401
+from .tensor import Parameter, Tensor, to_tensor  # noqa: E402,F401
+from . import ops  # noqa: E402
+from .ops import *  # noqa: E402,F401,F403
+from .ops import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: E402,F401
+from .ops.random import get_rng_state, seed, set_rng_state  # noqa: E402,F401
+from . import autograd  # noqa: E402,F401
+from .autograd import grad  # noqa: E402,F401
+
+CUDAPlace = TPUPlace  # reference-API compat: the accelerator is the TPU
+XPUPlace = TPUPlace
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def in_dynamic_mode():
+    from .jit.api import in_tracing
+
+    return not in_tracing()
+
+
+def disable_static(place=None):
+    pass
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no separate static graph mode; use paddle_tpu.jit.to_static"
+    )
+
+
+# subsystem namespaces
+from . import nn  # noqa: E402,F401
+from . import optimizer  # noqa: E402,F401
+from . import io  # noqa: E402,F401
+from . import amp  # noqa: E402,F401
+from . import jit  # noqa: E402,F401
+from . import metric  # noqa: E402,F401
+from . import vision  # noqa: E402,F401
+from . import framework  # noqa: E402,F401
+from .framework.io import load, save  # noqa: E402,F401
+from . import distributed  # noqa: E402,F401
+from . import profiler  # noqa: E402,F401
+from . import incubate  # noqa: E402,F401
+from . import static  # noqa: E402,F401
+from . import device  # noqa: E402,F401
+from . import version  # noqa: E402,F401
+from .version import __version__  # noqa: E402,F401

@@ -1,0 +1,12 @@
+"""AMP: automatic mixed precision.
+
+Reference: python/paddle/amp/auto_cast.py:271 (amp_guard) / :646 (auto_cast),
+grad_scaler.py:41, white/black op lists in amp_lists.py. TPU-native: the
+mixed dtype is bfloat16 (no loss scaling needed — bf16 has fp32's exponent
+range), so GradScaler degrades to an API-compatible passthrough unless
+float16 is explicitly requested. O1 casts op inputs by white/black list at
+dispatch; O2 ("pure") casts parameters once.
+"""
+from .auto_cast import amp_guard, auto_cast, decorate, white_list, black_list  # noqa: F401
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+from . import debugging  # noqa: F401

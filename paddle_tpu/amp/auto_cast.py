@@ -1,0 +1,94 @@
+"""auto_cast context (reference: python/paddle/amp/auto_cast.py)."""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import to_jax_dtype
+from ..ops import dispatch as _dispatch
+from ..tensor import Tensor
+
+# reference amp_lists.py: ops that are numerically safe in low precision (the
+# MXU-heavy ones) vs ops kept in fp32
+white_list = {"matmul", "linear", "conv2d", "conv1d", "conv3d", "einsum", "mm", "bmm", "sdpa", "flash_attention"}
+black_list = {
+    "exp", "square", "log", "mean", "sum", "cos_sim", "softmax", "log_softmax",
+    "softmax_with_cross_entropy", "cross_entropy", "layer_norm", "batch_norm",
+    "p_norm", "logsumexp", "cumsum",
+}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_amp_state = _AmpState()
+
+
+def amp_state():
+    return _amp_state
+
+
+def _maybe_cast_inputs(op_name, inputs):
+    """Called from dispatch when AMP O1 is active: cast inputs of white-list
+    ops to the amp dtype, black-list ops to fp32."""
+    st = _amp_state
+    wl = (white_list | st.custom_white) - st.custom_black
+    bl = (black_list | st.custom_black) - st.custom_white
+    if op_name in wl:
+        tgt = st.dtype
+    elif op_name in bl:
+        tgt = jnp.float32
+    else:
+        return inputs
+    out = []
+    for t in inputs:
+        if np.issubdtype(np.dtype(t._value.dtype), np.floating) and t._value.dtype != tgt:
+            out.append(t.astype(tgt))
+        else:
+            out.append(t)
+    return tuple(out)
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    st = _amp_state
+    prev = (st.enabled, st.dtype, st.level, st.custom_white, st.custom_black)
+    st.enabled = enable
+    st.dtype = to_jax_dtype(dtype)
+    st.level = level
+    st.custom_white = set(custom_white_list or [])
+    st.custom_black = set(custom_black_list or [])
+    try:
+        yield
+    finally:
+        st.enabled, st.dtype, st.level, st.custom_white, st.custom_black = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration: cast model params to the amp dtype (reference
+    auto_cast.py amp_decorate). Optimizers keep fp32 master weights
+    (multi_precision in our Adam)."""
+    from ..nn.layer import Layer
+
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers

@@ -1,0 +1,61 @@
+"""Numerical debugging (reference: python/paddle/amp/debugging.py:225
+TensorCheckerConfig / check_numerics, nan/inf hooks eager/nan_inf_utils.cc).
+TPU-native: FLAGS_check_nan_inf gates a per-op finite check in dispatch."""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import flags as _flags
+from ..tensor import Tensor
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    _flags.set_flags({
+        "FLAGS_check_nan_inf": config.enable,
+        "FLAGS_check_nan_inf_level": 0 if config.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT else 1,
+    })
+
+
+def disable_tensor_checker():
+    _flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    v = tensor._value
+    n_nan = int(jnp.isnan(v).sum())
+    n_inf = int(jnp.isinf(v).sum())
+    if (n_nan or n_inf) and debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        raise FloatingPointError(
+            f"check_numerics: {op_type}/{var_name} has {n_nan} nan, {n_inf} inf"
+        )
+    return Tensor(jnp.asarray([n_nan, n_inf], jnp.int64))
+
+
+@contextmanager
+def collect_operator_stats():
+    yield
+
+
+def enable_operator_stats_collection():
+    pass
+
+
+def disable_operator_stats_collection():
+    pass

@@ -1,0 +1,20 @@
+"""Autograd public API (reference: python/paddle/autograd/__init__.py)."""
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..ops.dispatch import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .engine import GradNode, grad, run_backward  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward (reference:
+    python/paddle/autograd/backward_mode.py)."""
+    from ..tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)

@@ -1,0 +1,264 @@
+"""Reverse-mode autograd engine.
+
+TPU-native equivalent of the reference's eager backward engine
+(reference: paddle/fluid/eager/backward.cc:104 ``RunBackward`` — BFS in-degree
+reverse-topological queue over GradNodeBase, GradTensorHolder accumulation).
+
+Design differences, deliberately TPU-first:
+- A GradNode's backward function is the op's XLA VJP captured at forward time
+  (``jax.vjp``), not hand-written grad kernels. Residuals live in device
+  memory exactly like the reference's TensorWrapper saves.
+- Execution order is a simple reverse topological sort (DFS) — the whole walk
+  is Python, but every VJP call is an async XLA dispatch, so the device
+  pipeline stays full; under ``jit.to_static`` the walk is traced away
+  entirely into one fused program.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["GradNode", "run_backward", "grad"]
+
+_float0 = jax.dtypes.float0
+
+
+class GradNode:
+    """One recorded op in the grad graph (reference grad_node_info.h:50)."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "name", "_out_tensors", "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, out_avals, name="op"):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # tuple[Tensor]
+        self.out_avals = out_avals  # tuple[(shape, dtype)]
+        self.name = name
+        self._out_tensors = []  # list[weakref[Tensor]] for hook delivery
+
+    def __repr__(self):
+        return f"<GradNode {self.name}>"
+
+
+def _topo_order(root_nodes) -> List[GradNode]:
+    """Reverse-topological order (consumers before producers)."""
+    order: List[GradNode] = []
+    state: Dict[int, int] = {}  # id(node) -> 0 visiting / 1 done
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        nid = id(node)
+        if processed:
+            state[nid] = 1
+            order.append(node)
+            continue
+        if state.get(nid) is not None:
+            continue
+        state[nid] = 0
+        stack.append((node, True))
+        for t in node.inputs:
+            prod = t._grad_node
+            if prod is not None and state.get(id(prod)) is None:
+                stack.append((prod, False))
+    order.reverse()  # consumers first
+    return order
+
+
+def _accumulate(slot, value):
+    return value if slot is None else slot + value
+
+
+def run_backward(
+    tensors: Sequence,
+    grad_tensors: Optional[Sequence] = None,
+    retain_graph: bool = False,
+    *,
+    capture: Optional[Dict[int, object]] = None,
+    accumulate_leaf: bool = True,
+):
+    """Drive backward from ``tensors`` (reference backward.cc:421 ``Backward``).
+
+    capture: optional dict id(Tensor)->None; filled with raw grads for those
+    tensors (used by :func:`grad`).
+    """
+    from ..tensor import Tensor
+
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    # cotangent buffers: keyed by id(GradNode) -> list per output slot
+    buffers: Dict[int, List] = {}
+    # leaf/captured accumulation keyed by id(Tensor)
+    leaf_grads: Dict[int, object] = {}
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError(
+                "backward() called on a tensor with stop_gradient=True"
+            )
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {tuple(t._value.shape)}"
+                )
+            g_raw = jnp.ones(t._value.shape, t._value.dtype)
+        else:
+            g_raw = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._grad_node
+        if node is None:
+            # directly a leaf
+            leaf_grads[id(t)] = _accumulate(leaf_grads.get(id(t)), g_raw)
+            continue
+        buf = buffers.setdefault(id(node), [None] * len(node.out_avals))
+        buf[t._output_index] = _accumulate(buf[t._output_index], g_raw)
+        roots.append(node)
+
+    order = _topo_order(roots)
+
+    for node in order:
+        buf = buffers.pop(id(node), None)
+        if buf is None:
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"grad graph for op '{node.name}' has been freed; "
+                "call backward(retain_graph=True) to backprop twice"
+            )
+        cotangents = []
+        for slot, (shape, dtype) in zip(buf, node.out_avals):
+            if slot is None:
+                if np.issubdtype(np.dtype(dtype), np.inexact):
+                    slot = jnp.zeros(shape, dtype)
+                else:
+                    slot = np.zeros(shape, _float0)
+            cotangents.append(slot)
+        # fire tensor hooks on the accumulated output grads
+        for ref in node._out_tensors:
+            t = ref()
+            if t is None or not t._hooks:
+                continue
+            g = cotangents[t._output_index]
+            if g.dtype == _float0:
+                continue
+            for hook in t._hooks.values():
+                new_g = hook(Tensor(g, stop_gradient=True))
+                if new_g is not None:
+                    g = new_g._value if isinstance(new_g, Tensor) else new_g
+            cotangents[t._output_index] = g
+
+        in_grads = node.vjp_fn(tuple(cotangents))
+        if not retain_graph:
+            node.vjp_fn = None
+
+        for t, g in zip(node.inputs, in_grads):
+            if g is None or (hasattr(g, "dtype") and g.dtype == _float0):
+                continue
+            if t.stop_gradient and (capture is None or id(t) not in capture):
+                continue
+            prod = t._grad_node
+            if prod is not None:
+                b = buffers.setdefault(id(prod), [None] * len(prod.out_avals))
+                b[t._output_index] = _accumulate(b[t._output_index], g)
+                if capture is not None and id(t) in capture:
+                    leaf_grads[id(t)] = _accumulate(leaf_grads.get(id(t)), g)
+            else:
+                leaf_grads[id(t)] = _accumulate(leaf_grads.get(id(t)), g)
+                if t._hooks:
+                    gval = leaf_grads[id(t)]
+                    for hook in t._hooks.values():
+                        new_g = hook(Tensor(gval, stop_gradient=True))
+                        if new_g is not None:
+                            gval = new_g._value if isinstance(new_g, Tensor) else new_g
+                    leaf_grads[id(t)] = gval
+
+    if capture is not None:
+        for tid in list(capture.keys()):
+            capture[tid] = leaf_grads.get(tid)
+
+    if accumulate_leaf:
+        _write_leaf_grads(tensors, leaf_grads, capture)
+    return leaf_grads
+
+
+def _write_leaf_grads(root_tensors, leaf_grads, capture):
+    from ..tensor import Tensor
+
+    # walk all tensors we saw; leaf tensors referenced by nodes
+    seen = set()
+    seen_leaves = set()
+    stack = [t._grad_node for t in root_tensors if t._grad_node is not None]
+    leaves = []
+    for t in root_tensors:
+        if t._grad_node is None and not t.stop_gradient and id(t) not in seen_leaves:
+            seen_leaves.add(id(t))
+            leaves.append(t)
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        for t in node.inputs:
+            if t._grad_node is not None:
+                stack.append(t._grad_node)
+            elif not t.stop_gradient and id(t) not in seen_leaves:
+                seen_leaves.add(id(t))
+                leaves.append(t)
+    for t in leaves:
+        if capture is not None and id(t) in capture:
+            continue  # paddle.grad does not pollute .grad
+        g = leaf_grads.get(id(t))
+        if g is None:
+            continue
+        if t.grad is None:
+            t.grad = Tensor(g, stop_gradient=True)
+        else:
+            t.grad = Tensor(t.grad._value + g, stop_gradient=True)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=False,
+    create_graph=False,
+    allow_unused=False,
+):
+    """Functional gradient API (reference: paddle/fluid/eager/general_grad.h,
+    python ``paddle.grad``). create_graph is not yet supported (the VJP chain
+    is first-order); use jax-level transforms via jit.to_static for higher
+    order."""
+    from ..tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle_tpu.jit.functional_grad for higher-order"
+        )
+    capture = {id(t): None for t in inputs}
+    run_backward(
+        outputs,
+        grad_outputs,
+        retain_graph=retain_graph,
+        capture=capture,
+        accumulate_leaf=False,
+    )
+    results = []
+    for t in inputs:
+        g = capture[id(t)]
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors received no gradient; "
+                    "pass allow_unused=True to get None instead"
+                )
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
+    return results

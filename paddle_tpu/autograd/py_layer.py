@@ -1,0 +1,99 @@
+"""PyLayer: user-defined forward/backward pairs.
+
+Reference: python/paddle/autograd/py_layer.py:29,234 and C++
+paddle/fluid/eager/pylayer/. TPU-native: the user's backward is spliced into
+the grad graph as a custom GradNode whose "vjp" calls the python staticmethod;
+under jit tracing the python backward traces into the same XLA program.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import numpy as np
+
+from ..tensor import Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.extra = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return list(self._saved)
+
+    # attribute bag like the reference ctx
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..autograd.engine import GradNode
+        from ..ops import dispatch
+
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = dispatch.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs
+        )
+
+        with dispatch.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        single = not isinstance(outputs, (tuple, list))
+        outs = [outputs] if single else list(outputs)
+
+        if not needs_grad:
+            return outputs
+
+        def vjp_fn(cotangents):
+            cts = [Tensor(c, stop_gradient=True) for c in cotangents]
+            with dispatch.no_grad():
+                grads = cls.backward(ctx, *cts)
+            if not isinstance(grads, (tuple, list)):
+                grads = [grads]
+            raw = []
+            gi = iter(grads)
+            for a in args:
+                if isinstance(a, Tensor):
+                    g = next(gi, None)
+                    raw.append(g._value if isinstance(g, Tensor) else g)
+            return tuple(raw)
+
+        node = GradNode(
+            vjp_fn=vjp_fn,
+            inputs=tuple(tensor_inputs),
+            out_avals=tuple((o._value.shape, o._value.dtype) for o in outs),
+            name=cls.__name__,
+        )
+        import weakref
+
+        wrapped = []
+        for i, o in enumerate(outs):
+            t = Tensor(o._value, stop_gradient=False)
+            if np.issubdtype(np.dtype(o._value.dtype), np.inexact):
+                t._grad_node = node
+                t._output_index = i
+            else:
+                t.stop_gradient = True
+            node._out_tensors.append(weakref.ref(t))
+            wrapped.append(t)
+        return wrapped[0] if single else tuple(wrapped)

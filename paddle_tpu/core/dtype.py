@@ -1,0 +1,123 @@
+"""Dtype system.
+
+TPU-native equivalent of the reference's ``phi::DataType`` enum
+(reference: paddle/phi/common/data_type.h). We reuse numpy/jax dtypes as the
+canonical representation and expose paddle-style names (``paddle.float32`` …)
+as module-level singletons.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = [
+    "DType",
+    "float16",
+    "bfloat16",
+    "float32",
+    "float64",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "uint8",
+    "bool_",
+    "complex64",
+    "complex128",
+    "convert_dtype",
+    "to_jax_dtype",
+    "is_floating_point_dtype",
+    "is_integer_dtype",
+]
+
+
+class DType:
+    """A named dtype singleton comparable against strings and numpy dtypes."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or _ALIASES.get(other) == self.name
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.name)
+
+
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+uint8 = DType("uint8", np.uint8)
+bool_ = DType("bool", np.bool_)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALL = [
+    float16,
+    bfloat16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+    bool_,
+    complex64,
+    complex128,
+]
+_BY_NAME = {d.name: d for d in _ALL}
+_ALIASES = {"float": "float32", "double": "float64", "half": "float16", "int": "int32", "bool_": "bool"}
+
+
+def convert_dtype(dtype) -> DType:
+    """Normalize any dtype spec (str, numpy, jax, DType) to a :class:`DType`."""
+    if dtype is None:
+        return float32
+    if isinstance(dtype, DType):
+        return dtype
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name in _BY_NAME:
+            return _BY_NAME[name]
+        raise ValueError(f"unknown dtype string: {dtype!r}")
+    npd = np.dtype(dtype)
+    if npd == np.dtype(jnp.bfloat16):
+        return bfloat16
+    name = npd.name
+    if name in _BY_NAME:
+        return _BY_NAME[name]
+    raise ValueError(f"unsupported dtype: {dtype!r}")
+
+
+def to_jax_dtype(dtype):
+    """DType/str/np → a dtype jax understands."""
+    return convert_dtype(dtype).np_dtype
+
+
+def is_floating_point_dtype(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d.name in ("float16", "bfloat16", "float32", "float64")
+
+
+def is_integer_dtype(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d.name in ("int8", "int16", "int32", "int64", "uint8")

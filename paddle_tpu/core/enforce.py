@@ -1,0 +1,71 @@
+"""Error enforcement.
+
+TPU-native equivalent of PADDLE_ENFORCE / phi error codes
+(reference: paddle/phi/core/enforce.h, paddle/phi/core/errors.h). Python-level
+framework errors carry a categorized type and a readable message; we keep the
+category taxonomy so user-facing behavior matches the reference.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "EnforceNotMet",
+    "InvalidArgumentError",
+    "NotFoundError",
+    "OutOfRangeError",
+    "AlreadyExistsError",
+    "PreconditionNotMetError",
+    "UnimplementedError",
+    "UnavailableError",
+    "enforce",
+    "enforce_eq",
+    "enforce_shape_match",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base framework error (reference enforce.h:EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+def enforce(cond, msg: str = "", err=InvalidArgumentError):
+    if not cond:
+        raise err(msg or "enforce failed")
+
+
+def enforce_eq(a, b, msg: str = ""):
+    if a != b:
+        raise InvalidArgumentError(f"{msg or 'values must be equal'}: got {a!r} vs {b!r}")
+
+
+def enforce_shape_match(shape_a, shape_b, msg: str = ""):
+    if tuple(shape_a) != tuple(shape_b):
+        raise InvalidArgumentError(
+            f"{msg or 'shape mismatch'}: {tuple(shape_a)} vs {tuple(shape_b)}"
+        )

@@ -1,0 +1,66 @@
+"""Global flag registry.
+
+TPU-native equivalent of the reference's gflags tier
+(reference: paddle/phi/core/flags.cc, python setter at
+python/paddle/fluid/framework.py:7470 ``set_flags/get_flags``).
+Flags initialise from ``FLAGS_*`` environment variables, then are mutable via
+:func:`set_flags`.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable
+
+__all__ = ["define_flag", "set_flags", "get_flags"]
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def _coerce(value, like):
+    if isinstance(like, bool):
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    if isinstance(like, int):
+        return int(value)
+    if isinstance(like, float):
+        return float(value)
+    return value
+
+
+def define_flag(name: str, default, help: str = ""):  # noqa: A002
+    env = os.environ.get(name)
+    _REGISTRY[name] = _coerce(env, default) if env is not None else default
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        if k not in _REGISTRY:
+            _REGISTRY[k] = v
+        else:
+            _REGISTRY[k] = _coerce(v, _REGISTRY[k])
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        if k not in _REGISTRY:
+            raise KeyError(f"flag {k!r} is not defined")
+        out[k] = _REGISTRY[k]
+    return out
+
+
+def flag(name: str):
+    """Internal fast accessor."""
+    return _REGISTRY[name]
+
+
+# Core flags (subset of the ~90 in the reference that are meaningful on TPU).
+define_flag("FLAGS_check_nan_inf", False, "check every op output for nan/inf")
+define_flag("FLAGS_check_nan_inf_level", 0, "0: error on nan/inf; >0 log only")
+define_flag("FLAGS_eager_op_cache", True, "cache per-op jitted executables in eager mode")
+define_flag("FLAGS_use_bf16_matmul", False, "force bf16 matmul accumulation")
+define_flag("FLAGS_log_level", 0, "framework VLOG level")
+define_flag("FLAGS_benchmark", False, "block on every op for timing")

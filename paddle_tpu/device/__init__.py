@@ -1,0 +1,33 @@
+"""device namespace (reference: python/paddle/device/)."""
+from ..core.place import (  # noqa: F401
+    CPUPlace,
+    Place,
+    TPUPlace,
+    current_place,
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+
+
+def get_all_device_type():
+    types = ["cpu"]
+    if is_compiled_with_tpu():
+        types.append("tpu")
+    return types
+
+
+def get_available_device():
+    return [f"{t}:{i}" for t in get_all_device_type() for i in range(device_count(t) or 1)]
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (analog of
+    cudaDeviceSynchronize; jax exposes this as barrier on async dispatch)."""
+    import jax
+
+    try:
+        jax.block_until_ready(jax.numpy.zeros(()))
+    except Exception:
+        pass

@@ -1,0 +1,29 @@
+"""distributed namespace (reference: python/paddle/distributed/__init__.py)."""
+from .env import ParallelEnv, get_rank, get_world_size, init_parallel_env, is_initialized  # noqa: F401
+from .mesh import HYBRID_AXES, build_mesh, get_mesh, has_mesh, named_sharding, set_mesh  # noqa: F401
+from .group import Group, get_group, new_group  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_gather_into_tensor,
+    all_reduce,
+    alltoall,
+    alltoall_single,
+    barrier,
+    broadcast,
+    get_backend,
+    irecv,
+    isend,
+    p2p_push,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from .parallel import DataParallel  # noqa: F401
+from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel.api import shard_tensor, shard_op, dtensor_from_fn  # noqa: F401
+from .auto_parallel.process_mesh import ProcessMesh  # noqa: F401

@@ -1,0 +1,264 @@
+"""Functional collectives.
+
+Reference: python/paddle/distributed/communication/ (all_reduce/all_gather/…
+dispatching to ProcessGroup*, e.g. communication/stream/all_reduce.py:28).
+TPU-native: inside a mapped region (shard_map over the global mesh) these
+lower to XLA collectives (psum/all_gather/ppermute/all_to_all) on the
+group's axis names — the compiler schedules them on ICI. From the
+controller (outside any mapped region) values are replicated/global, so
+collectives are identities, matching the single-controller SPMD model.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops import dispatch
+from .group import Group, get_group
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+def _axis(group: Optional[Group]):
+    g = group if group is not None else get_group(0)
+    return g.axis_name
+
+
+def _in_mapped_context(axis_name) -> bool:
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+    except TypeError:
+        return False
+
+
+def _reduce_fn(op):
+    if op == ReduceOp.SUM or op == ReduceOp.AVG:
+        return jax.lax.psum
+    if op == ReduceOp.MAX:
+        return jax.lax.pmax
+    if op == ReduceOp.MIN:
+        return jax.lax.pmin
+    raise NotImplementedError(f"reduce op {op}")
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
+    ax = _axis(group)
+    if not _in_mapped_context(ax):
+        return tensor  # replicated value on the controller
+    fn = _reduce_fn(op)
+
+    def raw(x):
+        out = fn(x, ax)
+        if op == ReduceOp.AVG:
+            out = out / jax.lax.psum(jnp.ones((), x.dtype), ax)
+        return out
+
+    out = dispatch.apply(raw, tensor, op_name="all_reduce")
+    tensor._set_value(out._value)
+    tensor._grad_node = out._grad_node
+    tensor._output_index = out._output_index
+    return tensor
+
+
+def all_gather(tensor_list: Optional[List[Tensor]], tensor: Tensor, group=None, sync_op=True, axis=0):
+    ax = _axis(group)
+    if not _in_mapped_context(ax):
+        if tensor_list is not None:
+            n = (group or get_group(0)).nranks
+            tensor_list.extend(Tensor(tensor._value) for _ in range(n))
+            return tensor_list
+        return tensor
+    out = dispatch.apply(
+        lambda x: jax.lax.all_gather(x, ax, axis=0), tensor, op_name="all_gather"
+    )
+    if tensor_list is not None:
+        from .. import ops as _ops
+
+        parts = _ops.unstack(out, axis=0)
+        tensor_list.extend(parts)
+        return tensor_list
+    return out
+
+
+def all_gather_into_tensor(out_tensor, tensor, group=None, sync_op=True):
+    ax = _axis(group)
+    if not _in_mapped_context(ax):
+        out_tensor._set_value(tensor._value)
+        return out_tensor
+    out = dispatch.apply(
+        lambda x: jax.lax.all_gather(x, ax, axis=0, tiled=True), tensor, op_name="all_gather"
+    )
+    out_tensor._set_value(out._value)
+    out_tensor._grad_node = out._grad_node
+    return out_tensor
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _axis(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        from .. import ops as _ops
+
+        src = _ops.concat(list(src), axis=0)
+    if not _in_mapped_context(ax):
+        tensor._set_value(src._value)
+        return tensor
+    out = dispatch.apply(
+        lambda x: jax.lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True),
+        src,
+        op_name="reduce_scatter",
+    )
+    tensor._set_value(out._value)
+    tensor._grad_node = out._grad_node
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    ax = _axis(group)
+    from .. import ops as _ops
+
+    if isinstance(in_tensor_list, Tensor):
+        x = in_tensor_list
+        split_mode = False
+    else:
+        x = _ops.stack(list(in_tensor_list), axis=0)
+        split_mode = True
+    if not _in_mapped_context(ax):
+        if split_mode and out_tensor_list is not None:
+            out_tensor_list.extend(list(in_tensor_list))
+            return out_tensor_list
+        return x
+    out = dispatch.apply(
+        lambda a: jax.lax.all_to_all(a, ax, split_axis=0, concat_axis=0, tiled=False),
+        x,
+        op_name="alltoall",
+    )
+    if split_mode and out_tensor_list is not None:
+        out_tensor_list.extend(_ops.unstack(out, axis=0))
+        return out_tensor_list
+    return out
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None, out_split_sizes=None, group=None, sync_op=True):
+    ax = _axis(group)
+    if not _in_mapped_context(ax):
+        if out_tensor is not None:
+            out_tensor._set_value(in_tensor._value)
+            return out_tensor
+        return in_tensor
+    out = dispatch.apply(
+        lambda a: jax.lax.all_to_all(a, ax, split_axis=0, concat_axis=0, tiled=True),
+        in_tensor,
+        op_name="alltoall_single",
+    )
+    if out_tensor is not None:
+        out_tensor._set_value(out._value)
+        out_tensor._grad_node = out._grad_node
+        return out_tensor
+    return out
+
+
+def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
+    ax = _axis(group)
+    if not _in_mapped_context(ax):
+        return tensor
+    # replicate src's shard to all members of the axis
+    out = dispatch.apply(
+        lambda x: jax.lax.all_gather(x, ax, axis=0)[src], tensor, op_name="broadcast"
+    )
+    tensor._set_value(out._value)
+    tensor._grad_node = out._grad_node
+    return tensor
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # SPMD: reduce == all_reduce (every member gets the result; dst is moot)
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if not _in_mapped_context(ax):
+        if tensor_list:
+            tensor._set_value(tensor_list[0]._value)
+        return tensor
+    from .. import ops as _ops
+
+    stacked = _ops.stack(list(tensor_list), axis=0)
+    idx = jax.lax.axis_index(ax)
+    out = dispatch.apply(
+        lambda a: jax.lax.dynamic_index_in_dim(a, idx, axis=0, keepdims=False),
+        stacked,
+        op_name="scatter",
+    )
+    tensor._set_value(out._value)
+    return tensor
+
+
+def isend(tensor, dst, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=None, group=None):
+    return recv(tensor, src, group)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Point-to-point on a mesh axis = collective_permute. In SPMD we express
+    send/recv together via ppermute in the pipeline engine; the standalone
+    send stages the value for the matching recv (same-program pairing)."""
+    ax = _axis(group)
+    if not _in_mapped_context(ax):
+        _P2P_STAGE.append(tensor)
+        return None
+    raise RuntimeError(
+        "inside shard_map use paddle_tpu.distributed.p2p_push (ppermute); "
+        "pairwise send/recv is a two-sided NCCL concept that does not exist in SPMD"
+    )
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    ax = _axis(group)
+    if not _in_mapped_context(ax):
+        if _P2P_STAGE:
+            tensor._set_value(_P2P_STAGE.pop(0)._value)
+        return None
+    raise RuntimeError("inside shard_map use paddle_tpu.distributed.p2p_push")
+
+
+_P2P_STAGE: list = []
+
+
+def p2p_push(tensor: Tensor, perm, group=None):
+    """collective_permute: ship each rank's shard to perm[rank]
+    (the SPMD-native form of the reference's partial_send/recv PP ops)."""
+    ax = _axis(group)
+    if not _in_mapped_context(ax):
+        return tensor
+    return dispatch.apply(
+        lambda x: jax.lax.ppermute(x, ax, perm), tensor, op_name="p2p_push"
+    )
+
+
+def barrier(group=None):
+    ax = _axis(group)
+    if not _in_mapped_context(ax):
+        jax.block_until_ready(jnp.zeros(()))
+        return
+    jax.lax.psum(jnp.ones(()), ax)
+
+
+def get_backend(group=None):
+    return "xla"

@@ -1,0 +1,97 @@
+"""Distributed environment.
+
+Reference: python/paddle/distributed/parallel.py (init_parallel_env:913,
+ParallelEnv) driven by PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS env vars
+set by the launcher.
+
+TPU-native model: a single controller process drives all local chips via
+SPMD (jit + shardings over a Mesh); multi-host jobs run one controller per
+host coordinated by jax.distributed. "rank"/"world_size" therefore mean the
+*process* rank (host) for host-level logic (data loading, logging) while
+device-level parallelism is expressed through mesh axes — the analog of the
+reference's process-per-GPU model collapsing into process-per-host.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def _env_int(name, default=0):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class ParallelEnv:
+    def __init__(self):
+        self.rank = _env_int("PADDLE_TRAINER_ID", _env_int("RANK", 0))
+        self.world_size = _env_int("PADDLE_TRAINERS_NUM", _env_int("WORLD_SIZE", 1))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self.trainer_endpoints = eps.split(",") if eps else []
+        self.current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        self.device_id = _env_int("FLAGS_selected_tpus", 0)
+        self.nrings = 1
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def dev_id(self):
+        return self.device_id
+
+
+_parallel_env: Optional[ParallelEnv] = None
+_initialized = False
+
+
+def _env() -> ParallelEnv:
+    global _parallel_env
+    if _parallel_env is None:
+        _parallel_env = ParallelEnv()
+    return _parallel_env
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    return _env().rank
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return _env().world_size
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_parallel_env():
+    """Bring up the multi-host runtime (reference parallel.py:913). On a
+    single host this is a no-op beyond recording the env; on pods it calls
+    jax.distributed.initialize using the launcher-provided coordinator."""
+    global _initialized
+    env = _env()
+    if _initialized:
+        return env
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ENDPOINT")
+    if env.world_size > 1 and coord and not os.environ.get("PADDLE_TPU_NO_JAX_DIST"):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=env.world_size,
+                process_id=env.rank,
+            )
+        except Exception as e:  # already initialized or local testing
+            if "already" not in str(e).lower():
+                import warnings
+
+                warnings.warn(f"jax.distributed.initialize failed: {e}")
+    _initialized = True
+    return env

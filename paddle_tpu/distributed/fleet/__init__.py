@@ -1,0 +1,109 @@
+"""fleet: user-facing distributed API (reference: fleet/fleet.py —
+fleet.init:167, distributed_model fleet/model.py:30,
+distributed_optimizer)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..env import get_rank, get_world_size, init_parallel_env
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from . import meta_parallel  # noqa: F401
+from .meta_parallel import PipelineLayer, PipelineParallel, TensorParallel  # noqa: F401
+from .recompute import recompute  # noqa: F401
+
+_fleet_initialized = False
+_user_strategy: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    """fleet.init (reference fleet/fleet.py:167): build the hybrid topology
+    mesh from strategy.hybrid_configs."""
+    global _fleet_initialized, _user_strategy
+    init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    _user_strategy = strategy
+    hc = strategy.hybrid_configs
+    order = hc.get("order", ["dp", "pp", "sharding", "sep", "mp"])
+    name_map = {"dp": "data", "pp": "pipe", "sharding": "sharding", "sep": "sep", "mp": "model"}
+    names = [name_map[o] for o in order]
+    dims = [int(hc.get(f"{o}_degree", 1)) for o in order]
+    topo = CommunicateTopology(names, dims)
+    hcg = HybridCommunicateGroup(topo)
+    set_hybrid_communicate_group(hcg)
+    _fleet_initialized = True
+    return None
+
+
+def is_first_worker():
+    return get_rank() == 0
+
+
+def worker_index():
+    return get_rank()
+
+
+def worker_num():
+    return get_world_size()
+
+
+def get_hybrid_communicate_group_():
+    return get_hybrid_communicate_group()
+
+
+def distributed_model(model):
+    """fleet/model.py:30: wrap by strategy — PP > TP > sharding > DP."""
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return model
+    mode = hcg.get_parallel_mode()
+    if mode == "pipeline":
+        strat = _user_strategy or DistributedStrategy()
+        return PipelineParallel(model, hcg, strat)
+    if mode == "model":
+        return TensorParallel(model, hcg)
+    from ..parallel import DataParallel
+
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    from .meta_parallel.hybrid_optimizer import HybridParallelOptimizer
+
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return optimizer
+    return HybridParallelOptimizer(optimizer, hcg, strategy or _user_strategy)
+
+
+# PS-era APIs kept for surface parity (reference fleet.py server methods)
+def init_server(*args, **kwargs):
+    raise NotImplementedError("parameter-server mode is out of the TPU scope")
+
+
+def run_server():
+    raise NotImplementedError("parameter-server mode is out of the TPU scope")
+
+
+def stop_worker():
+    pass
+
+
+def barrier_worker():
+    from ..collective import barrier
+
+    barrier()
+
+
+def save_model(path, mode=0):
+    raise NotImplementedError("use paddle_tpu.save(model.state_dict(), path)")
+
+
+utils = None

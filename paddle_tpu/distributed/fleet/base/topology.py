@@ -1,0 +1,208 @@
+"""Hybrid-parallel topology.
+
+Reference: fleet/base/topology.py:54 CommunicateTopology / :140
+HybridCommunicateGroup — builds an NCCL group per parallelism axis.
+TPU-native: ONE global Mesh with axes (dp, pp, sharding, sep, mp); each
+"communicate group" is a mesh-axis view (Group). No communicator bootstrap:
+XLA lays collectives on ICI rings from the mesh at compile time.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ... import collective as _collective
+from ...env import get_rank, get_world_size
+from ...group import Group
+from ... import mesh as _mesh
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep", "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        self._world = int(np.prod(self._dims))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coords = [kwargs[n] for n in self._parallel_names]
+        return int(np.ravel_multi_index(coords, self._dims))
+
+    def get_coord(self, rank):
+        return tuple(np.unravel_index(rank, self._dims))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        ranks = [
+            self.get_rank(**dict(zip(self._parallel_names, coord)))
+            for coord in itertools.product(*[range(d) for d in self._dims])
+            if coord[axis] == index
+        ]
+        return sorted(ranks)
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        other_dims = [d for i, d in enumerate(self._dims) if i != axis]
+        lists = []
+        for other_coord in itertools.product(*[range(d) for d in other_dims]):
+            ranks = []
+            for k in range(self._dims[axis]):
+                coord = list(other_coord)
+                coord.insert(axis, k)
+                ranks.append(self.get_rank(**dict(zip(self._parallel_names, coord))))
+            lists.append(ranks)
+        return lists
+
+
+# paddle axis name → mesh axis name
+_AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding", "sep": "sep", "model": "mp"}
+
+
+class HybridCommunicateGroup:
+    """Reference topology.py:140. Builds the global Mesh and exposes
+    per-axis Groups + this process's coordinates."""
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        names = topology.get_hybrid_group_names()
+        dims = [topology.get_dim(n) for n in names]
+
+        self._dp_degree = topology.get_dim("data") if "data" in names else 1
+        self._pp_degree = topology.get_dim("pipe") if "pipe" in names else 1
+        self._sharding_degree = topology.get_dim("sharding") if "sharding" in names else 1
+        self._sep_degree = topology.get_dim("sep") if "sep" in names else 1
+        self._mp_degree = topology.get_dim("model") if "model" in names else 1
+
+        # build the global mesh in the reference's axis order
+        axes = {}
+        for n, d in zip(names, dims):
+            axes[_AXIS_MAP.get(n, n)] = d
+        import jax
+
+        n_needed = int(np.prod(dims))
+        if n_needed <= len(jax.devices()):
+            _mesh.set_mesh(_mesh.build_mesh(axes))
+        # groups as axis views
+        self._dp_group = Group(("dp",), gid=101)
+        self._pp_group = Group(("pp",), gid=102)
+        self._sharding_group = Group(("sharding",), gid=103)
+        self._sep_group = Group(("sep",), gid=104)
+        self._mp_group = Group(("mp",), gid=105)
+        self.global_rank = get_rank()
+
+    # --- degrees / ranks (controller view: rank 0 of each axis) ---------
+    def get_parallel_mode(self):
+        if self._pp_degree > 1:
+            return "pipeline"
+        if self._mp_degree > 1:
+            return "model"
+        if self._sharding_degree > 1:
+            return "sharding"
+        return "data"
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    # model parallel
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # pipeline
+    @property
+    def stage_id(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_pipe_parallel_rank(self):
+        return 0
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_p2p_groups(self):
+        return None
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return 0
+
+    # sep
+    def get_sep_parallel_rank(self):
+        return 0
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_check_parallel_group(self, sharding=False):
+        return Group(("dp", "pp", "sharding", "sep", "mp"), gid=110)
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

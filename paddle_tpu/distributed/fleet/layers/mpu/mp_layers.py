@@ -1,0 +1,122 @@
+"""Megatron-style tensor-parallel layers.
+
+Reference: fleet/layers/mpu/mp_layers.py — VocabParallelEmbedding(:35),
+ColumnParallelLinear(:173), RowParallelLinear(:343), ParallelCrossEntropy
+(:524); identity/allreduce autograd ops in mp_ops.py.
+
+TPU-native: the SAME math, but partitioning is declared via NamedShardings
+on the weights (mp axis) plus sharding constraints on activations; XLA's
+SPMD partitioner inserts the all-reduce/all-gather that mp_ops.py codes by
+hand. gather_output/input_is_parallel keep their reference meaning as
+layout constraints.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .... import mesh as _mesh
+from ....fleet.base.topology import get_hybrid_communicate_group
+from .....nn import functional as F
+from .....nn.layer import Layer
+from .....ops import dispatch
+from .....ops.sharding_ops import shard_constraint, shard_param
+from .....tensor import Tensor
+
+
+def _mp_size():
+    hcg = get_hybrid_communicate_group()
+    if hcg is not None:
+        return hcg.get_model_parallel_world_size()
+    return _mesh.axis_size("mp")
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded over the vocab dim on the 'mp' axis
+    (reference mp_layers.py:35)."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        from ....fleet.base.topology import get_hybrid_communicate_group  # noqa: F811
+
+        self.weight = self.create_parameter([num_embeddings, embedding_dim], attr=weight_attr)
+        if _mp_size() > 1:
+            shard_param(self.weight, "mp")  # rows sharded across mp
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        if _mp_size() > 1:
+            out = shard_constraint(out)  # replicated activation (XLA inserts
+            # the partial-sum all-reduce over mp from the sharded gather)
+        return out
+
+
+class ColumnParallelLinear(Layer):
+    """W sharded by columns over 'mp' (reference mp_layers.py:173)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=None,
+                 gather_output=True, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter([in_features, out_features], attr=weight_attr)
+        self.bias = (
+            self.create_parameter([out_features], is_bias=True)
+            if (has_bias or has_bias is None)
+            else None
+        )
+        if _mp_size() > 1:
+            shard_param(self.weight, None, "mp")
+            if self.bias is not None:
+                shard_param(self.bias, "mp")
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if _mp_size() > 1:
+            if self.gather_output:
+                out = shard_constraint(out)  # all-gather to replicated
+            else:
+                out = shard_constraint(out, *( [None] * (out.ndim - 1) + ["mp"] ))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """W sharded by rows over 'mp'; output partial-sums all-reduced
+    (reference mp_layers.py:343)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, has_bias=True,
+                 input_is_parallel=False, fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter([in_features, out_features], attr=weight_attr)
+        self.bias = self.create_parameter([out_features], is_bias=True) if has_bias else None
+        if _mp_size() > 1:
+            shard_param(self.weight, "mp", None)
+            if self.bias is not None:
+                shard_param(self.bias)
+
+    def forward(self, x):
+        if _mp_size() > 1 and self.input_is_parallel:
+            x = shard_constraint(x, *([None] * (x.ndim - 1) + ["mp"]))
+        out = F.linear(x, self.weight, self.bias)
+        if _mp_size() > 1:
+            out = shard_constraint(out)  # forces the mp all-reduce of partials
+        return out
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over an mp-sharded vocab (reference mp_layers.py:524).
+    With GSPMD the logits stay sharded on the class dim; XLA partitions the
+    log-softmax reduction with an all-reduce of max/denominator — the same
+    algorithm the reference hand-codes."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):  # noqa: A002
+        return F.cross_entropy(input, label, reduction="none", ignore_index=self.ignore_index)

@@ -1,0 +1,59 @@
+"""Per-axis RNG state tracking (reference: fleet/layers/mpu/random.py:34
+RNGStatesTracker — distinct dropout streams inside vs outside TP regions).
+TPU-native: each named state is its own functional Generator."""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from .....ops.random import Generator, default_generator
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self._states = {}
+
+    def reset(self):
+        self._states = {}
+
+    def add(self, name, seed):
+        if name in self._states:
+            raise ValueError(f"rng state {name} already exists")
+        self._states[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return dict(self._states)
+
+    def set_states_tracker(self, states):
+        self._states = dict(states)
+
+    @contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self._states:
+            self._states[name] = Generator(hash(name) & 0x7FFFFFFF)
+        import paddle_tpu.ops.random as R
+
+        prev = R.default_generator
+        R.default_generator = self._states[name]
+        try:
+            yield
+        finally:
+            R.default_generator = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+
+    from .....ops.random import seed as set_seed
+
+    base = seed if seed is not None else pyrandom.randint(0, 2**31 - 1)
+    _tracker.reset()
+    set_seed(base)
+    _tracker.add("model_parallel_rng", base + 1)
+    _tracker.add("global_seed", base)

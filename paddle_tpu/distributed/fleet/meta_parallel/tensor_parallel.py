@@ -1,0 +1,32 @@
+"""TensorParallel wrapper (reference: fleet/meta_parallel/tensor_parallel.py:27
+— broadcasts non-TP params across the mp group and wires TP layers).
+TPU-native: parameters are born in their NamedSharding layouts (the mpu
+layers shard themselves), so the wrapper only constrains inputs to be
+replicated over 'mp' and batch-sharded over 'dp'."""
+from __future__ import annotations
+
+from ....nn.layer import Layer
+from ....ops.sharding_ops import shard_constraint
+from ....tensor import Tensor
+from ... import mesh as _mesh
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        if _mesh.has_mesh() and "dp" in _mesh.get_mesh().axis_names:
+            inputs = tuple(
+                shard_constraint(x, "dp") if isinstance(x, Tensor) else x
+                for x in inputs
+            )
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)

@@ -1,0 +1,99 @@
+"""Activation recomputation (reference: fleet/recompute/recompute.py:69
+RecomputeFunction PyLayer — saves inputs, reruns forward in backward with
+tracked RNG state).
+
+TPU-native: ``jax.checkpoint`` (remat) IS this feature, applied at the jax
+level so XLA schedules the recompute optimally; RNG replay is automatic
+because our RNG is functional (key Tensors). The eager path uses a PyLayer
+that reruns the function on backward — same semantics, engine-level.
+"""
+from __future__ import annotations
+
+import jax
+
+from ....autograd.py_layer import PyLayer
+from ....ops import dispatch
+from ....ops.random import default_generator
+from ....tensor import Tensor
+
+
+def recompute(function, *args, **kwargs):
+    """reference recompute.py:334 ``recompute(function, *args)``."""
+    use_reentrant = kwargs.pop("use_reentrant", True)
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    if not dispatch.is_grad_enabled() or not any(
+        not t.stop_gradient for t in tensor_args
+    ):
+        return function(*args, **kwargs)
+
+    # snapshot RNG so the backward rerun sees identical dropout masks
+    rng_snapshot = default_generator.get_state() if preserve_rng_state else None
+
+    class _Recompute(PyLayer):
+        @staticmethod
+        def forward(ctx, *tensors):
+            ctx.save_for_backward(*tensors)
+            if rng_snapshot is not None:
+                ctx.rng = Tensor(rng_snapshot._value)
+            with dispatch.no_grad():
+                out = function(*args, **kwargs)
+            ctx.single = not isinstance(out, (tuple, list))
+            return out
+
+        @staticmethod
+        def backward(ctx, *grads):
+            saved = ctx.saved_tensor()
+            # rerun forward WITH grad tracking on detached inputs
+            detached = [Tensor(t._value, stop_gradient=t.stop_gradient) for t in saved]
+            it = iter(detached)
+            new_args = [next(it) if isinstance(a, Tensor) else a for a in args]
+            if rng_snapshot is not None:
+                keep = default_generator.get_state()
+                default_generator.set_state(ctx.rng)
+            with dispatch.enable_grad():
+                out = function(*new_args, **kwargs)
+            if rng_snapshot is not None:
+                default_generator.set_state(keep)
+            outs = [out] if not isinstance(out, (tuple, list)) else list(out)
+            from ....autograd.engine import run_backward, grad as _grad
+
+            diff_inputs = [t for t in detached if not t.stop_gradient]
+            gs = _grad(
+                [o for o in outs if not o.stop_gradient],
+                diff_inputs,
+                grad_outputs=[Tensor(g._value) for g, o in zip(grads, outs) if not o.stop_gradient],
+                allow_unused=True,
+            )
+            gi = iter(gs)
+            result = []
+            for t in detached:
+                if t.stop_gradient:
+                    result.append(None)
+                else:
+                    result.append(next(gi))
+            return tuple(result)
+
+    return _Recompute.apply(*tensor_args)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """reference recompute_sequential: chunked recompute over a Sequential."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    per = max(1, len(layers) // segments)
+
+    def run_segment(lo, hi):
+        def seg(x):
+            for l in layers[lo:hi]:
+                x = l(x)
+            return x
+
+        return seg
+
+    x = args[0]
+    for lo in range(0, len(layers), per):
+        hi = min(lo + per, len(layers))
+        x = recompute(run_segment(lo, hi), x, **kwargs)
+    return x

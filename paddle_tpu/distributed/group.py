@@ -1,0 +1,86 @@
+"""Process groups as mesh-axis views.
+
+Reference: paddle/fluid/distributed/collective/process_group.h:53 (abstract
+ProcessGroup with NCCL/Gloo/... backends) + paddle.distributed.new_group.
+TPU-native: a Group names one or more mesh axes; collectives on the group
+lower to XLA collectives bound to those axis names (inside shard_map/jit).
+There is no per-group communicator bootstrap — XLA derives the ICI rings
+from the mesh topology at compile time.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+
+from .mesh import axis_size, get_mesh
+
+
+class Group:
+    def __init__(self, axes: Tuple[str, ...], ranks: Optional[List[int]] = None, gid: int = 0):
+        self.axes = tuple(axes)
+        self._ranks = ranks
+        self.id = gid
+
+    @property
+    def axis_name(self):
+        return self.axes[0] if len(self.axes) == 1 else self.axes
+
+    @property
+    def nranks(self) -> int:
+        n = 1
+        for a in self.axes:
+            n *= axis_size(a)
+        return n
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self) -> int:
+        # meaningful only inside a mapped context; 0 from the controller
+        return 0
+
+    @property
+    def ranks(self):
+        return self._ranks if self._ranks is not None else list(range(self.nranks))
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def is_member(self):
+        return True
+
+    def __repr__(self):
+        return f"Group(axes={self.axes}, nranks={self.nranks})"
+
+
+_groups: dict = {}
+_next_gid = [1]
+
+
+def _world_group() -> Group:
+    mesh = get_mesh()
+    return Group(tuple(mesh.axis_names), gid=0)
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _world_group()
+    return _groups[gid]
+
+
+def new_group(ranks=None, backend=None, timeout=None, axes=None) -> Group:
+    """reference paddle.distributed.new_group. TPU-native extension: pass
+    ``axes=("mp",)`` to bind the group to mesh axes; plain rank lists map to
+    the whole mesh (arbitrary subsets require a mesh reshape, which the
+    hybrid topology does for you)."""
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    if axes is None:
+        g = Group(tuple(get_mesh().axis_names), ranks=list(ranks) if ranks else None, gid=gid)
+    else:
+        g = Group(tuple(axes), ranks=list(ranks) if ranks else None, gid=gid)
+    _groups[gid] = g
+    return g

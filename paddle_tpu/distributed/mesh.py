@@ -1,0 +1,65 @@
+"""Device mesh management.
+
+TPU-native replacement for the reference's communication groups
+(ProcessGroupNCCL per topology axis, fleet/base/topology.py:54
+CommunicateTopology). A single global ``jax.sharding.Mesh`` carries all
+parallelism axes; every "process group" is a named axis view of it
+(SURVEY.md §5: "collectives become XLA ops over ICI/DCN meshes").
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_global_mesh: Optional[Mesh] = None
+
+# canonical axis order for hybrid parallelism (reference topology order
+# fleet/base/topology.py: ["data","pipe","sharding","sep","model"])
+HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+
+def build_mesh(axes: Dict[str, int], devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from {axis_name: size}. Sizes must multiply to the device
+    count (a trailing axis of size 1 is fine)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    shape = [max(1, int(s)) for s in axes.values()]
+    need = int(np.prod(shape))
+    if need > len(devs):
+        raise ValueError(f"mesh needs {need} devices, have {len(devs)}")
+    arr = np.array(devs[:need]).reshape(shape)
+    return Mesh(arr, tuple(axes.keys()))
+
+
+def set_mesh(mesh: Mesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Mesh:
+    global _global_mesh
+    if _global_mesh is None:
+        devs = jax.devices()
+        _global_mesh = Mesh(np.array(devs), ("dp",))
+    return _global_mesh
+
+
+def has_mesh() -> bool:
+    return _global_mesh is not None
+
+
+def axis_size(axis: str) -> int:
+    mesh = get_mesh()
+    if axis not in mesh.axis_names:
+        return 1
+    return mesh.shape[axis]
+
+
+def named_sharding(*spec) -> NamedSharding:
+    return NamedSharding(get_mesh(), PartitionSpec(*spec))
+
+
+def replicated_sharding() -> NamedSharding:
+    return NamedSharding(get_mesh(), PartitionSpec())

@@ -1,0 +1,44 @@
+"""DataParallel (reference: python/paddle/distributed/parallel.py:186 +
+EagerReducer grad bucketing, collective/reducer.cc).
+
+TPU-native: DP is batch sharding over the 'dp' mesh axis. Parameters stay
+replicated; when the train step is compiled (jit.to_static) XLA inserts ONE
+fused gradient all-reduce per step — the compiler-scheduled equivalent of the
+reference's bucketed overlap reducer. comm_buffer_size/last_comm_buffer_size
+are accepted for API parity (XLA chooses bucketing itself).
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from ..ops.sharding_ops import shard_constraint
+from ..tensor import Tensor
+from .env import init_parallel_env  # noqa: F401
+from . import mesh as _mesh
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        if _mesh.has_mesh() and "dp" in _mesh.get_mesh().axis_names:
+            inputs = tuple(
+                shard_constraint(x, "dp") if isinstance(x, Tensor) else x for x in inputs
+            )
+        return self._layers(*inputs, **kwargs)
+
+    # delegate the Layer protocol to the wrapped module
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss  # XLA mean-reduces over the sharded batch already
+
+    def apply_collective_grads(self):
+        pass  # grads are globally correct under SPMD

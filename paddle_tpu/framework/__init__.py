@@ -1,0 +1,6 @@
+"""framework: save/load, RNG seeding, misc runtime glue
+(reference: python/paddle/framework/)."""
+from . import io  # noqa: F401
+from .io import load, save  # noqa: F401
+from ..ops.random import seed  # noqa: F401
+from ..ops.dispatch import is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401

@@ -1,0 +1,69 @@
+"""paddle.save / paddle.load analog.
+
+Reference: python/paddle/framework/io.py:646 ``save`` / :888 ``load`` —
+pickle-based nested state dicts with tensor→numpy conversion. Identical
+design here: Tensors serialize as numpy arrays; load rehydrates to Tensors
+on the current place.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+def _to_serializable(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._value), obj.name)
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_serializable(v) for v in obj)
+    return obj
+
+
+def _from_serializable(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Tensor(jnp.asarray(obj.array))
+        t.name = obj.name
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_serializable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_serializable(v, return_numpy) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    __slots__ = ("array", "name")
+
+    def __init__(self, array, name=None):
+        self.array = array
+        self.name = name
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_serializable(obj, return_numpy=return_numpy)

@@ -1,0 +1,2 @@
+"""incubate: experimental features (reference: python/paddle/incubate/)."""
+from . import nn  # noqa: F401

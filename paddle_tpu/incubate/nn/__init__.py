@@ -1,0 +1,80 @@
+"""incubate.nn: fused layers (reference:
+python/paddle/incubate/nn/layer/fused_transformer.py:193,498 —
+FusedMultiHeadAttention / FusedFeedForward). On TPU, "fused" means the XLA/
+Pallas compiled form of the same math; these classes keep the reference API
+while emitting the fused-attention path."""
+from __future__ import annotations
+
+from ...nn import Layer, Linear, LayerNorm, Dropout
+from ...nn import functional as F
+from ... import ops
+
+
+class FusedMultiHeadAttention(Layer):
+    """Reference fused_transformer.py:193. attn = SDPA (XLA/Pallas fused)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5, attn_dropout_rate=0.5,
+                 kdim=None, vdim=None, normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.qkv = Linear(embed_dim, 3 * embed_dim, qkv_weight_attr, qkv_bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, linear_weight_attr, linear_bias_attr)
+        self.pre_ln = LayerNorm(embed_dim, epsilon=epsilon)
+        self.ln = LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = Dropout(dropout_rate)
+        self.attn_dropout_rate = attn_dropout_rate
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        residual = query
+        x = self.pre_ln(query) if self.normalize_before else query
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = ops.unbind(qkv, axis=2)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_rate,
+            training=self.training,
+        )
+        out = self.out_proj(out.reshape([b, s, self.embed_dim]))
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.ln(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """Reference fused_transformer.py:498."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, epsilon=1e-5,
+                 activation="relu", act_dropout_rate=None, normalize_before=False,
+                 linear1_weight_attr=None, linear1_bias_attr=None,
+                 linear2_weight_attr=None, linear2_bias_attr=None,
+                 ln1_scale_attr=None, ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.linear1 = Linear(d_model, dim_feedforward, linear1_weight_attr, linear1_bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model, linear2_weight_attr, linear2_bias_attr)
+        self.ln = LayerNorm(d_model, epsilon=epsilon)
+        self.dropout = Dropout(dropout_rate)
+        self.act_dropout = Dropout(act_dropout_rate if act_dropout_rate is not None else dropout_rate)
+        self.activation = getattr(F, activation)
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = self.ln(src) if self.normalize_before else src
+        x = self.linear2(self.act_dropout(self.activation(self.linear1(x))))
+        x = residual + self.dropout(x)
+        if not self.normalize_before:
+            x = self.ln(x)
+        return x
+
+
+class FusedLinear(Linear):
+    pass

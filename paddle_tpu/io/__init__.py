@@ -1,0 +1,10 @@
+"""io: Dataset / DataLoader / samplers.
+
+Reference: python/paddle/io/ (reader.py:218 DataLoader, multiprocess worker
+loop dataloader_iter.py:451). TPU-native: host-side numpy batching with a
+background prefetch thread feeding the async XLA dispatch queue; multiprocess
+workers use the same worker-loop design when num_workers>0.
+"""
+from .dataset import ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset, Subset, TensorDataset, random_split  # noqa: F401
+from .sampler import BatchSampler, DistributedBatchSampler, RandomSampler, Sampler, SequenceSampler, WeightedRandomSampler  # noqa: F401
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,3 @@
+"""jit: trace-and-compile (dy2static analog) + program save/load."""
+from .api import StaticFunction, in_tracing, not_to_static, to_static  # noqa: F401
+from .save_load import load, save  # noqa: F401

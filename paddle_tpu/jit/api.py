@@ -1,0 +1,262 @@
+"""Trace-and-compile: the dy2static analog, TPU-first.
+
+Reference: python/paddle/jit/api.py:233 ``to_static`` +
+dy2static/program_translator.py (StaticFunction/ConcreteProgram/
+PartialProgramLayer executing a captured ProgramDesc via run_program op).
+
+TPU-native redesign: instead of AST-rewriting python into a ProgramDesc and
+interpreting it, we *functionalize* the imperative program into a single
+jitted XLA computation:
+
+1. A first "scout" call runs eagerly while logging (a) every leaf Tensor the
+   function reads (captured state: parameters, buffers, RNG keys, optimizer
+   moments) and (b) every Tensor whose value is re-bound (mutations:
+   optimizer updates, RNG advance, buffer writes).
+2. Subsequent calls execute a cached ``jax.jit`` program whose inputs are
+   (example args + captured state) and whose outputs are (results + mutated
+   state), written back after each call.
+
+The whole train step — forward, ``loss.backward()``'s VJP chain, and the
+optimizer update — traces into ONE fused program: XLA sees the entire graph,
+so there is no per-op dispatch, no interpreter, and remat/fusion apply
+globally. This is why eager-mode overhead does not bound performance
+(SURVEY.md §7 "hard parts" (a)).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..tensor import Tensor
+from ..ops import dispatch
+
+
+class _JitState(threading.local):
+    def __init__(self):
+        self.tracing = False
+
+
+_jit_state = _JitState()
+
+
+def in_tracing() -> bool:
+    return _jit_state.tracing
+
+
+def _tree_flatten(obj, tensors: List[Tensor]):
+    """Flatten nested python containers, extracting Tensors; returns a spec."""
+    if isinstance(obj, Tensor):
+        tensors.append(obj)
+        return ("t", len(tensors) - 1)
+    if isinstance(obj, (list, tuple)):
+        specs = [_tree_flatten(o, tensors) for o in obj]
+        return ("seq", type(obj).__name__, specs)
+    if isinstance(obj, dict):
+        keys = list(obj.keys())
+        specs = [_tree_flatten(obj[k], tensors) for k in keys]
+        return ("dict", keys, specs)
+    return ("leaf", obj)
+
+
+def _tree_unflatten(spec, raws):
+    kind = spec[0]
+    if kind == "t":
+        return Tensor(raws[spec[1]])
+    if kind == "seq":
+        seq = [_tree_unflatten(s, raws) for s in spec[2]]
+        return tuple(seq) if spec[1] == "tuple" else seq
+    if kind == "dict":
+        return {k: _tree_unflatten(s, raws) for k, s in zip(spec[1], spec[2])}
+    return spec[1]
+
+
+def _sig_of(tensors: List[Tensor], static_repr: str):
+    return (
+        tuple((tuple(t._value.shape), str(t._value.dtype)) for t in tensors),
+        static_repr,
+    )
+
+
+class _CompiledEntry:
+    __slots__ = (
+        "jitted",
+        "captured",
+        "mutated_order",
+        "out_spec",
+        "n_args",
+        "gen_threshold",
+        "_scout_result",
+    )
+
+    def __init__(self):
+        self.jitted = None
+        self.captured: List[Tensor] = []
+        self.mutated_order: List[Tensor] = []
+        self.out_spec = None
+        self.n_args = 0
+        self.gen_threshold = 0
+        self._scout_result = None
+
+
+class StaticFunction:
+    """Callable wrapping a compiled imperative function
+    (reference program_translator.py:305)."""
+
+    def __init__(self, fn, input_spec=None, build_strategy=None, backend=None):
+        self._fn = fn
+        self._cache: Dict[Any, _CompiledEntry] = {}
+        functools.update_wrapper(self, fn)
+
+    @property
+    def code_cache(self):
+        return self._cache
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        bound = StaticFunction.__new__(StaticFunction)
+        bound._fn = self._fn.__get__(instance, owner)
+        bound._cache = self._cache  # share compiled programs per class fn
+        return bound
+
+    def __call__(self, *args, **kwargs):
+        arg_tensors: List[Tensor] = []
+        arg_spec = _tree_flatten((args, kwargs), arg_tensors)
+        key = _sig_of(arg_tensors, repr(arg_spec))
+        bound_self = getattr(self._fn, "__self__", None)
+        if bound_self is not None:
+            key = (key, id(bound_self))
+
+        entry = self._cache.get(key)
+        if entry is None:
+            # warmup call: run eagerly so lazily-created state (optimizer
+            # moments, BN stats, caches) comes into existence before capture
+            entry = _CompiledEntry()
+            self._cache[key] = entry
+            return self._fn(*args, **kwargs)
+        if entry.jitted is None:
+            entry = self._scout_and_compile(key, args, kwargs, arg_tensors)
+            # scout call already produced results eagerly
+            return entry._scout_result
+
+        raw_args = [t._value for t in arg_tensors]
+        raw_caps = [t._value for t in entry.captured]
+        out_raws, new_states = entry.jitted(raw_args, raw_caps)
+        for t, v in zip(entry.mutated_order, new_states):
+            t._value = v  # direct write; no re-logging
+        return _tree_unflatten(entry.out_spec, list(out_raws))
+
+    # -- compilation -------------------------------------------------------
+    def _scout_and_compile(self, key, args, kwargs, arg_tensors):
+        entry = self._cache.get(key) or _CompiledEntry()
+
+        # 1. scout: run eagerly, log reads of leaf tensors + mutations
+        from .. import tensor as _tensor_mod
+
+        _tensor_mod._GENERATION[0] += 1
+        threshold = _tensor_mod._GENERATION[0]
+        entry.gen_threshold = threshold
+
+        read_log: Dict[int, Tensor] = {}
+        mut_log: Dict[int, Tensor] = {}
+        prev_read = dispatch._trace_state.read_log
+        prev_epoch = dispatch._trace_state.read_epoch
+        prev_mut = dispatch._trace_state.mutation_log
+        dispatch._trace_state.read_log = read_log
+        dispatch._trace_state.read_epoch = threshold
+        dispatch._trace_state.mutation_log = mut_log
+        try:
+            result = self._fn(*args, **kwargs)
+        finally:
+            dispatch._trace_state.read_log = prev_read
+            dispatch._trace_state.read_epoch = prev_epoch
+            dispatch._trace_state.mutation_log = prev_mut
+
+        arg_ids = {id(t) for t in arg_tensors}
+        captured = [t for tid, t in read_log.items() if tid not in arg_ids]
+        # pre-existing mutated tensors must be carried even if never read
+        for tid, t in mut_log.items():
+            if tid not in arg_ids and t._gen < threshold and not any(
+                t is c for c in captured
+            ):
+                captured.append(t)
+        entry.captured = captured
+        entry.n_args = len(arg_tensors)
+
+        out_tensors: List[Tensor] = []
+        entry.out_spec = _tree_flatten(result, out_tensors)
+        entry._scout_result = result  # type: ignore[attr-defined]
+
+        # 2. build the pure function over (args, captured)
+        fn = self._fn
+        cap_list = captured
+        arg_spec = _tree_flatten((args, kwargs), [])
+
+        def pure_fn(raw_args, raw_caps):
+            # bind tracers into the live Tensor objects, run, then restore
+            snapshot = [(t, t._value, t.grad) for t in cap_list]
+            mut: Dict[int, Tensor] = {}
+            prev_m = dispatch._trace_state.mutation_log
+            prev_t = _jit_state.tracing
+            dispatch._trace_state.mutation_log = mut
+            _jit_state.tracing = True
+            try:
+                for t, rv in zip(cap_list, raw_caps):
+                    t._value = rv
+                a, kw = _tree_unflatten(arg_spec, list(raw_args))
+                res = fn(*a, **kw)
+                outs: List[Tensor] = []
+                _tree_flatten(res, outs)
+                out_raws = tuple(o._value for o in outs)
+                # stable mutation order: captured order first, then other
+                # pre-existing tensors; call-local tensors die with the call
+                order = [t for t in cap_list if id(t) in mut]
+                extra = [
+                    t
+                    for t in mut.values()
+                    if t._gen < entry.gen_threshold and not any(t is o for o in order)
+                ]
+                order.extend(extra)
+                entry.mutated_order = order
+                new_states = tuple(t._value for t in order)
+                return out_raws, new_states
+            finally:
+                dispatch._trace_state.mutation_log = prev_m
+                _jit_state.tracing = prev_t
+                for t, v, g in snapshot:
+                    t._value = v
+                    t.grad = g
+
+        entry.jitted = jax.jit(pure_fn)
+        self._cache[key] = entry
+        return entry
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """Decorator/wrapper compiling an imperative function
+    (reference jit/api.py:233)."""
+
+    def decorate(fn):
+        if isinstance(fn, StaticFunction):
+            return fn
+        # wrapping a Layer: compile its forward
+        from ..nn.layer import Layer
+
+        if isinstance(fn, Layer):
+            layer = fn
+            layer.forward = StaticFunction(layer.forward)
+            return layer
+        return StaticFunction(fn, input_spec, build_strategy, backend)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._paddle_tpu_not_to_static = True
+    return fn

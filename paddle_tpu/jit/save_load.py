@@ -1,0 +1,114 @@
+"""jit.save/load: persist a traced model for inference.
+
+Reference: python/paddle/jit/api.py ``save``/``load`` (inference program +
+params → .pdmodel/.pdiparams). TPU-native: the forward computation is
+serialized with ``jax.export`` (a versioned StableHLO artifact — the analog
+of the reference's ProgramDesc protobuf) alongside the state dict; load
+returns a TranslatedLayer that executes the compiled program.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import export as jax_export
+
+from ..tensor import Tensor
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def _example_avals(input_spec):
+    avals = []
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            avals.append(jax.ShapeDtypeStruct(spec._value.shape, spec._value.dtype))
+        else:
+            from ..static.input_spec import InputSpec
+
+            if isinstance(spec, InputSpec):
+                shape = tuple(1 if (s is None or s < 0) else s for s in spec.shape)
+                avals.append(jax.ShapeDtypeStruct(shape, spec.dtype.np_dtype))
+            else:
+                arr = jnp.asarray(spec)
+                avals.append(jax.ShapeDtypeStruct(arr.shape, arr.dtype))
+    return avals
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Serialize ``layer`` (params + exported StableHLO forward) under ``path``."""
+    from ..nn.layer import Layer
+
+    if not isinstance(layer, Layer):
+        raise TypeError("jit.save expects a Layer (wrap plain functions in a Layer)")
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+
+    fwd = layer.forward
+    fn = fwd._fn if hasattr(fwd, "_fn") else fwd
+    captured = list(layer.parameters()) + [b for _, b in layer.named_buffers()]
+    if input_spec is None:
+        raise ValueError("jit.save of a Layer requires input_spec")
+    in_avals = _example_avals(input_spec)
+    cap_avals = tuple(
+        jax.ShapeDtypeStruct(t._value.shape, t._value.dtype) for t in captured
+    )
+
+    def pure(raw_inputs, raw_caps):
+        snapshot = [(t, t._value) for t in captured]
+        try:
+            for t, rv in zip(captured, raw_caps):
+                t._value = rv
+            ins = [Tensor(r) for r in raw_inputs]
+            out = fn(*ins)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(o._value for o in outs)
+        finally:
+            for t, v in snapshot:
+                t._value = v
+
+    exported = jax_export.export(jax.jit(pure))(tuple(in_avals), cap_avals)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    state = {k: np.asarray(v._value) for k, v in layer.state_dict().items()}
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(
+            {"state": state, "captured": [np.asarray(t._value) for t in captured]},
+            f,
+            protocol=4,
+        )
+
+
+class TranslatedLayer:
+    """Loaded inference program (reference: paddle.jit.TranslatedLayer)."""
+
+    def __init__(self, path):
+        with open(path + ".pdiparams", "rb") as f:
+            blob = pickle.load(f)
+        self._captured = tuple(jnp.asarray(a) for a in blob["captured"])
+        self._state = blob["state"]
+        with open(path + ".pdmodel", "rb") as f:
+            self._exported = jax_export.deserialize(bytearray(f.read()))
+
+    def __call__(self, *inputs):
+        raws = tuple(
+            i._value if isinstance(i, Tensor) else jnp.asarray(i) for i in inputs
+        )
+        outs = self._exported.call(raws, self._captured)
+        outs = [Tensor(o) for o in outs]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def eval(self):
+        return self
+
+    def state_dict(self):
+        return {k: Tensor(jnp.asarray(v)) for k, v in self._state.items()}
+
+
+def load(path, **configs) -> TranslatedLayer:
+    return TranslatedLayer(path)

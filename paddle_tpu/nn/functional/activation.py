@@ -1,0 +1,193 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+All are single fused VPU expressions under XLA."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops import dispatch
+from ...ops._factory import ensure_tensor, unary_op
+
+relu = unary_op(jax.nn.relu, "relu")
+relu6 = unary_op(lambda x: jnp.clip(x, 0, 6), "relu6")
+sigmoid = unary_op(jax.nn.sigmoid, "sigmoid")
+tanh = unary_op(jnp.tanh, "tanh")
+silu = unary_op(jax.nn.silu, "silu")
+swish = silu
+mish = unary_op(lambda x: x * jnp.tanh(jax.nn.softplus(x)), "mish")
+log_sigmoid = unary_op(jax.nn.log_sigmoid, "log_sigmoid")
+softsign = unary_op(jax.nn.soft_sign, "softsign")
+tanhshrink = unary_op(lambda x: x - jnp.tanh(x), "tanhshrink")
+
+
+def gelu(x, approximate=False, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: jax.nn.gelu(a, approximate=approximate), x, op_name="gelu"
+    )
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: jax.nn.leaky_relu(a, negative_slope), x, op_name="leaky_relu"
+    )
+
+
+def elu(x, alpha=1.0, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jax.nn.elu(a, alpha), x, op_name="elu")
+
+
+def selu(
+    x,
+    scale=1.0507009873554804934193349852946,
+    alpha=1.6732632423543772848170429916717,
+    name=None,
+):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x, op_name="selu"
+    )
+
+
+def celu(x, alpha=1.0, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jax.nn.celu(a, alpha), x, op_name="celu")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.clip(a, min, max), x, op_name="hardtanh")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: jnp.clip(a * slope + offset, 0.0, 1.0), x, op_name="hardsigmoid"
+    )
+
+
+def hardswish(x, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x, op_name="hardswish"
+    )
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), x, op_name="hardshrink"
+    )
+
+
+def softshrink(x, threshold=0.5, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: jnp.where(
+            a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)
+        ),
+        x,
+        op_name="softshrink",
+    )
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: jnp.where(
+            a * beta > threshold, a, jax.nn.softplus(a * beta) / beta
+        ),
+        x,
+        op_name="softplus",
+    )
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def fn(a, w):
+        if w.size > 1:
+            ax = 1 if data_format == "NCHW" else a.ndim - 1
+            shape = [1] * a.ndim
+            shape[ax] = w.size
+            w = w.reshape(shape)
+        return jnp.where(a > 0, a, a * w)
+
+    return dispatch.apply(fn, x, weight, op_name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.3333333, training=True, name=None):
+    x = ensure_tensor(x)
+    if training:
+        from ...ops.random import default_generator
+
+        key = default_generator.split()
+
+        def fn(a):
+            slopes = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, a * slopes)
+
+        return dispatch.apply(fn, x, op_name="rrelu")
+    mid = (lower + upper) / 2.0
+    return dispatch.apply(lambda a: jnp.where(a >= 0, a, a * mid), x, op_name="rrelu")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return dispatch.apply(lambda a: jax.nn.softmax(a, axis=axis), x, op_name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if dtype is not None:
+        x = x.astype(dtype)
+    return dispatch.apply(
+        lambda a: jax.nn.log_softmax(a, axis=axis), x, op_name="log_softmax"
+    )
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    x = ensure_tensor(x)
+    from ...ops.random import default_generator
+
+    key = default_generator.split()
+
+    def fn(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = jnp.zeros_like(y)
+            onehot = jnp.put_along_axis(onehot, idx, 1.0, axis=axis, inplace=False)
+            y = onehot + y - jax.lax.stop_gradient(y)
+        return y
+
+    return dispatch.apply(fn, x, op_name="gumbel_softmax")
+
+
+def glu(x, axis=-1, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jax.nn.glu(a, axis=axis), x, op_name="glu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = list(a.shape[:ax]) + [c // groups, groups] + list(a.shape[ax + 1 :])
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+
+    return dispatch.apply(fn, x, op_name="maxout")
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: jnp.where(a > threshold, a, value), x, op_name="thresholded_relu"
+    )

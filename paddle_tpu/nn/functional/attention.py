@@ -1,0 +1,108 @@
+"""Attention functionals.
+
+Reference: python/paddle/nn/functional/flash_attention.py:125 (dynloaded
+flash-attn CUDA kernel). TPU-native: a fused attention expression that XLA
+compiles into blocked MXU matmuls; a Pallas splash/flash kernel
+(paddle_tpu/ops/pallas_kernels/flash_attention.py) takes over for long
+sequences when available.
+
+Layouts follow the reference: q/k/v are [batch, seqlen, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import flags as _flags
+from ...tensor import Tensor
+from ...ops import dispatch
+from ...ops._factory import ensure_tensor
+
+_flags.define_flag("FLAGS_use_pallas_flash_attention", True, "use the Pallas flash kernel when eligible")
+
+
+def _sdpa_reference(q, k, v, mask, dropout_p, is_causal, key=None):
+    # q,k,v: [b, s, h, d] → compute in [b, h, s, d]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    if is_causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        else:
+            scores = scores + mask
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def _flash_eligible(q_shape, dropout_p, mask):
+    if mask is not None or dropout_p > 0.0:
+        return False
+    b, s, h, d = q_shape
+    # Pallas kernel wants seqlen divisible by its block and lane-sized head_dim
+    return s >= 256 and s % 128 == 0 and d % 128 == 0
+
+
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+):
+    query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    mask_t = ensure_tensor(attn_mask) if attn_mask is not None else None
+
+    rng_key = None
+    if dropout_p > 0.0 and training:
+        from ...ops.random import default_generator
+
+        rng_key = default_generator.split()
+    else:
+        dropout_p = 0.0
+
+    use_flash = (
+        _flags.flag("FLAGS_use_pallas_flash_attention")
+        and _flash_eligible(tuple(query._value.shape), dropout_p, mask_t)
+    )
+    if use_flash:
+        try:
+            from ...ops.pallas_kernels.flash_attention import flash_attention_bshd
+
+            fn = functools.partial(flash_attention_bshd, causal=is_causal)
+            return dispatch.apply(fn, query, key, value, op_name="flash_attention")
+        except Exception:
+            pass  # fall back to the XLA expression
+
+    def fn(q, k, v, *m):
+        return _sdpa_reference(q, k, v, m[0] if m else None, dropout_p, is_causal, rng_key)
+
+    if mask_t is not None:
+        return dispatch.apply(fn, query, key, value, mask_t, op_name="sdpa")
+    return dispatch.apply(fn, query, key, value, op_name="sdpa")
+
+
+def flash_attention(
+    query, key, value, dropout=0.0, causal=False, return_softmax=False,
+    fixed_seed_offset=None, rng_name="", training=True, name=None,
+):
+    """API parity with reference flash_attention.py:125 (returns (out, softmax));
+    softmax is only returned by the reference for debugging — we return None."""
+    out = scaled_dot_product_attention(
+        query, key, value, None, dropout, causal, training
+    )
+    return out, None
+
+
+def flash_attn_unpadded(*args, **kwargs):
+    raise NotImplementedError("varlen flash attention: use dense batches on TPU")

@@ -1,0 +1,309 @@
+"""Common functionals: linear, embedding, dropout, pad, one_hot, interpolate…
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dtype import to_jax_dtype
+from ...tensor import Tensor
+from ...ops import dispatch
+from ...ops._factory import ensure_tensor
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b); paddle weight layout is [in_features, out_features]
+    (reference nn/functional/common.py linear → matmul kernel on MXU)."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    if bias is None:
+        return dispatch.apply(lambda a, w: a @ w, x, weight, op_name="linear")
+    bias = ensure_tensor(bias)
+    return dispatch.apply(lambda a, w, b: a @ w + b, x, weight, bias, op_name="linear")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Row gather from the embedding table (reference functional/input.py:
+    embedding). sparse=True is accepted but meaningless on TPU — gradients
+    flow through XLA scatter-add, which is already the fast path."""
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+
+    def fn(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return dispatch.apply(fn, x, weight, op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply_nondiff(
+        lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32), x
+    )
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return dispatch.apply(lambda a: a * (1 - p), x, op_name="dropout_infer")
+        return x
+    from ...ops.random import default_generator
+
+    key = default_generator.split()
+
+    def fn(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0)
+        return jnp.where(keep, a, 0.0)
+
+    return dispatch.apply(fn, x, op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = ensure_tensor(x)
+    if not training or p == 0.0:
+        return x
+    from ...ops.random import default_generator
+
+    key = default_generator.split()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        coef_a = (1.0 - p + p * alpha_p**2 * (1.0 - p)) ** -0.5
+        coef_b = -coef_a * alpha_p * p
+        return coef_a * jnp.where(keep, a, alpha_p) + coef_b
+
+    return dispatch.apply(fn, x, op_name="alpha_dropout")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    if isinstance(pad, Tensor):
+        pad = [int(v) for v in pad.numpy()]
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+
+    if len(pad) == 2 * nd:
+        # full-rank paddle pad: [dim0_lo, dim0_hi, dim1_lo, ...]? The
+        # reference uses per-dim pairs ordered from the LAST dim backwards
+        pairs = [(0, 0)] * nd
+        for i in range(nd):
+            pairs[nd - 1 - i] = (pad[2 * i], pad[2 * i + 1])
+    else:
+        # spatial-only pad on the data_format's spatial dims, last-dim-first
+        n_spatial = len(pad) // 2
+        pairs = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial = list(range(2, nd))
+        else:
+            spatial = list(range(1, nd - 1))
+        spatial = spatial[-n_spatial:]
+        for i, d in enumerate(reversed(spatial)):
+            pairs[d] = (pad[2 * i], pad[2 * i + 1])
+
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+
+    def fn(a):
+        if jmode == "constant":
+            return jnp.pad(a, pairs, mode="constant", constant_values=value)
+        return jnp.pad(a, pairs, mode=jmode)
+
+    return dispatch.apply(fn, x, op_name="pad")
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode="nearest",
+    align_corners=False,
+    align_mode=0,
+    data_format="NCHW",
+    name=None,
+):
+    x = ensure_tensor(x)
+    if data_format not in ("NCHW", "NHWC"):
+        raise NotImplementedError("interpolate supports 4-D inputs")
+    hw_axes = (2, 3) if data_format == "NCHW" else (1, 2)
+    in_h, in_w = x._value.shape[hw_axes[0]], x._value.shape[hw_axes[1]]
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in size.numpy()]
+        out_h, out_w = int(size[0]), int(size[1])
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * 2
+        out_h, out_w = int(in_h * sf[0]), int(in_w * sf[1])
+
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def fn(a):
+        shape = list(a.shape)
+        shape[hw_axes[0]], shape[hw_axes[1]] = out_h, out_w
+        if align_corners and method != "nearest":
+            # jax.image.resize has no align_corners; emulate with explicit coords
+            idx_h = jnp.linspace(0, in_h - 1, out_h)
+            idx_w = jnp.linspace(0, in_w - 1, out_w)
+            a_m = jnp.moveaxis(a, hw_axes, (a.ndim - 2, a.ndim - 1))
+            h0 = jnp.floor(idx_h).astype(jnp.int32)
+            h1 = jnp.minimum(h0 + 1, in_h - 1)
+            wh = (idx_h - h0)[..., None]
+            w0 = jnp.floor(idx_w).astype(jnp.int32)
+            w1 = jnp.minimum(w0 + 1, in_w - 1)
+            ww = idx_w - w0
+            top = a_m[..., h0, :][..., :, w0] * (1 - ww) + a_m[..., h0, :][..., :, w1] * ww
+            bot = a_m[..., h1, :][..., :, w0] * (1 - ww) + a_m[..., h1, :][..., :, w1] * ww
+            out = top * (1 - wh) + bot * wh
+            return jnp.moveaxis(out, (a.ndim - 2, a.ndim - 1), hw_axes)
+        return jax.image.resize(a, shape, method=method)
+
+    return dispatch.apply(fn, x, op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, data_format=data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = upscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+
+    return dispatch.apply(fn, x, op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    r = downscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        raise NotImplementedError
+
+    return dispatch.apply(fn, x, op_name="pixel_unshuffle")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=True))
+        else:
+            n = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=True), 1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+
+    return dispatch.apply(fn, x, op_name="normalize")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = ensure_tensor(x)
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[0], pd[1], pd[1]]
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (pd[0], pd[1]), (pd[2], pd[3])])
+        oh = (a.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (a.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                sl = a[
+                    :,
+                    :,
+                    i * dl[0] : i * dl[0] + oh * st[0] : st[0],
+                    j * dl[1] : j * dl[1] + ow * st[1] : st[1],
+                ]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return dispatch.apply(fn, x, op_name="unfold")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = ensure_tensor(label)
+    if prior_dist is not None:
+        prior_dist = ensure_tensor(prior_dist)
+        return dispatch.apply(
+            lambda l, p: (1 - epsilon) * l + epsilon * p, label, prior_dist, op_name="label_smooth"
+        )
+    k = label._value.shape[-1]
+    return dispatch.apply(
+        lambda l: (1 - epsilon) * l + epsilon / k, label, op_name="label_smooth"
+    )
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    x1, x2 = ensure_tensor(x1), ensure_tensor(x2)
+
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+
+    return dispatch.apply(fn, x1, x2, op_name="cosine_similarity")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = ensure_tensor(x1), ensure_tensor(x2), ensure_tensor(weight)
+
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    if bias is not None:
+        return dispatch.apply(fn, x1, x2, weight, ensure_tensor(bias), op_name="bilinear")
+    return dispatch.apply(fn, x1, x2, weight, op_name="bilinear")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    m = maxlen if maxlen is not None else int(x.numpy().max())
+    jd = to_jax_dtype(dtype)
+    return dispatch.apply_nondiff(
+        lambda a: (jnp.arange(m)[None, :] < a[..., None]).astype(jd), x
+    )

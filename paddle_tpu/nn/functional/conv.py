@@ -1,0 +1,190 @@
+"""Convolutions (reference: python/paddle/nn/functional/conv.py; cuDNN kernels
+paddle/phi/kernels/gpudnn/conv_kernel.cu). TPU-native: lax.conv_general_dilated
+lowers directly onto the MXU; XLA picks the conv algorithm, replacing the
+reference's cudnn autotuning (phi/kernels/autotune)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Tensor
+from ...ops import dispatch
+from ...ops._factory import ensure_tensor
+
+
+def _tuple_n(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(x) for x in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding_for(padding, n_spatial):
+    """Paddle padding: int | list[n] | list[2n] | list of pairs | 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n_spatial
+    padding = list(padding)
+    if len(padding) == n_spatial and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n_spatial:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n_spatial)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # may include batch/channel pairs; keep the last n_spatial
+        pairs = [tuple(p) for p in padding]
+        return pairs[-n_spatial:]
+    raise ValueError(f"bad padding: {padding!r}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, n_spatial, op_name):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    stride = _tuple_n(stride, n_spatial)
+    dilation = _tuple_n(dilation, n_spatial)
+    pad = _padding_for(padding, n_spatial)
+
+    spatial = "DHW"[-n_spatial:]
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + spatial
+        out_spec = "NC" + spatial
+    else:
+        lhs_spec = "N" + spatial + "C"
+        out_spec = "N" + spatial + "C"
+    rhs_spec = "OI" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        x._value.shape, weight._value.shape, (lhs_spec, rhs_spec, out_spec)
+    )
+
+    def fn(a, w, *rest):
+        out = jax.lax.conv_general_dilated(
+            a,
+            w,
+            window_strides=stride,
+            padding=pad,
+            rhs_dilation=dilation,
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[out_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return dispatch.apply(fn, x, weight, ensure_tensor(bias), op_name=op_name)
+    return dispatch.apply(fn, x, weight, op_name=op_name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 1, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3, "conv3d")
+
+
+def _conv_transpose(
+    x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, n_spatial, op_name
+):
+    x, weight = ensure_tensor(x), ensure_tensor(weight)
+    stride = _tuple_n(stride, n_spatial)
+    dilation = _tuple_n(dilation, n_spatial)
+    opad = _tuple_n(output_padding, n_spatial)
+    pad = _padding_for(padding, n_spatial)
+
+    spatial = "DHW"[-n_spatial:]
+    if data_format in ("NCHW", "NCL", "NCDHW"):
+        lhs_spec = "NC" + spatial
+    else:
+        lhs_spec = "N" + spatial + "C"
+    # paddle conv_transpose weight layout: [in_channels, out_channels/groups, *k]
+    rhs_spec = "IO" + spatial
+    dn = (lhs_spec, rhs_spec, lhs_spec)
+
+    def fn(a, w, *rest):
+        if isinstance(pad, str):
+            padding_arg = pad
+        else:
+            # transposed conv: lax.conv_transpose interprets padding like conv
+            padding_arg = [
+                (
+                    dilation[i] * (w.shape[2 + i] - 1) - pad[i][0],
+                    dilation[i] * (w.shape[2 + i] - 1) - pad[i][1] + opad[i],
+                )
+                for i in range(n_spatial)
+            ]
+        if groups == 1:
+            out = jax.lax.conv_transpose(
+                a,
+                w,
+                strides=stride,
+                padding=padding_arg,
+                rhs_dilation=dilation,
+                dimension_numbers=jax.lax.conv_dimension_numbers(a.shape, w.shape, dn),
+                transpose_kernel=True,
+            )
+        else:
+            # grouped transpose: split, conv each group, concat
+            c_ax = lhs_spec.index("C")
+            a_groups = jnp.split(a, groups, axis=c_ax)
+            w_groups = jnp.split(w, groups, axis=0)
+            outs = [
+                jax.lax.conv_transpose(
+                    ag,
+                    wg,
+                    strides=stride,
+                    padding=padding_arg,
+                    rhs_dilation=dilation,
+                    dimension_numbers=jax.lax.conv_dimension_numbers(ag.shape, wg.shape, dn),
+                    transpose_kernel=True,
+                )
+                for ag, wg in zip(a_groups, w_groups)
+            ]
+            out = jnp.concatenate(outs, axis=c_ax)
+        if rest:
+            b = rest[0]
+            shape = [1] * out.ndim
+            shape[lhs_spec.index("C")] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+
+    if bias is not None:
+        return dispatch.apply(fn, x, weight, ensure_tensor(bias), op_name=op_name)
+    return dispatch.apply(fn, x, weight, op_name=op_name)
+
+
+def conv1d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1,
+    dilation=1, output_size=None, data_format="NCL", name=None,
+):
+    return _conv_transpose(
+        x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, 1, "conv1d_transpose"
+    )
+
+
+def conv2d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1,
+    dilation=1, output_size=None, data_format="NCHW", name=None,
+):
+    return _conv_transpose(
+        x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, 2, "conv2d_transpose"
+    )
+
+
+def conv3d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1,
+    dilation=1, output_size=None, data_format="NCDHW", name=None,
+):
+    return _conv_transpose(
+        x, weight, bias, stride, padding, output_padding, dilation, groups, data_format, 3, "conv3d_transpose"
+    )

@@ -1,0 +1,273 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py;
+softmax_with_cross_entropy kernel phi/kernels/gpu/cross_entropy_kernel.cu)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Tensor
+from ...ops import dispatch
+from ...ops._factory import ensure_tensor
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(
+    input,  # noqa: A002
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    use_softmax=True,
+    label_smoothing=0.0,
+    name=None,
+):
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    tensors = [input, label]
+    has_w = weight is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+
+    def fn(logits, lab, *w):
+        lp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(
+            jnp.maximum(logits, 1e-30)
+        )
+        n_classes = logits.shape[axis]
+        if soft_label:
+            tgt = lab
+            if label_smoothing > 0:
+                tgt = tgt * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(tgt * lp, axis=axis)
+        else:
+            lab_idx = lab
+            if lab_idx.ndim == lp.ndim:
+                lab_idx = jnp.squeeze(lab_idx, axis=axis)
+            lab_idx = lab_idx.astype(jnp.int32)
+            valid = lab_idx != ignore_index
+            safe = jnp.where(valid, lab_idx, 0)
+            picked = jnp.take_along_axis(
+                lp, jnp.expand_dims(safe, axis), axis=axis
+            ).squeeze(axis)
+            if label_smoothing > 0:
+                smooth = -jnp.mean(lp, axis=axis)
+                loss = (1 - label_smoothing) * (-picked) + label_smoothing * smooth
+            else:
+                loss = -picked
+            loss = jnp.where(valid, loss, 0.0)
+            if has_w:
+                wv = jnp.take(w[0], safe)
+                wv = jnp.where(valid, wv, 0.0)
+                loss = loss * wv
+                if reduction == "mean":
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(wv), 1e-12)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+                return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    return dispatch.apply(fn, *tensors, op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(
+    logits, label, soft_label=False, ignore_index=-100, numeric_stable_mode=True,
+    return_softmax=False, axis=-1,
+):
+    out = cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        reduction="none", axis=axis,
+    )
+    out = out.unsqueeze(axis) if not soft_label else out
+    if return_softmax:
+        from .activation import softmax
+
+        return out, softmax(logits, axis=axis)
+    return out
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return dispatch.apply(
+        lambda a, b: _reduce(jnp.square(a - b), reduction), input, label, op_name="mse_loss"
+    )
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return dispatch.apply(
+        lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label, op_name="l1_loss"
+    )
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        # paddle multiplies by delta
+        return _reduce(loss * delta, reduction)
+
+    return dispatch.apply(fn, input, label, op_name="smooth_l1_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    tensors = [input, label]
+    has_w = weight is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+
+    def fn(lp, lab, *w):
+        lab = lab.astype(jnp.int32)
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        picked = jnp.take_along_axis(lp, safe[:, None], axis=1).squeeze(1)
+        loss = -picked
+        if has_w:
+            wv = jnp.take(w[0], safe)
+            loss = loss * wv
+            loss = jnp.where(valid, loss, 0.0)
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(jnp.where(valid, wv, 0.0)), 1e-12)
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+
+    return dispatch.apply(fn, *tensors, op_name="nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    tensors = [input, label]
+    has_w = weight is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+
+    def fn(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if has_w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+
+    return dispatch.apply(fn, *tensors, op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(
+    logit, label, weight=None, reduction="mean", pos_weight=None, name=None
+):
+    logit, label = ensure_tensor(logit), ensure_tensor(label)
+    tensors = [logit, label]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_pw:
+        tensors.append(ensure_tensor(pos_weight))
+
+    def fn(z, y, *rest):
+        i = 0
+        w = None
+        pw = None
+        if has_w:
+            w = rest[i]
+            i += 1
+        if has_pw:
+            pw = rest[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)), with pos_weight factor
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.logaddexp(0.0, -jnp.abs(z)) + jnp.maximum(-z, 0.0))
+        else:
+            loss = jnp.maximum(z, 0) - z * y + jnp.logaddexp(0.0, -jnp.abs(z))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+
+    return dispatch.apply(fn, *tensors, op_name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(lp, y):
+        loss = y * (jnp.log(jnp.maximum(y, 1e-30)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+
+    return dispatch.apply(fn, input, label, op_name="kl_div")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+
+    return dispatch.apply(fn, input, label, op_name="hinge_embedding_loss")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):  # noqa: A002
+    input, other, label = ensure_tensor(input), ensure_tensor(other), ensure_tensor(label)
+
+    def fn(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(loss, reduction)
+
+    return dispatch.apply(fn, input, other, label, op_name="margin_ranking_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    input1, input2, label = ensure_tensor(input1), ensure_tensor(input2), ensure_tensor(label)
+
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12
+        )
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+
+    return dispatch.apply(fn, input1, input2, label, op_name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(
+    input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False,  # noqa: A002
+    reduction="mean", name=None,
+):
+    input, positive, negative = (
+        ensure_tensor(input), ensure_tensor(positive), ensure_tensor(negative),
+    )
+
+    def fn(a, pos, neg):
+        def dst(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p), axis=-1), 1.0 / p)
+
+        d_pos = dst(a, pos)
+        d_neg = dst(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dst(pos, neg))
+        loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+        return _reduce(loss, reduction)
+
+    return dispatch.apply(fn, input, positive, negative, op_name="triplet_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    raise NotImplementedError("ctc_loss lands with the audio stack")
+
+
+def square_error_cost(input, label):  # noqa: A002
+    input, label = ensure_tensor(input), ensure_tensor(label)
+    return dispatch.apply(lambda a, b: jnp.square(a - b), input, label, op_name="square_error_cost")

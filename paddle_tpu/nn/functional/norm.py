@@ -1,0 +1,217 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py;
+CUDA kernels phi/kernels/gpu/layer_norm_kernel.cu, batch_norm_kernel.cu).
+XLA fuses the mean/var/normalize chain into a couple of VPU passes."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Tensor
+from ...ops import dispatch
+from ...ops._factory import ensure_tensor
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    x = ensure_tensor(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    n_axes = len(list(normalized_shape))
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
+
+    tensors = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_b:
+        tensors.append(ensure_tensor(bias))
+
+    def fn(a, *wb):
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(a - mean), axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i]
+            i += 1
+        if has_b:
+            out = out + wb[i]
+        return out
+
+    return dispatch.apply(fn, *tensors, op_name="layer_norm")
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-05,
+    data_format="NCHW",
+    use_global_stats=None,
+    name=None,
+):
+    """Reference functional/norm.py batch_norm. Running stats are buffers
+    updated in-place during training (functionalized under jit tracing)."""
+    x = ensure_tensor(x)
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    use_batch_stats = training and not use_global_stats
+
+    tensors = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_b:
+        tensors.append(ensure_tensor(bias))
+
+    shape = [1] * x.ndim
+    shape[c_axis] = x._value.shape[c_axis]
+
+    if use_batch_stats:
+        # compute batch stats (differentiable), update running buffers
+        def fn(a, *wb):
+            mean = jnp.mean(a, axis=reduce_axes)
+            var = jnp.var(a, axis=reduce_axes)
+            out = (a - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+            i = 0
+            if has_w:
+                out = out * wb[i].reshape(shape)
+                i += 1
+            if has_b:
+                out = out + wb[i].reshape(shape)
+            return out, mean, var
+
+        out, mean_t, var_t = dispatch.apply(fn, *tensors, op_name="batch_norm")
+        if running_mean is not None:
+            dispatch.note_read(running_mean)
+            n = int(np.prod([x._value.shape[i] for i in reduce_axes]))
+            unbias = n / max(n - 1, 1)
+            running_mean._set_value(
+                running_mean._value * momentum + mean_t._value * (1 - momentum)
+            )
+            running_var._set_value(
+                running_var._value * momentum + var_t._value * unbias * (1 - momentum)
+            )
+        return out
+
+    rm, rv = ensure_tensor(running_mean), ensure_tensor(running_var)
+    all_t = [x, rm, rv] + tensors[1:]
+
+    def fn_eval(a, m, v, *wb):
+        out = (a - m.reshape(shape)) * jax.lax.rsqrt(v.reshape(shape) + epsilon)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    return dispatch.apply(fn_eval, *all_t, op_name="batch_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    axes = tuple(range(2, x.ndim)) if data_format.startswith("NC") else tuple(range(1, x.ndim - 1))
+    c_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[c_axis] = x._value.shape[c_axis]
+
+    tensors = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_b:
+        tensors.append(ensure_tensor(bias))
+
+    def fn(a, *wb):
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    return dispatch.apply(fn, *tensors, op_name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    if not data_format.startswith("NC"):
+        raise NotImplementedError("group_norm NHWC")
+    c = x._value.shape[1]
+    g = num_groups
+
+    tensors = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+    if has_b:
+        tensors.append(ensure_tensor(bias))
+
+    def fn(a, *wb):
+        n = a.shape[0]
+        rest = a.shape[2:]
+        ag = a.reshape(n, g, c // g, *rest)
+        axes = tuple(range(2, ag.ndim))
+        mean = jnp.mean(ag, axis=axes, keepdims=True)
+        var = jnp.var(ag, axis=axes, keepdims=True)
+        out = ((ag - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+        shape = [1, c] + [1] * (a.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    return dispatch.apply(fn, *tensors, op_name="group_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (used by modern LLM blocks; reference has fused variants in
+    incubate). Pallas-fusable; XLA already emits a tight kernel."""
+    x = ensure_tensor(x)
+    tensors = [x]
+    has_w = weight is not None
+    if has_w:
+        tensors.append(ensure_tensor(weight))
+
+    def fn(a, *w):
+        ms = jnp.mean(jnp.square(a), axis=-1, keepdims=True)
+        out = a * jax.lax.rsqrt(ms + epsilon)
+        if has_w:
+            out = out * w[0]
+        return out
+
+    return dispatch.apply(fn, *tensors, op_name="rms_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        sq = jnp.square(a)
+        c_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        half = size // 2
+        sq_m = jnp.moveaxis(sq, c_axis, 0)
+        padded = jnp.pad(sq_m, [(half, size - 1 - half)] + [(0, 0)] * (sq_m.ndim - 1))
+        acc = sum(padded[i : i + sq_m.shape[0]] for i in range(size))
+        acc = jnp.moveaxis(acc, 0, c_axis)
+        return a / jnp.power(k + alpha * acc, beta)
+
+    return dispatch.apply(fn, x, op_name="local_response_norm")

@@ -1,0 +1,227 @@
+"""Pooling (reference: python/paddle/nn/functional/pooling.py). All lower to
+lax.reduce_window."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import dispatch
+from ...ops._factory import ensure_tensor
+from .conv import _padding_for, _tuple_n
+
+
+def _window(nd_spatial, data_format, ks, st):
+    if data_format.startswith("NC"):
+        dims = (1, 1) + ks
+        strides = (1, 1) + st
+        spatial_off = 2
+    else:
+        dims = (1,) + ks + (1,)
+        strides = (1,) + st + (1,)
+        spatial_off = 1
+    return dims, strides, spatial_off
+
+
+def _full_pad(pairs, nd, spatial_off):
+    full = [(0, 0)] * nd
+    for i, p in enumerate(pairs):
+        full[spatial_off + i] = tuple(p)
+    return full
+
+
+def max_pool2d(
+    x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+    data_format="NCHW", name=None,
+):
+    return _max_pool(x, kernel_size, stride, padding, return_mask, ceil_mode, data_format, 2)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    return _max_pool(x, kernel_size, stride, padding, return_mask, ceil_mode, "NCL", 1)
+
+
+def max_pool3d(
+    x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+    data_format="NCDHW", name=None,
+):
+    return _max_pool(x, kernel_size, stride, padding, return_mask, ceil_mode, data_format, 3)
+
+
+def _max_pool(x, kernel_size, stride, padding, return_mask, ceil_mode, data_format, nsp):
+    x = ensure_tensor(x)
+    ks = _tuple_n(kernel_size, nsp)
+    st = _tuple_n(stride if stride is not None else kernel_size, nsp)
+    pairs = _padding_for(padding, nsp)
+    dims, strides, off = _window(nsp, data_format, ks, st)
+
+    def fn(a):
+        if isinstance(pairs, str):
+            pad_arg = pairs
+        else:
+            pad_arg = _full_pad(pairs, a.ndim, off)
+        neg = jnp.finfo(a.dtype).min if np.issubdtype(np.dtype(a.dtype), np.floating) else np.iinfo(np.dtype(a.dtype)).min
+        return jax.lax.reduce_window(a, neg, jax.lax.max, dims, strides, pad_arg)
+
+    out = dispatch.apply(fn, x, op_name="max_pool")
+    if return_mask:
+        idx = dispatch.apply_nondiff(lambda a: _argmax_pool(a, dims, strides, pairs, off), x)
+        return out, idx
+    return out
+
+
+def _argmax_pool(a, dims, strides, pairs, off):
+    flat_idx = jnp.arange(a.size, dtype=jnp.float64).reshape(a.shape)
+    # pack (value, index): use a reduce over tuples via argmax trick
+    def select(x1, x2):
+        v1, i1 = x1
+        v2, i2 = x2
+        pick = v1 >= v2
+        return jnp.where(pick, v1, v2), jnp.where(pick, i1, i2)
+
+    pad_arg = "VALID" if isinstance(pairs, str) and pairs == "VALID" else (
+        pairs if isinstance(pairs, str) else _full_pad(pairs, a.ndim, off)
+    )
+    neg = jnp.finfo(a.dtype).min if np.issubdtype(np.dtype(a.dtype), np.floating) else np.iinfo(np.dtype(a.dtype)).min
+    vals, idx = jax.lax.reduce_window(
+        (a, flat_idx),
+        (jnp.asarray(neg, a.dtype), jnp.asarray(-1.0, jnp.float64)),
+        select,
+        dims,
+        strides,
+        pad_arg,
+    )
+    return idx.astype(jnp.int64)
+
+
+def avg_pool2d(
+    x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+    divisor_override=None, data_format="NCHW", name=None,
+):
+    return _avg_pool(x, kernel_size, stride, padding, exclusive, divisor_override, data_format, 2)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    return _avg_pool(x, kernel_size, stride, padding, exclusive, None, "NCL", 1)
+
+
+def avg_pool3d(
+    x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+    divisor_override=None, data_format="NCDHW", name=None,
+):
+    return _avg_pool(x, kernel_size, stride, padding, exclusive, divisor_override, data_format, 3)
+
+
+def _avg_pool(x, kernel_size, stride, padding, exclusive, divisor_override, data_format, nsp):
+    x = ensure_tensor(x)
+    ks = _tuple_n(kernel_size, nsp)
+    st = _tuple_n(stride if stride is not None else kernel_size, nsp)
+    pairs = _padding_for(padding, nsp)
+    dims, strides, off = _window(nsp, data_format, ks, st)
+
+    def fn(a):
+        pad_arg = pairs if isinstance(pairs, str) else _full_pad(pairs, a.ndim, off)
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, dims, strides, pad_arg)
+        if divisor_override:
+            return s / divisor_override
+        if exclusive and not isinstance(pairs, str):
+            ones = jnp.ones_like(a)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims, strides, pad_arg)
+            return s / cnt
+        return s / float(np.prod(ks))
+
+    return dispatch.apply(fn, x, op_name="avg_pool")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    x = ensure_tensor(x)
+    os = _tuple_n(output_size, 2)
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            oh, ow = os
+            a5 = a.reshape(n, c, oh, h // oh, ow, w // ow) if h % oh == 0 and w % ow == 0 else None
+            if a5 is not None:
+                return a5.mean(axis=(3, 5))
+            # general: mean over variable windows
+            out = jnp.stack(
+                [
+                    jnp.stack(
+                        [
+                            a[:, :, (i * h) // oh : ((i + 1) * h + oh - 1) // oh,
+                              (j * w) // ow : ((j + 1) * w + ow - 1) // ow].mean(axis=(2, 3))
+                            for j in range(ow)
+                        ],
+                        axis=-1,
+                    )
+                    for i in range(oh)
+                ],
+                axis=-2,
+            )
+            return out
+        raise NotImplementedError("NHWC adaptive pool")
+
+    return dispatch.apply(fn, x, op_name="adaptive_avg_pool2d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    x = ensure_tensor(x)
+    os = _tuple_n(output_size, 2)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        oh, ow = os
+        if h % oh == 0 and w % ow == 0:
+            return a.reshape(n, c, oh, h // oh, ow, w // ow).max(axis=(3, 5))
+        return jnp.stack(
+            [
+                jnp.stack(
+                    [
+                        a[:, :, (i * h) // oh : ((i + 1) * h + oh - 1) // oh,
+                          (j * w) // ow : ((j + 1) * w + ow - 1) // ow].max(axis=(2, 3))
+                        for j in range(ow)
+                    ],
+                    axis=-1,
+                )
+                for i in range(oh)
+            ],
+            axis=-2,
+        )
+
+    out = dispatch.apply(fn, x, op_name="adaptive_max_pool2d")
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool2d return_mask")
+    return out
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    x = ensure_tensor(x)
+    os = int(output_size)
+
+    def fn(a):
+        n, c, l = a.shape
+        if l % os == 0:
+            return a.reshape(n, c, os, l // os).mean(axis=3)
+        return jnp.stack(
+            [a[:, :, (i * l) // os : ((i + 1) * l + os - 1) // os].mean(axis=2) for i in range(os)],
+            axis=-1,
+        )
+
+    return dispatch.apply(fn, x, op_name="adaptive_avg_pool1d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    x = ensure_tensor(x)
+    os = int(output_size)
+
+    def fn(a):
+        n, c, l = a.shape
+        if l % os == 0:
+            return a.reshape(n, c, os, l // os).max(axis=3)
+        return jnp.stack(
+            [a[:, :, (i * l) // os : ((i + 1) * l + os - 1) // os].max(axis=2) for i in range(os)],
+            axis=-1,
+        )
+
+    return dispatch.apply(fn, x, op_name="adaptive_max_pool1d")

@@ -1,0 +1,89 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..layer import Layer
+
+
+def _act_layer(fname, **fixed):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = {**fixed}
+            # positional args map onto the functional's extra params in order
+            fn = getattr(F, fname)
+            import inspect
+
+            params = [
+                p for p in inspect.signature(fn).parameters if p not in ("x", "name")
+            ]
+            for p, a in zip(params, args):
+                self._kwargs[p] = a
+            for k, v in kwargs.items():
+                if k != "name":
+                    self._kwargs[k] = v
+
+        def forward(self, x):
+            return getattr(F, fname)(x, **self._kwargs)
+
+    _Act.__name__ = fname.title().replace("_", "")
+    return _Act
+
+
+ReLU = _act_layer("relu")
+ReLU6 = _act_layer("relu6")
+GELU = _act_layer("gelu")
+SiLU = _act_layer("silu")
+Swish = _act_layer("swish")
+Mish = _act_layer("mish")
+Sigmoid = _act_layer("sigmoid")
+Tanh = _act_layer("tanh")
+LogSigmoid = _act_layer("log_sigmoid")
+Softsign = _act_layer("softsign")
+Tanhshrink = _act_layer("tanhshrink")
+LeakyReLU = _act_layer("leaky_relu")
+ELU = _act_layer("elu")
+SELU = _act_layer("selu")
+CELU = _act_layer("celu")
+Hardtanh = _act_layer("hardtanh")
+Hardsigmoid = _act_layer("hardsigmoid")
+Hardswish = _act_layer("hardswish")
+Hardshrink = _act_layer("hardshrink")
+Softshrink = _act_layer("softshrink")
+Softplus = _act_layer("softplus")
+ThresholdedReLU = _act_layer("thresholded_relu")
+Maxout = _act_layer("maxout")
+GLU = _act_layer("glu")
+RReLU = _act_layer("rrelu")
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, axis=self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, axis=self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        from ..initializer import Constant
+
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr, default_initializer=Constant(init)
+        )
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self.data_format)

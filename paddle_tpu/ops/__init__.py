@@ -1,0 +1,235 @@
+"""Op library aggregator + Tensor method patching.
+
+Reference analog: python/paddle/tensor/__init__.py re-exports +
+python/paddle/fluid/dygraph/math_op_patch.py (operator overloads installed
+onto the Tensor type at import time).
+"""
+from __future__ import annotations
+
+from . import creation, dispatch, linalg, logic, manipulation, math, random, reduction, search
+from .dispatch import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+
+from .creation import *  # noqa: F401,F403
+from .linalg import (  # noqa: F401
+    bincount,
+    bmm,
+    cholesky,
+    cond,
+    corrcoef,
+    cov,
+    cross,
+    det,
+    dist,
+    dot,
+    eig,
+    eigh,
+    eigvals,
+    eigvalsh,
+    einsum,
+    histogram,
+    inner,
+    inv,
+    inverse,
+    kron,
+    lstsq,
+    matmul,
+    matrix_power,
+    matrix_rank,
+    matrix_transpose,
+    mm,
+    multi_dot,
+    norm,
+    outer,
+    pinv,
+    qr,
+    slogdet,
+    solve,
+    svd,
+    t,
+    triangular_solve,
+)
+from .logic import *  # noqa: F401,F403
+from .manipulation import (  # noqa: F401
+    as_strided,
+    broadcast_tensors,
+    broadcast_to,
+    cast,
+    chunk,
+    concat,
+    expand,
+    expand_as,
+    flatten,
+    flip,
+    gather,
+    gather_nd,
+    index_add,
+    index_sample,
+    index_select,
+    moveaxis,
+    numel,
+    put_along_axis,
+    repeat_interleave,
+    reshape,
+    reshape_,
+    roll,
+    rot90,
+    scatter,
+    scatter_nd,
+    scatter_nd_add,
+    shard_index,
+    split,
+    squeeze,
+    stack,
+    swapaxes,
+    take_along_axis,
+    tile,
+    transpose,
+    unbind,
+    unique,
+    unique_consecutive,
+    unstack,
+    unsqueeze,
+)
+from .math import *  # noqa: F401,F403
+from .random import (  # noqa: F401
+    Generator,
+    bernoulli,
+    binomial,
+    default_generator,
+    gaussian,
+    get_rng_state,
+    multinomial,
+    normal,
+    poisson,
+    rand,
+    randint,
+    randint_like,
+    randn,
+    randperm,
+    seed,
+    set_rng_state,
+    standard_normal,
+    uniform,
+)
+from .reduction import (  # noqa: F401
+    all,
+    amax,
+    amin,
+    any,
+    count_nonzero,
+    logsumexp,
+    max,
+    mean,
+    median,
+    min,
+    nanmean,
+    nanmedian,
+    nanquantile,
+    nansum,
+    prod,
+    quantile,
+    std,
+    sum,
+    var,
+)
+from .search import (  # noqa: F401
+    argmax,
+    argmin,
+    argsort,
+    bucketize,
+    index_put,
+    kthvalue,
+    masked_fill,
+    masked_select,
+    mode,
+    nonzero,
+    searchsorted,
+    sort,
+    topk,
+    where,
+)
+
+# ---------------------------------------------------------------------------
+# Tensor method patching (math_op_patch analog)
+# ---------------------------------------------------------------------------
+from ..tensor import Tensor as _T
+
+
+def _patch():
+    import sys
+
+    mod = sys.modules[__name__]
+    method_names = [
+        # math
+        "add", "subtract", "multiply", "divide", "mod", "floor_divide", "pow",
+        "maximum", "minimum", "fmax", "fmin", "exp", "log", "log2", "log10",
+        "log1p", "sqrt", "rsqrt", "square", "abs", "sign", "neg", "reciprocal",
+        "floor", "ceil", "round", "trunc", "sin", "cos", "tan", "tanh",
+        "sigmoid", "erf", "scale", "clip", "lerp", "cumsum", "cumprod",
+        "isnan", "isinf", "isfinite", "nan_to_num",
+        "add_", "subtract_", "multiply_", "divide_", "scale_", "clip_",
+        "exp_", "sqrt_", "rsqrt_", "floor_", "ceil_", "round_", "reciprocal_", "tanh_",
+        # reduction
+        "sum", "mean", "max", "min", "prod", "all", "any", "logsumexp", "var",
+        "std", "median", "quantile", "amax", "amin",
+        # linalg
+        "matmul", "mm", "bmm", "dot", "norm", "dist", "t", "inner", "outer",
+        "cholesky", "inverse", "det",
+        # manipulation
+        "reshape", "reshape_", "flatten", "squeeze", "unsqueeze", "transpose",
+        "concat", "split", "chunk", "tile", "expand", "expand_as",
+        "broadcast_to", "flip", "roll", "gather", "gather_nd", "scatter",
+        "index_select", "index_sample", "index_add", "take_along_axis",
+        "put_along_axis", "unbind", "unique", "repeat_interleave", "moveaxis",
+        "swapaxes", "numel",
+        # logic
+        "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+        "less_equal", "logical_and", "logical_or", "logical_xor",
+        "logical_not", "isclose", "allclose", "equal_all", "bitwise_and",
+        "bitwise_or", "bitwise_xor", "bitwise_not",
+        # search
+        "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+        "masked_select", "masked_fill", "kthvalue", "mode",
+    ]
+    for name in method_names:
+        fn = getattr(mod, name, None)
+        if fn is not None and not hasattr(_T, name):
+            setattr(_T, name, fn)
+
+    # operator overloads
+    _T.__add__ = lambda self, o: add(self, o)
+    _T.__radd__ = lambda self, o: add(o, self)
+    _T.__sub__ = lambda self, o: subtract(self, o)
+    _T.__rsub__ = lambda self, o: subtract(o, self)
+    _T.__mul__ = lambda self, o: multiply(self, o)
+    _T.__rmul__ = lambda self, o: multiply(o, self)
+    _T.__truediv__ = lambda self, o: divide(self, o)
+    _T.__rtruediv__ = lambda self, o: divide(o, self)
+    _T.__floordiv__ = lambda self, o: floor_divide(self, o)
+    _T.__mod__ = lambda self, o: mod(self, o)
+    _T.__pow__ = lambda self, o: pow(self, o)
+    _T.__rpow__ = lambda self, o: pow(o, self)
+    _T.__matmul__ = lambda self, o: matmul(self, o)
+    _T.__rmatmul__ = lambda self, o: matmul(o, self)
+    _T.__neg__ = lambda self: neg(self)
+    _T.__abs__ = lambda self: abs(self)
+    _T.__eq__ = lambda self, o: equal(self, o)
+    _T.__ne__ = lambda self, o: not_equal(self, o)
+    _T.__lt__ = lambda self, o: less_than(self, o)
+    _T.__le__ = lambda self, o: less_equal(self, o)
+    _T.__gt__ = lambda self, o: greater_than(self, o)
+    _T.__ge__ = lambda self, o: greater_equal(self, o)
+    _T.__invert__ = lambda self: logical_not(self)
+    _T.__and__ = lambda self, o: (
+        logical_and(self, o) if self.dtype == "bool" else bitwise_and(self, o)
+    )
+    _T.__or__ = lambda self, o: (
+        logical_or(self, o) if self.dtype == "bool" else bitwise_or(self, o)
+    )
+    _T.__xor__ = lambda self, o: (
+        logical_xor(self, o) if self.dtype == "bool" else bitwise_xor(self, o)
+    )
+
+
+_patch()
+del _patch

@@ -1,0 +1,82 @@
+"""Op-definition helpers.
+
+TPU-native analog of the reference's YAML op codegen
+(reference: paddle/phi/api/yaml/ops.yaml + generator/api_gen.py): instead of
+generating C++ from YAML, each op is declared as a pure jax function and these
+factories produce the user-facing wrapper (tensor conversion, scalar closure,
+autograd capture via dispatch.apply).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, to_tensor
+from . import dispatch
+
+__all__ = ["ensure_tensor", "unary_op", "binary_op", "cmp_op", "logical_op"]
+
+
+def ensure_tensor(x, like=None):
+    if isinstance(x, Tensor):
+        return x
+    dtype = None
+    if like is not None and isinstance(x, (int, float, bool)) and not isinstance(x, bool):
+        dtype = like.dtype
+    return to_tensor(x, dtype=dtype)
+
+
+def unary_op(jfn: Callable, name: str):
+    def op(x, name=None):  # noqa: A002  (matches reference signature)
+        x = ensure_tensor(x)
+        return dispatch.apply(jfn, x, op_name=op.__name__)
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"Elementwise ``{name}`` (TPU-native; see reference ops.yaml entry '{name}')."
+    return op
+
+
+def binary_op(jfn: Callable, name: str):
+    def op(x, y, name=None):  # noqa: A002
+        xt = isinstance(x, Tensor)
+        yt = isinstance(y, Tensor)
+        if xt and yt:
+            return dispatch.apply(jfn, x, y, op_name=op.__name__)
+        if xt:
+            return dispatch.apply(lambda a: jfn(a, y), x, op_name=op.__name__)
+        if yt:
+            return dispatch.apply(lambda b: jfn(x, b), y, op_name=op.__name__)
+        return dispatch.apply(jfn, ensure_tensor(x), ensure_tensor(y), op_name=op.__name__)
+
+    op.__name__ = name
+    op.__qualname__ = name
+    op.__doc__ = f"Elementwise ``{name}`` with broadcasting."
+    return op
+
+
+def cmp_op(jfn: Callable, name: str):
+    def op(x, y, name=None):  # noqa: A002
+        x = ensure_tensor(x)
+        y = y if not isinstance(y, Tensor) else y
+        if isinstance(y, Tensor):
+            return dispatch.apply_nondiff(jfn, x, y)
+        return dispatch.apply_nondiff(lambda a: jfn(a, y), x)
+
+    op.__name__ = name
+    return op
+
+
+def logical_op(jfn: Callable, name: str):
+    def op(x, y=None, out=None, name=None):  # noqa: A002
+        x = ensure_tensor(x)
+        if y is None:
+            return dispatch.apply_nondiff(jfn, x)
+        y = ensure_tensor(y)
+        return dispatch.apply_nondiff(jfn, x, y)
+
+    op.__name__ = name
+    return op

@@ -1,0 +1,187 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import to_jax_dtype
+from ..tensor import Tensor, to_tensor
+from . import dispatch
+from ._factory import ensure_tensor
+
+__all__ = [
+    "to_tensor",
+    "zeros",
+    "ones",
+    "full",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty",
+    "empty_like",
+    "arange",
+    "linspace",
+    "logspace",
+    "eye",
+    "diag",
+    "diagflat",
+    "tril",
+    "triu",
+    "meshgrid",
+    "assign",
+    "clone",
+    "tril_indices",
+    "triu_indices",
+    "complex",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.numpy()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def zeros(shape, dtype="float32", name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), to_jax_dtype(dtype)))
+
+
+def ones(shape, dtype="float32", name=None):
+    return Tensor(jnp.ones(_shape_list(shape), to_jax_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype="float32", name=None):
+    fv = fill_value.item() if isinstance(fill_value, Tensor) else fill_value
+    return Tensor(jnp.full(_shape_list(shape), fv, to_jax_dtype(dtype)))
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    jd = to_jax_dtype(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jnp.zeros(x._value.shape, jd))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = ensure_tensor(x)
+    jd = to_jax_dtype(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jnp.ones(x._value.shape, jd))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = ensure_tensor(x)
+    jd = to_jax_dtype(dtype) if dtype is not None else x._value.dtype
+    fv = fill_value.item() if isinstance(fill_value, Tensor) else fill_value
+    return Tensor(jnp.full(x._value.shape, fv, jd))
+
+
+def empty(shape, dtype="float32", name=None):
+    # XLA has no uninitialized alloc; zeros is free under fusion.
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (
+            "int64"
+            if all(isinstance(v, (int, np.integer)) for v in (start, end, step))
+            else "float32"
+        )
+    return Tensor(jnp.arange(start, end, step, to_jax_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)), dtype=to_jax_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype="float32", name=None):
+    return Tensor(jnp.logspace(start, stop, int(num), base=base, dtype=to_jax_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype="float32", name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=to_jax_dtype(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(a, offset=offset)
+
+    return dispatch.apply(fn, x, op_name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.diagflat(a, k=offset), x, op_name="diagflat")
+
+
+def tril(x, diagonal=0, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.tril(a, k=diagonal), x, op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.triu(a, k=diagonal), x, op_name="triu")
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=to_jax_dtype(dtype)))
+
+
+def meshgrid(*args, name=None):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    ts = [ensure_tensor(a) for a in args]
+    outs = dispatch.apply(
+        lambda *raws: tuple(jnp.meshgrid(*raws, indexing="ij")), *ts, op_name="meshgrid"
+    )
+    return list(outs)
+
+
+def assign(x, output=None):
+    """reference ops.yaml 'assign'."""
+    if not isinstance(x, Tensor):
+        x = to_tensor(np.asarray(x))
+    out = dispatch.apply(lambda a: a + 0 if np.issubdtype(np.dtype(a.dtype), np.inexact) else a, x, op_name="assign")
+    if output is not None:
+        output._set_value(out._value)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return ensure_tensor(x).clone()
+
+
+def complex(real, imag, name=None):  # noqa: A001
+    real, imag = ensure_tensor(real), ensure_tensor(imag)
+    return dispatch.apply(jax.lax.complex, real, imag, op_name="complex")

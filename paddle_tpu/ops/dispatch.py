@@ -1,0 +1,210 @@
+"""Eager op dispatch.
+
+TPU-native replacement for the reference's generated dygraph forward functions
+(reference: paddle/fluid/eager/auto_code_generator → ``matmul_ad_func`` etc.,
+call stack SURVEY.md §3.1). Instead of a C++ kernel registry keyed by
+KernelKey, every op is a pure jax function; eager execution dispatches it
+directly (XLA executes op-by-op asynchronously), and when autograd is needed we
+capture the op's VJP via ``jax.vjp`` — the TPU-idiomatic analog of the
+reference's generated GradNode + TensorWrapper
+(paddle/fluid/eager/grad_node_info.h:50, tensor_wrapper.h:37).
+
+The same code path works under ``jit.to_static`` tracing: raw values become
+jax tracers and the recorded VJPs compose into one fused XLA program.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core import flags as _flags
+
+__all__ = [
+    "apply",
+    "apply_nondiff",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_state = _GradState()
+
+# When jit.to_static traces an imperative function, every Tensor whose value is
+# re-bound (optimizer updates, buffer mutation) is logged here so the trace can
+# functionalize the mutation (see paddle_tpu/jit/api.py).
+class _TraceState(threading.local):
+    def __init__(self):
+        self.mutation_log = None  # Optional[dict id(Tensor) -> Tensor]
+        self.read_log = None  # Optional[dict id(Tensor) -> Tensor] (scout pass)
+        self.read_epoch = 0  # only tensors with _gen < read_epoch are "state"
+
+
+_trace_state = _TraceState()
+
+
+def note_read(t):
+    """Log a direct read of a leaf tensor's value (for code that bypasses op
+    dispatch, e.g. the RNG generator or optimizer internals)."""
+    log = _trace_state.read_log
+    if log is not None and t._grad_node is None and t._gen < _trace_state.read_epoch:
+        log[id(t)] = t
+
+
+def _log_reads(inputs):
+    log = _trace_state.read_log
+    if log is None:
+        return
+    epoch = _trace_state.read_epoch
+    for t in inputs:
+        if t._grad_node is None and t._gen < epoch:
+            log[id(t)] = t
+
+
+def is_grad_enabled() -> bool:
+    return _grad_state.enabled
+
+
+def set_grad_enabled(mode: bool):
+    _grad_state.enabled = bool(mode)
+
+
+class no_grad:
+    """Context manager + decorator disabling autograd capture
+    (reference: python/paddle/framework/framework.py no_grad)."""
+
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+
+def _check_finite(name, raws):
+    level = _flags.flag("FLAGS_check_nan_inf_level")
+    for r in raws:
+        if hasattr(r, "dtype") and np.issubdtype(np.dtype(r.dtype), np.floating):
+            finite = bool(jax.numpy.isfinite(r).all())
+            if not finite:
+                msg = f"nan/inf detected in output of op '{name}'"
+                if level == 0:
+                    raise FloatingPointError(msg)
+                print(f"[paddle_tpu] WARNING: {msg}")
+
+
+def apply(raw_fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
+    """Run ``raw_fn(*raw_values, **attrs)`` over Tensor inputs.
+
+    Records a GradNode holding the op's VJP when any input requires grad.
+    Returns Tensor or tuple of Tensors mirroring raw_fn's output structure.
+    """
+    from ..tensor import Tensor  # local import to break the cycle
+    from ..autograd.engine import GradNode
+
+    # AMP O1: list-based input casting (reference eager_amp_auto_cast.h)
+    from ..amp.auto_cast import _amp_state, _maybe_cast_inputs
+
+    if _amp_state.enabled and _amp_state.level == "O1":
+        inputs = _maybe_cast_inputs(op_name, inputs)
+
+    _log_reads(inputs)
+    raws = tuple(t._value for t in inputs)
+    needs_grad = _grad_state.enabled and any(not t.stop_gradient for t in inputs)
+
+    if attrs:
+        fwd = functools.partial(raw_fn, **attrs)
+    else:
+        fwd = raw_fn
+
+    if not needs_grad:
+        out = fwd(*raws)
+        return _wrap_outputs(out, stop_gradient=True)
+
+    multi = [None]
+
+    def tuple_fn(*args):
+        o = fwd(*args)
+        if isinstance(o, tuple):
+            multi[0] = True
+            return o
+        multi[0] = False
+        return (o,)
+
+    outs_raw, vjp_fn = jax.vjp(tuple_fn, *raws)
+    node = GradNode(
+        vjp_fn=vjp_fn,
+        inputs=inputs,
+        out_avals=tuple((o.shape, o.dtype) for o in outs_raw),
+        name=op_name or getattr(raw_fn, "__name__", "op"),
+    )
+    outs = []
+    for i, o in enumerate(outs_raw):
+        sg = not np.issubdtype(np.dtype(o.dtype), np.inexact)
+        t = Tensor(o, stop_gradient=sg)
+        if not sg:
+            t._grad_node = node
+            t._output_index = i
+        node._out_tensors.append(_weakref(t))
+        outs.append(t)
+
+    if _flags.flag("FLAGS_check_nan_inf"):
+        _check_finite(node.name, outs_raw)
+
+    if multi[0]:
+        return tuple(outs)
+    return outs[0]
+
+
+def apply_nondiff(raw_fn: Callable, *inputs, **attrs):
+    """Dispatch an op that is never differentiated (comparisons, indexing…)."""
+    _log_reads(inputs)
+    raws = tuple(t._value for t in inputs)
+    out = raw_fn(*raws, **attrs) if attrs else raw_fn(*raws)
+    return _wrap_outputs(out, stop_gradient=True)
+
+
+def _wrap_outputs(out, stop_gradient: bool):
+    from ..tensor import Tensor
+
+    if isinstance(out, tuple):
+        return tuple(Tensor(o, stop_gradient=stop_gradient) for o in out)
+    return Tensor(out, stop_gradient=stop_gradient)
+
+
+import weakref  # noqa: E402
+
+
+def _weakref(t):
+    return weakref.ref(t)

@@ -1,0 +1,297 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py, e.g. matmul
+at :139 → _C_ops.matmul). matmul/einsum lower straight to MXU dot_generals;
+decompositions (qr/svd/cholesky/...) lower to XLA's linalg lowerings.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+from . import dispatch
+from ._factory import ensure_tensor
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+
+    return dispatch.apply(fn, x, y, op_name="matmul")
+
+
+def mm(input, mat2, name=None):  # noqa: A002
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return dispatch.apply(
+        lambda a, b: jnp.sum(a * b, axis=-1), x, y, op_name="dot"
+    )
+
+
+def inner(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return dispatch.apply(jnp.inner, x, y, op_name="inner")
+
+
+def outer(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return dispatch.apply(
+        lambda a, b: jnp.outer(a.reshape(-1), b.reshape(-1)), x, y, op_name="outer"
+    )
+
+
+def t(input, name=None):  # noqa: A002
+    input = ensure_tensor(input)
+    return dispatch.apply(lambda a: a.T if a.ndim >= 2 else a, input, op_name="t")
+
+
+def kron(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return dispatch.apply(jnp.kron, x, y, op_name="kron")
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    ax = axis
+    if ax == 9:  # paddle default: first axis with dim 3
+        ax = next((i for i, d in enumerate(x._value.shape) if d == 3), -1)
+    return dispatch.apply(lambda a, b: jnp.cross(a, b, axis=ax), x, y, op_name="cross")
+
+
+def einsum(equation, *operands):
+    ts = [ensure_tensor(o) for o in operands]
+    return dispatch.apply(
+        lambda *raws: jnp.einsum(equation, *raws), *ts, op_name="einsum"
+    )
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    if p is None:
+        p = "fro" if axis is None or isinstance(axis, (list, tuple)) else 2
+
+    def fn(a):
+        if axis is None:
+            flat = a.reshape(-1)
+            if p == "fro" or p == 2:
+                return jnp.sqrt(jnp.sum(flat * flat))
+            if p == 1:
+                return jnp.sum(jnp.abs(flat))
+            if p == np.inf:
+                return jnp.max(jnp.abs(flat))
+            if p == -np.inf:
+                return jnp.min(jnp.abs(flat))
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(flat), p)), 1.0 / p)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(a * a, axis=ax, keepdims=keepdim))
+        if p == np.inf:
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == -np.inf:
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a), p), axis=ax, keepdims=keepdim), 1.0 / p
+        )
+
+    return dispatch.apply(fn, x, op_name="p_norm")
+
+
+def dist(x, y, p=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def fn(a, b):
+        d = (a - b).reshape(-1)
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype))
+        if p == np.inf:
+            return jnp.max(jnp.abs(d))
+        if p == -np.inf:
+            return jnp.min(jnp.abs(d))
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+    return dispatch.apply(fn, x, y, op_name="dist")
+
+
+def matrix_power(x, n, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.linalg.matrix_power(a, n), x, op_name="matrix_power")
+
+
+def transpose_last(x):
+    return dispatch.apply(lambda a: jnp.swapaxes(a, -1, -2), ensure_tensor(x), op_name="transpose_last")
+
+
+# -- decompositions / solvers (jnp.linalg; XLA provides TPU lowerings) --------
+def cholesky(x, upper=False, name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return dispatch.apply(fn, x, op_name="cholesky")
+
+
+def qr(x, mode="reduced", name=None):
+    x = ensure_tensor(x)
+    outs = dispatch.apply(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x, op_name="qr")
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x, op_name="svd"
+    )
+
+
+def eig(x, name=None):
+    x = ensure_tensor(x)
+    w, v = np.linalg.eig(x.numpy())
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x, op_name="eigh")
+
+
+def eigvals(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(x.numpy())))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x, op_name="eigvalsh")
+
+
+def inv(x, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(jnp.linalg.inv, x, op_name="inverse")
+
+
+inverse = inv
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), x, op_name="pinv"
+    )
+
+
+def solve(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return dispatch.apply(jnp.linalg.solve, x, y, op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return dispatch.apply(
+        lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        ),
+        x,
+        y,
+        op_name="triangular_solve",
+    )
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def fn(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+
+    return dispatch.apply(fn, x, y, op_name="cholesky_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    sol, res, rank, sv = np.linalg.lstsq(x.numpy(), y.numpy(), rcond=rcond)
+    return (
+        Tensor(jnp.asarray(sol)),
+        Tensor(jnp.asarray(res)),
+        Tensor(jnp.asarray(rank)),
+        Tensor(jnp.asarray(sv)),
+    )
+
+
+def det(x, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(jnp.linalg.det, x, op_name="determinant")
+
+
+def slogdet(x, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: tuple(jnp.linalg.slogdet(a)), x, op_name="slogdet"
+    )
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply_nondiff(
+        lambda a: jnp.linalg.matrix_rank(a, rtol=tol), x
+    )
+
+
+def cond(x, p=None, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.linalg.cond(a, p=p), x, op_name="cond")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    x = ensure_tensor(x)
+    fw = fweights.numpy() if isinstance(fweights, Tensor) else fweights
+    aw = aweights.numpy() if isinstance(aweights, Tensor) else aweights
+    return dispatch.apply(
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0, fweights=fw, aweights=aw),
+        x,
+        op_name="cov",
+    )
+
+
+def corrcoef(x, rowvar=True, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, op_name="corrcoef")
+
+
+def multi_dot(tensors, name=None):
+    ts = [ensure_tensor(t) for t in tensors]
+    return dispatch.apply(lambda *raws: jnp.linalg.multi_dot(raws), *ts, op_name="multi_dot")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    input = ensure_tensor(input)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (float(input.numpy().min()), float(input.numpy().max()))
+    h, _ = np.histogram(input.numpy(), bins=bins, range=(lo, hi))
+    return Tensor(jnp.asarray(h, dtype=jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = ensure_tensor(x)
+    w = weights._value if isinstance(weights, Tensor) else None
+    length = int(np.max(x.numpy(), initial=-1)) + 1 if x.size else 0
+    length = max(length, minlength)
+    return Tensor(jnp.bincount(x._value, weights=w, minlength=minlength, length=length))
+
+
+def matrix_transpose(x, name=None):
+    return transpose_last(x)

@@ -1,0 +1,70 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from . import dispatch
+from ._factory import cmp_op, ensure_tensor, logical_op
+
+equal = cmp_op(jnp.equal, "equal")
+not_equal = cmp_op(jnp.not_equal, "not_equal")
+greater_than = cmp_op(jnp.greater, "greater_than")
+greater_equal = cmp_op(jnp.greater_equal, "greater_equal")
+less_than = cmp_op(jnp.less, "less_than")
+less_equal = cmp_op(jnp.less_equal, "less_equal")
+
+logical_and = logical_op(jnp.logical_and, "logical_and")
+logical_or = logical_op(jnp.logical_or, "logical_or")
+logical_xor = logical_op(jnp.logical_xor, "logical_xor")
+
+
+def logical_not(x, out=None, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply_nondiff(jnp.logical_not, x)
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return dispatch.apply_nondiff(jnp.bitwise_and, ensure_tensor(x), ensure_tensor(y))
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return dispatch.apply_nondiff(jnp.bitwise_or, ensure_tensor(x), ensure_tensor(y))
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return dispatch.apply_nondiff(jnp.bitwise_xor, ensure_tensor(x), ensure_tensor(y))
+
+
+def bitwise_not(x, out=None, name=None):
+    return dispatch.apply_nondiff(jnp.bitwise_not, ensure_tensor(x))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return dispatch.apply_nondiff(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y
+    )
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return dispatch.apply_nondiff(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), x, y
+    )
+
+
+def equal_all(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return dispatch.apply_nondiff(
+        lambda a, b: jnp.array_equal(a, b), x, y
+    )
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(x.size == 0))

@@ -1,0 +1,407 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import to_jax_dtype
+from ..core.enforce import InvalidArgumentError, enforce
+from ..tensor import Tensor
+from . import dispatch
+from ._factory import ensure_tensor
+
+
+def _resolve_shape(shape, cur_shape):
+    """Paddle reshape semantics: -1 infers, 0 copies the input dim."""
+    shape = [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            enforce(i < len(cur_shape), f"reshape dim {i} is 0 but input has rank {len(cur_shape)}")
+            out.append(cur_shape[i])
+        else:
+            out.append(s)
+    return out
+
+
+def reshape(x, shape, name=None):
+    x = ensure_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = [int(s) for s in shape.numpy()]
+    tgt = _resolve_shape(list(shape), x._value.shape)
+    return dispatch.apply(lambda a: a.reshape(tgt), x, op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._set_value(out._value)
+    x._grad_node = out._grad_node
+    x._output_index = out._output_index
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    sa = start_axis % nd if nd else 0
+    ea = stop_axis % nd if nd else 0
+    shp = x._value.shape
+    tgt = list(shp[:sa]) + [int(np.prod(shp[sa : ea + 1])) if ea >= sa else 1] + list(shp[ea + 1 :])
+    return dispatch.apply(lambda a: a.reshape(tgt), x, op_name="flatten")
+
+
+def squeeze(x, axis=None, name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(ax % a.ndim for ax in axes if a.shape[ax % a.ndim] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+
+    return dispatch.apply(fn, x, op_name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    x = ensure_tensor(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a._value) if isinstance(a, Tensor) else int(a) for a in axes]
+    return dispatch.apply(lambda a: jnp.expand_dims(a, tuple(axes)), x, op_name="unsqueeze")
+
+
+def transpose(x, perm, name=None):
+    x = ensure_tensor(x)
+    perm = [int(p) for p in perm]
+    return dispatch.apply(lambda a: jnp.transpose(a, perm), x, op_name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.moveaxis(a, source, destination), x, op_name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.swapaxes(a, axis0, axis1), x, op_name="swapaxes")
+
+
+def concat(x, axis=0, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis._value)
+    return dispatch.apply(lambda *raws: jnp.concatenate(raws, axis=axis), *ts, op_name="concat")
+
+
+def stack(x, axis=0, name=None):
+    ts = [ensure_tensor(t) for t in x]
+    return dispatch.apply(lambda *raws: jnp.stack(raws, axis=axis), *ts, op_name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = ensure_tensor(x)
+    n = num if num is not None else x._value.shape[axis]
+    outs = dispatch.apply(
+        lambda a: tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis)),
+        x,
+        op_name="unstack",
+    )
+    return list(outs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = ensure_tensor(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis._value)
+    dim = x._value.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s._value) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+        n_neg = sum(1 for s in sizes if s < 0)
+        enforce(n_neg <= 1, "split accepts at most one -1 section")
+        if n_neg:
+            rem = dim - sum(s for s in sizes if s >= 0)
+            sizes = [rem if s < 0 else s for s in sizes]
+    offsets = np.cumsum([0] + sizes[:-1]).tolist()
+
+    def fn(a):
+        return tuple(
+            jax.lax.dynamic_slice_in_dim(a, off, size, axis=axis)
+            for off, size in zip(offsets, sizes)
+        )
+
+    return list(dispatch.apply(fn, x, op_name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    x = ensure_tensor(x)
+    reps = [int(r._value) if isinstance(r, Tensor) else int(r) for r in repeat_times] \
+        if not isinstance(repeat_times, int) else repeat_times
+    return dispatch.apply(lambda a: jnp.tile(a, reps), x, op_name="tile")
+
+
+def expand(x, shape, name=None):
+    x = ensure_tensor(x)
+    if isinstance(shape, Tensor):
+        shape = [int(s) for s in shape.numpy()]
+    shape = [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+    cur = list(x._value.shape)
+    # right-align; -1 keeps input dim
+    pad = len(shape) - len(cur)
+    tgt = []
+    for i, s in enumerate(shape):
+        if s == -1:
+            enforce(i >= pad, "expand: -1 in a new leading dim")
+            tgt.append(cur[i - pad])
+        else:
+            tgt.append(s)
+    return dispatch.apply(lambda a: jnp.broadcast_to(a, tgt), x, op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    tgt = y._value.shape
+    return dispatch.apply(lambda a: jnp.broadcast_to(a, tgt), x, op_name="expand_as")
+
+
+def broadcast_to(x, shape, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.broadcast_to(a, list(shape)), x, op_name="broadcast_to")
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    outs = dispatch.apply(lambda *raws: tuple(jnp.broadcast_arrays(*raws)), *ts, op_name="broadcast_tensors")
+    return list(outs)
+
+
+def flip(x, axis, name=None):
+    x = ensure_tensor(x)
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return dispatch.apply(lambda a: jnp.flip(a, tuple(axes)), x, op_name="flip")
+
+
+def roll(x, shifts, axis=None, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.roll(a, shifts, axis=axis), x, op_name="roll")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x, op_name="rot90")
+
+
+def cast(x, dtype):
+    return ensure_tensor(x).astype(dtype)
+
+
+def slice(x, axes, starts, ends):  # noqa: A001
+    """reference ops.yaml 'slice' (static-graph style)."""
+    x = ensure_tensor(x)
+
+    def _v(v):
+        return int(v._value) if isinstance(v, Tensor) else int(v)
+
+    idx = [slice_builtin(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice_builtin(_v(st), _v(en))
+    idx = tuple(idx)
+    return dispatch.apply(lambda a: a[idx], x, op_name="slice")
+
+
+import builtins as _builtins  # noqa: E402
+
+slice_builtin = _builtins.slice
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    if isinstance(axis, Tensor):
+        axis = int(axis._value)
+    return dispatch.apply(
+        lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i, axis=axis),
+        x,
+        index,
+        op_name="gather",
+    )
+
+
+def gather_nd(x, index, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def fn(a, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+
+    return dispatch.apply(fn, x, index, op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """reference ops.yaml 'scatter' — writes rows of ``updates`` at ``index``."""
+    x, index, updates = ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+
+    def fn(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        base = a.at[i].set(jnp.zeros_like(u))
+        return base.at[i].add(u)
+
+    return dispatch.apply(fn, x, index, updates, op_name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = ensure_tensor(x), ensure_tensor(index), ensure_tensor(updates)
+
+    def fn(a, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u)
+
+    return dispatch.apply(fn, x, index, updates, op_name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = ensure_tensor(index), ensure_tensor(updates)
+
+    def fn(i, u):
+        zero = jnp.zeros(list(shape), u.dtype)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return zero.at[idx].add(u)
+
+    return dispatch.apply(fn, index, updates, op_name="scatter_nd")
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign", name=None):  # noqa: A002
+    x, indices = ensure_tensor(x), ensure_tensor(indices)
+    values = ensure_tensor(values)
+
+    def fn(a, i, v):
+        v = jnp.broadcast_to(v, i.shape)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        if reduce == "add":
+            oh = jnp.zeros_like(a)
+            dims = jnp.indices(i.shape)
+            idx = list(dims)
+            idx[axis] = i
+            return a.at[tuple(idx)].add(v)
+        if reduce == "multiply" or reduce == "mul":
+            dims = jnp.indices(i.shape)
+            idx = list(dims)
+            idx[axis] = i
+            return a.at[tuple(idx)].multiply(v)
+        raise InvalidArgumentError(f"put_along_axis: unknown reduce {reduce}")
+
+    return dispatch.apply(fn, x, indices, values, op_name="put_along_axis")
+
+
+def take_along_axis(x, indices, axis, name=None):
+    x, indices = ensure_tensor(x), ensure_tensor(indices)
+    return dispatch.apply(
+        lambda a, i: jnp.take_along_axis(a, i, axis=axis), x, indices, op_name="take_along_axis"
+    )
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return dispatch.apply(lambda a, i: jnp.take(a, i, axis=axis), x, index, op_name="index_select")
+
+
+def index_sample(x, index):
+    x, index = ensure_tensor(x), ensure_tensor(index)
+    return dispatch.apply(
+        lambda a, i: jnp.take_along_axis(a, i, axis=1), x, index, op_name="index_sample"
+    )
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = ensure_tensor(x), ensure_tensor(index), ensure_tensor(value)
+
+    def fn(a, i, v):
+        a_m = jnp.moveaxis(a, axis, 0)
+        v_m = jnp.moveaxis(v, axis, 0)
+        out = a_m.at[i].add(v_m)
+        return jnp.moveaxis(out, 0, axis)
+
+    return dispatch.apply(fn, x, index, value, op_name="index_add")
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = ensure_tensor(x)
+    if isinstance(repeats, Tensor):
+        return dispatch.apply(
+            lambda a, r: jnp.repeat(a, r, axis=axis, total_repeat_length=int(repeats.numpy().sum())),
+            x,
+            repeats,
+            op_name="repeat_interleave",
+        )
+    return dispatch.apply(lambda a: jnp.repeat(a, repeats, axis=axis), x, op_name="repeat_interleave")
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis=axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    res = np.unique(
+        x.numpy(), return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis,
+    )
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    a = x.numpy()
+    if axis is None:
+        a = a.reshape(-1)
+    keep = np.concatenate([[True], a[1:] != a[:-1]]) if a.ndim == 1 else None
+    if keep is None:
+        raise NotImplementedError("unique_consecutive with axis on >1d")
+    vals = a[keep]
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.concatenate([idx, [len(a)]]))
+        outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    x = ensure_tensor(x)
+    a = np.lib.stride_tricks.as_strided(
+        x.numpy().reshape(-1)[offset:],
+        shape=shape,
+        strides=[s * x.numpy().dtype.itemsize for s in stride],
+    )
+    return Tensor(jnp.asarray(a.copy()))
+
+
+def numel(x, name=None):
+    x = ensure_tensor(x)
+    return Tensor(jnp.asarray(x.size, dtype=jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    input = ensure_tensor(input)
+    shard_size = (index_num + nshards - 1) // nshards
+
+    def fn(a):
+        lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+        inside = (a >= lo) & (a < hi)
+        return jnp.where(inside, a - lo, ignore_value)
+
+    return dispatch.apply_nondiff(fn, input)

@@ -1,0 +1,275 @@
+"""Elementwise and scalar math ops.
+
+Reference surface: python/paddle/tensor/math.py + ops.yaml elementwise
+entries. All lower to jax.numpy → StableHLO; XLA fuses chains of these into
+single VPU loops, so there is no need for the reference's handwritten
+broadcast/elementwise CUDA templates (paddle/phi/kernels/funcs/broadcast_function.h).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+from . import dispatch
+from ._factory import binary_op, ensure_tensor, unary_op
+
+# ---- binary arithmetic -------------------------------------------------------
+add = binary_op(jnp.add, "add")
+subtract = binary_op(jnp.subtract, "subtract")
+multiply = binary_op(jnp.multiply, "multiply")
+divide = binary_op(jnp.divide, "divide")
+mod = binary_op(jnp.mod, "mod")
+remainder = mod
+floor_mod = mod
+floor_divide = binary_op(jnp.floor_divide, "floor_divide")
+pow = binary_op(jnp.power, "pow")  # noqa: A001
+maximum = binary_op(jnp.maximum, "maximum")
+minimum = binary_op(jnp.minimum, "minimum")
+fmax = binary_op(jnp.fmax, "fmax")
+fmin = binary_op(jnp.fmin, "fmin")
+atan2 = binary_op(jnp.arctan2, "atan2")
+hypot = binary_op(jnp.hypot, "hypot")
+logaddexp = binary_op(jnp.logaddexp, "logaddexp")
+heaviside = binary_op(jnp.heaviside, "heaviside")
+gcd = binary_op(jnp.gcd, "gcd")
+lcm = binary_op(jnp.lcm, "lcm")
+nextafter = binary_op(jnp.nextafter, "nextafter")
+copysign = binary_op(jnp.copysign, "copysign")
+
+# ---- unary -------------------------------------------------------------------
+exp = unary_op(jnp.exp, "exp")
+expm1 = unary_op(jnp.expm1, "expm1")
+log = unary_op(jnp.log, "log")
+log2 = unary_op(jnp.log2, "log2")
+log10 = unary_op(jnp.log10, "log10")
+log1p = unary_op(jnp.log1p, "log1p")
+sqrt = unary_op(jnp.sqrt, "sqrt")
+rsqrt = unary_op(jax.lax.rsqrt, "rsqrt")
+square = unary_op(jnp.square, "square")
+abs = unary_op(jnp.abs, "abs")  # noqa: A001
+sign = unary_op(jnp.sign, "sign")
+neg = unary_op(jnp.negative, "neg")
+reciprocal = unary_op(jnp.reciprocal, "reciprocal")
+floor = unary_op(jnp.floor, "floor")
+ceil = unary_op(jnp.ceil, "ceil")
+round = unary_op(jnp.round, "round")  # noqa: A001
+trunc = unary_op(jnp.trunc, "trunc")
+frac = unary_op(lambda x: x - jnp.trunc(x), "frac")
+sin = unary_op(jnp.sin, "sin")
+cos = unary_op(jnp.cos, "cos")
+tan = unary_op(jnp.tan, "tan")
+asin = unary_op(jnp.arcsin, "asin")
+acos = unary_op(jnp.arccos, "acos")
+atan = unary_op(jnp.arctan, "atan")
+sinh = unary_op(jnp.sinh, "sinh")
+cosh = unary_op(jnp.cosh, "cosh")
+tanh = unary_op(jnp.tanh, "tanh")
+asinh = unary_op(jnp.arcsinh, "asinh")
+acosh = unary_op(jnp.arccosh, "acosh")
+atanh = unary_op(jnp.arctanh, "atanh")
+erf = unary_op(jax.scipy.special.erf, "erf")
+erfinv = unary_op(jax.scipy.special.erfinv, "erfinv")
+sigmoid = unary_op(jax.nn.sigmoid, "sigmoid")
+logit = unary_op(jax.scipy.special.logit, "logit")
+digamma = unary_op(jax.scipy.special.digamma, "digamma")
+lgamma = unary_op(jax.scipy.special.gammaln, "lgamma")
+i0 = unary_op(jax.scipy.special.i0, "i0")
+i1 = unary_op(jax.scipy.special.i1, "i1")
+angle = unary_op(jnp.angle, "angle")
+conj = unary_op(jnp.conj, "conj")
+real = unary_op(jnp.real, "real")
+imag = unary_op(jnp.imag, "imag")
+deg2rad = unary_op(jnp.deg2rad, "deg2rad")
+rad2deg = unary_op(jnp.rad2deg, "rad2deg")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """reference ops.yaml 'scale'."""
+    x = ensure_tensor(x)
+    s = scale._value if isinstance(scale, Tensor) else scale
+    if bias_after_scale:
+        fn = lambda a: a * s + bias
+    else:
+        fn = lambda a: (a + bias) * s
+    out = dispatch.apply(fn, x, op_name="scale")
+    if act == "relu":
+        from ..nn import functional as F
+
+        out = F.relu(out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return dispatch.apply(lambda a: jnp.clip(a, lo, hi), x, op_name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(weight, Tensor):
+        return dispatch.apply(lambda a, b, w: a + w * (b - a), x, y, weight, op_name="lerp")
+    return dispatch.apply(lambda a, b: a + weight * (b - a), x, y, op_name="lerp")
+
+
+def add_n(inputs, name=None):
+    """Sum of a list of tensors (reference ops.yaml 'add_n')."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    ts = [ensure_tensor(t) for t in inputs]
+
+    def fn(*raws):
+        out = raws[0]
+        for r in raws[1:]:
+            out = out + r
+        return out
+
+    return dispatch.apply(fn, *ts, op_name="add_n")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: scale_b * jnp.tanh(scale_a * a), x, op_name="stanh")
+
+
+def multiplex(inputs, index, name=None):
+    ts = [ensure_tensor(t) for t in inputs]
+    index = ensure_tensor(index)
+
+    def fn(idx, *raws):
+        stacked = jnp.stack(raws, axis=0)
+        rows = idx.reshape(-1)
+        return stacked[rows, jnp.arange(raws[0].shape[0])]
+
+    return dispatch.apply(fn, index, *ts, op_name="multiplex")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+        x,
+        op_name="nan_to_num",
+    )
+
+
+# ---- tests for nan/inf (nondiff) --------------------------------------------
+def isnan(x, name=None):
+    return dispatch.apply_nondiff(jnp.isnan, ensure_tensor(x))
+
+
+def isinf(x, name=None):
+    return dispatch.apply_nondiff(jnp.isinf, ensure_tensor(x))
+
+
+def isfinite(x, name=None):
+    return dispatch.apply_nondiff(jnp.isfinite, ensure_tensor(x))
+
+
+# ---- cumulative --------------------------------------------------------------
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    from ..core.dtype import to_jax_dtype
+
+    jd = to_jax_dtype(dtype) if dtype is not None else None
+
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=jd)
+        return jnp.cumsum(a, axis=axis, dtype=jd)
+
+    return dispatch.apply(fn, x, op_name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    from ..core.dtype import to_jax_dtype
+
+    jd = to_jax_dtype(dtype) if dtype is not None else None
+
+    def fn(a):
+        if dim is None:
+            a = a.reshape(-1)
+            return jnp.cumprod(a, dtype=jd)
+        return jnp.cumprod(a, axis=dim, dtype=jd)
+
+    return dispatch.apply(fn, x, op_name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    ax = axis if axis is not None else 0
+    xv = x if axis is not None else dispatch.apply(lambda a: a.reshape(-1), x)
+    vals = dispatch.apply(lambda a: jax.lax.cummax(a, axis=ax), xv, op_name="cummax")
+    idx = dispatch.apply_nondiff(lambda a: _running_arg(a, ax, jax.lax.cummax), xv)
+    return vals, idx
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    ax = axis if axis is not None else 0
+    xv = x if axis is not None else dispatch.apply(lambda a: a.reshape(-1), x)
+    vals = dispatch.apply(lambda a: jax.lax.cummin(a, axis=ax), xv, op_name="cummin")
+    idx = dispatch.apply_nondiff(lambda a: _running_arg(a, ax, jax.lax.cummin), xv)
+    return vals, idx
+
+
+def _running_arg(a, ax, cumfn):
+    """Index of the running extremum along ``ax``."""
+    cm = cumfn(a, axis=ax)
+    isnew = jnp.equal(a, cm)
+    idxs = jnp.arange(a.shape[ax]).reshape(
+        [-1 if i == ax % a.ndim else 1 for i in range(a.ndim)]
+    )
+    idxs = jnp.broadcast_to(idxs, a.shape)
+    return jax.lax.cummax(jnp.where(isnew, idxs, -1), axis=ax)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    x = ensure_tensor(x)
+    pre = prepend._value if isinstance(prepend, Tensor) else prepend
+    app = append._value if isinstance(append, Tensor) else append
+    return dispatch.apply(
+        lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app),
+        x,
+        op_name="diff",
+    )
+
+
+# ---- inplace variants (reference: ops with trailing underscore) --------------
+def _make_inplace(fn_name):
+    import sys
+
+    mod = sys.modules[__name__]
+
+    def inplace(x, *args, **kwargs):
+        out = getattr(mod, fn_name)(x, *args, **kwargs)
+        x._set_value(out._value)
+        x._grad_node = out._grad_node
+        x._output_index = out._output_index
+        if out._grad_node is not None:
+            x.stop_gradient = False
+        return x
+
+    inplace.__name__ = fn_name + "_"
+    return inplace
+
+
+add_ = _make_inplace("add")
+subtract_ = _make_inplace("subtract")
+multiply_ = _make_inplace("multiply")
+divide_ = _make_inplace("divide")
+scale_ = _make_inplace("scale")
+clip_ = _make_inplace("clip")
+exp_ = _make_inplace("exp")
+sqrt_ = _make_inplace("sqrt")
+rsqrt_ = _make_inplace("rsqrt")
+floor_ = _make_inplace("floor")
+ceil_ = _make_inplace("ceil")
+round_ = _make_inplace("round")
+reciprocal_ = _make_inplace("reciprocal")
+tanh_ = _make_inplace("tanh")

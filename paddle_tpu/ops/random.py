@@ -1,0 +1,201 @@
+"""Random ops + RNG state.
+
+Reference: python/paddle/tensor/random.py and the C++ Generator
+(paddle/phi/core/generator.h). TPU-native design: the global generator state
+is a *Tensor* holding a jax PRNG key — random ops split the key functionally,
+so the same code is reproducible eagerly AND functionalizes correctly under
+jit.to_static tracing (the key becomes a traced input/output instead of a
+baked-in constant). This mirrors the reference's RNGStatesTracker needs for
+parallel dropout (fleet/layers/mpu/random.py:34) — per-mesh-axis generators
+just hold distinct key Tensors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import to_jax_dtype
+from ..tensor import Tensor
+from ._factory import ensure_tensor
+from . import dispatch
+
+
+class Generator:
+    """Splittable functional RNG (analog of phi::Generator)."""
+
+    def __init__(self, seed: int = 0):
+        self._state = Tensor(jax.random.PRNGKey(seed))
+        self._seed = seed
+
+    def manual_seed(self, seed: int):
+        self._seed = seed
+        self._state._set_value(jax.random.PRNGKey(seed))
+        return self
+
+    def get_state(self):
+        return Tensor(self._state._value)
+
+    def set_state(self, state):
+        self._state._set_value(state._value if isinstance(state, Tensor) else state)
+
+    def split(self):
+        """Return a fresh subkey; advances the stored state."""
+        dispatch.note_read(self._state)
+        new, sub = jax.random.split(self._state._value)
+        self._state._set_value(new)
+        return sub
+
+    @property
+    def initial_seed(self):
+        return self._seed
+
+
+default_generator = Generator(0)
+
+
+def seed(s: int):
+    """paddle.seed analog."""
+    default_generator.manual_seed(int(s))
+    return default_generator
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state):
+    default_generator.set_state(state)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(v) for v in shape.numpy()]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def rand(shape, dtype="float32", name=None):
+    key = default_generator.split()
+    return Tensor(jax.random.uniform(key, _shape_list(shape), to_jax_dtype(dtype or "float32")))
+
+
+def randn(shape, dtype="float32", name=None):
+    key = default_generator.split()
+    return Tensor(jax.random.normal(key, _shape_list(shape), to_jax_dtype(dtype or "float32")))
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    key = default_generator.split() if seed == 0 else jax.random.PRNGKey(seed)
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return Tensor(
+        jax.random.uniform(key, _shape_list(shape), to_jax_dtype(dtype or "float32"), lo, hi)
+    )
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            np.shape(m) if not hasattr(m, "shape") else m.shape,
+            np.shape(s) if not hasattr(s, "shape") else s.shape,
+        )
+        key = default_generator.split()
+        return Tensor(jax.random.normal(key, shp) * s + m)
+    key = default_generator.split()
+    shp = _shape_list(shape if shape is not None else [1])
+    return Tensor(jax.random.normal(key, shp) * std + mean)
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype="float32", name=None):
+    key = default_generator.split() if seed == 0 else jax.random.PRNGKey(seed)
+    return Tensor(
+        jax.random.normal(key, _shape_list(shape), to_jax_dtype(dtype)) * std + mean
+    )
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = default_generator.split()
+    return Tensor(
+        jax.random.randint(key, _shape_list(shape), low, high, to_jax_dtype(dtype))
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+    if high is None:
+        low, high = 0, low
+    key = default_generator.split()
+    jd = to_jax_dtype(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jax.random.randint(key, x._value.shape, low, high, jd))
+
+
+def randperm(n, dtype="int64", name=None):
+    key = default_generator.split()
+    return Tensor(jax.random.permutation(key, n).astype(to_jax_dtype(dtype)))
+
+
+def shuffle(x, axis=0):
+    x = ensure_tensor(x)
+    key = default_generator.split()
+    perm = jax.random.permutation(key, x._value.shape[axis])
+    return dispatch.apply(lambda a: jnp.take(a, perm, axis=axis), x, op_name="shuffle")
+
+
+def bernoulli(x, name=None):
+    x = ensure_tensor(x)
+    key = default_generator.split()
+    return Tensor(
+        jax.random.bernoulli(key, x._value.astype(jnp.float32), x._value.shape).astype(
+            x._value.dtype
+        )
+    )
+
+
+def binomial(count, prob, name=None):
+    count, prob = ensure_tensor(count), ensure_tensor(prob)
+    key = default_generator.split()
+    return Tensor(
+        jax.random.binomial(key, count._value.astype(jnp.float32), prob._value).astype(jnp.int64)
+    )
+
+
+def poisson(x, name=None):
+    x = ensure_tensor(x)
+    key = default_generator.split()
+    return Tensor(jax.random.poisson(key, x._value).astype(x._value.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = ensure_tensor(x)
+    key = default_generator.split()
+    logits = jnp.log(jnp.maximum(x._value, 1e-30))
+    if x._value.ndim == 1:
+        out = jax.random.choice(
+            key, x._value.shape[0], shape=(num_samples,), replace=replacement, p=x._value / x._value.sum()
+        )
+        return Tensor(out.astype(jnp.int64))
+    keys = jax.random.split(key, x._value.shape[0])
+    rows = []
+    for i in range(x._value.shape[0]):
+        p = x._value[i] / x._value[i].sum()
+        rows.append(
+            jax.random.choice(keys[i], x._value.shape[1], shape=(num_samples,), replace=replacement, p=p)
+        )
+    return Tensor(jnp.stack(rows).astype(jnp.int64))
+
+
+def standard_normal(shape, dtype="float32", name=None):
+    return randn(shape, dtype)
+
+
+def exponential_(x, lam=1.0, name=None):
+    x = ensure_tensor(x)
+    key = default_generator.split()
+    x._set_value(jax.random.exponential(key, x._value.shape, x._value.dtype) / lam)
+    return x

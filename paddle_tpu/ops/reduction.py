@@ -1,0 +1,157 @@
+"""Reduction ops (reference: python/paddle/tensor/math.py sum/mean/... and
+stat.py). XLA maps these onto tiled VPU reductions; no handwritten
+reduce_function.h needed."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import to_jax_dtype
+from ..tensor import Tensor
+from . import dispatch
+from ._factory import ensure_tensor
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = axis.numpy()
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(jfn, name, promote_int=False):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):  # noqa: A002
+        x = ensure_tensor(x)
+        ax = _norm_axis(axis)
+        jd = to_jax_dtype(dtype) if dtype is not None else None
+
+        def fn(a):
+            kw = {}
+            if jd is not None:
+                kw["dtype"] = jd
+            elif promote_int and np.issubdtype(np.dtype(a.dtype), np.integer):
+                kw["dtype"] = jnp.int64
+            return jfn(a, axis=ax, keepdims=keepdim, **kw)
+
+        return dispatch.apply(fn, x, op_name=name)
+
+    op.__name__ = name
+    return op
+
+
+sum = _reduce(jnp.sum, "sum", promote_int=True)  # noqa: A001
+prod = _reduce(jnp.prod, "prod", promote_int=True)
+mean = _reduce(jnp.mean, "mean")
+nansum = _reduce(jnp.nansum, "nansum", promote_int=True)
+nanmean = _reduce(jnp.nanmean, "nanmean")
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return dispatch.apply(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x, op_name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return dispatch.apply(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x, op_name="min")
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return dispatch.apply_nondiff(lambda a: jnp.all(a, axis=ax, keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return dispatch.apply_nondiff(lambda a: jnp.any(a, axis=ax, keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return dispatch.apply(
+        lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+        x,
+        op_name="logsumexp",
+    )
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return dispatch.apply_nondiff(
+        lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim).astype(jnp.int64), x
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return dispatch.apply(
+        lambda a: jnp.var(a, axis=ax, ddof=ddof, keepdims=keepdim), x, op_name="var"
+    )
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    ddof = 1 if unbiased else 0
+    return dispatch.apply(
+        lambda a: jnp.std(a, axis=ax, ddof=ddof, keepdims=keepdim), x, op_name="std"
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    if mode == "avg":
+        return dispatch.apply(lambda a: jnp.median(a, axis=ax, keepdims=keepdim), x, op_name="median")
+    # mode == 'min': lower median value (+ index along a single axis)
+    def fn(a):
+        return jnp.quantile(a, 0.5, axis=ax, keepdims=keepdim, method="lower")
+
+    return dispatch.apply(fn, x, op_name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    return dispatch.apply(lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), x, op_name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return dispatch.apply(
+        lambda a: jnp.quantile(a, qv, axis=ax, keepdims=keepdim, method=interpolation),
+        x,
+        op_name="quantile",
+    )
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    ax = _norm_axis(axis)
+    qv = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    return dispatch.apply(
+        lambda a: jnp.nanquantile(a, qv, axis=ax, keepdims=keepdim), x, op_name="nanquantile"
+    )

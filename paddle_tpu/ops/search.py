@@ -1,0 +1,171 @@
+"""Search / sort / indexing ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import to_jax_dtype
+from ..tensor import Tensor
+from . import dispatch
+from ._factory import ensure_tensor
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    jd = to_jax_dtype(dtype)
+
+    def fn(a):
+        out = jnp.argmax(a if axis is not None else a.reshape(-1), axis=axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(jd)
+
+    return dispatch.apply_nondiff(fn, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = ensure_tensor(x)
+    jd = to_jax_dtype(dtype)
+
+    def fn(a):
+        out = jnp.argmin(a if axis is not None else a.reshape(-1), axis=axis)
+        if keepdim and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out.astype(jd)
+
+    return dispatch.apply_nondiff(fn, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable or descending)
+        if descending:
+            idx = jnp.flip(idx, axis=axis)
+        return idx.astype(jnp.int64)
+
+    return dispatch.apply_nondiff(fn, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        out = jnp.sort(a, axis=axis, stable=stable or descending)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+
+    return dispatch.apply(fn, x, op_name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    x = ensure_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k._value)
+    ax = axis if axis is not None else -1
+
+    def fn(a):
+        a_m = jnp.moveaxis(a, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(a_m, k)
+        else:
+            vals, idx = jax.lax.top_k(-a_m, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    vals, idx = dispatch.apply(fn, x, op_name="topk")
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis, stable=True)
+        v = jnp.take(s, k - 1, axis=axis)
+        ind = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            ind = jnp.expand_dims(ind, axis)
+        return v, ind.astype(jnp.int64)
+
+    return dispatch.apply(fn, x, op_name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = ensure_tensor(x)
+    a = x.numpy()
+    from scipy import stats as _stats  # scipy ships with jax env
+
+    m = _stats.mode(a, axis=axis, keepdims=keepdim)
+    return Tensor(jnp.asarray(m.mode)), Tensor(jnp.asarray(m.count))
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = ensure_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    x, y = ensure_tensor(x, like=None), ensure_tensor(y)
+    return dispatch.apply(
+        lambda c, a, b: jnp.where(c, a, b), condition, x, y, op_name="where"
+    )
+
+
+def nonzero(x, as_tuple=False, name=None):
+    x = ensure_tensor(x)
+    nz = np.nonzero(x.numpy())  # data-dependent shape → host computed, like
+    # the reference's nonzero which syncs to CPU for the output shape
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(v[:, None], dtype=jnp.int64)) for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1), dtype=jnp.int64))
+
+
+def masked_select(x, mask, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    out = x.numpy()[mask.numpy()]
+    return Tensor(jnp.asarray(out))
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = ensure_tensor(x), ensure_tensor(mask)
+    v = value._value if isinstance(value, Tensor) else value
+    return dispatch.apply(
+        lambda a, m: jnp.where(m, v, a), x, mask, op_name="masked_fill"
+    )
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = ensure_tensor(x)
+    value = ensure_tensor(value)
+    idx = tuple(i._value if isinstance(i, Tensor) else i for i in indices)
+
+    def fn(a, v):
+        if accumulate:
+            return a.at[idx].add(v)
+        return a.at[idx].set(v)
+
+    return dispatch.apply(fn, x, value, op_name="index_put")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    ss, v = ensure_tensor(sorted_sequence), ensure_tensor(values)
+
+    def fn(a, b):
+        side = "right" if right else "left"
+        if a.ndim == 1:
+            out = jnp.searchsorted(a, b, side=side)
+        else:
+            out = jnp.stack(
+                [jnp.searchsorted(a[i], b[i], side=side) for i in range(a.shape[0])]
+            )
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return dispatch.apply_nondiff(fn, ss, v)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)

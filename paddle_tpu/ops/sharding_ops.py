@@ -1,0 +1,45 @@
+"""Sharding annotation ops.
+
+The TPU-native replacement for the reference's per-op collective insertion
+(c_identity/c_allreduce in fleet/layers/mpu/mp_ops.py): we annotate arrays
+with NamedSharding and let XLA's SPMD partitioner insert the collectives.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..tensor import Tensor
+from ..distributed import mesh as _mesh
+from . import dispatch
+
+
+def _spec(*names):
+    return PartitionSpec(*names)
+
+
+def shard_constraint(x: Tensor, *spec_names) -> Tensor:
+    """Constrain ``x`` to PartitionSpec(*spec_names) over the global mesh.
+    Under jit this is lax.with_sharding_constraint; eagerly it's a
+    device_put (a real resharding collective on multi-device meshes)."""
+    if not _mesh.has_mesh():
+        return x
+    sh = NamedSharding(_mesh.get_mesh(), PartitionSpec(*spec_names))
+    from ..jit.api import in_tracing
+
+    if in_tracing():
+        return dispatch.apply(
+            lambda a: jax.lax.with_sharding_constraint(a, sh), x, op_name="shard_constraint"
+        )
+    return dispatch.apply(lambda a: jax.device_put(a, sh), x, op_name="shard_constraint")
+
+
+def shard_param(p: Tensor, *spec_names) -> Tensor:
+    """Commit a parameter/buffer to a sharded layout in place."""
+    if not _mesh.has_mesh():
+        return p
+    sh = NamedSharding(_mesh.get_mesh(), PartitionSpec(*spec_names))
+    p._set_value(jax.device_put(p._value, sh))
+    if hasattr(p, "__dict__"):
+        p.__dict__["_dist_spec"] = tuple(spec_names)
+    return p

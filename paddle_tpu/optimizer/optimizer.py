@@ -1,0 +1,149 @@
+"""Optimizer base (reference: python/paddle/optimizer/optimizer.py).
+
+TPU-native: parameter updates are pure jax expressions applied under
+``no_grad``; each ``step()`` rebinds param values (``_set_value``), which the
+jit tracer functionalizes — so a whole train step (fwd+bwd+update) compiles
+into one XLA program with fused optimizer kernels (the analog of the
+reference's multi_tensor/fused adam paths, phi/kernels/fused_adam_kernel.cu).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..ops import dispatch
+from ..tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer"]
+
+
+class Optimizer:
+    def __init__(
+        self,
+        learning_rate=0.001,
+        parameters=None,
+        weight_decay=None,
+        grad_clip=None,
+        name=None,
+    ):
+        if parameters is None:
+            raise ValueError("paddle_tpu optimizers require an explicit parameter list")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, float):
+            self._weight_decay = weight_decay
+        elif weight_decay is None:
+            self._weight_decay = None
+        else:  # L1Decay/L2Decay objects
+            self._weight_decay = weight_decay
+        self._accumulators: Dict[str, Dict[int, Tensor]] = {}
+        self._aux_state: Dict[int, Tensor] = {}
+        # eagerly create per-param state so jit capture sees it as
+        # pre-existing (the reference creates accumulators lazily in C++)
+        self._create_accumulators(self._parameter_list)
+
+    # -- state -------------------------------------------------------------
+    def _create_accumulators(self, params):
+        pass  # subclasses allocate moments here
+
+    def _add_accumulator(self, name, param, fill_value=0.0, dtype=None):
+        store = self._accumulators.setdefault(name, {})
+        if id(param) not in store:
+            store[id(param)] = Tensor(
+                jnp.full(param._value.shape, fill_value, dtype or jnp.float32)
+            )
+        return store[id(param)]
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][id(param)]
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("set_lr cannot override an LRScheduler")
+        self._learning_rate = float(value)
+
+    def _lr_value(self):
+        """lr as a Tensor read through note_read so jit captures scheduler
+        changes as a traced input rather than a baked constant."""
+        if isinstance(self._learning_rate, LRScheduler):
+            t = self._learning_rate._lr_tensor()
+            dispatch.note_read(t)
+            return t._value
+        return self.get_lr()
+
+    # -- step --------------------------------------------------------------
+    def _collect_params_grads(self):
+        pg = []
+        for p in self._parameter_list:
+            if isinstance(p, Parameter) and not p.trainable:
+                continue
+            if p.grad is None:
+                pg.append((p, None))
+            else:
+                pg.append((p, p.grad))
+        return pg
+
+    @dispatch.no_grad()
+    def step(self):
+        params_grads = [(p, g) for p, g in self._collect_params_grads() if g is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        for p, g in params_grads:
+            dispatch.note_read(p)
+            self._apply_one(p, g)
+
+    def _apply_one(self, p: Tensor, g: Tensor):
+        raise NotImplementedError
+
+    def _decayed_grad(self, p, g_raw):
+        """L2 regularization folded into the gradient (reference: regularizer
+        appended in _create_optimization_pass)."""
+        wd = self._weight_decay
+        if wd is None:
+            return g_raw
+        if isinstance(wd, float):
+            return g_raw + wd * p._value
+        coeff = getattr(wd, "_coeff", None)
+        if coeff is not None:
+            return g_raw + coeff * p._value
+        return g_raw
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for name, store in self._accumulators.items():
+            for i, p in enumerate(self._parameter_list):
+                if id(p) in store:
+                    sd[f"{name}_{i}"] = store[id(p)]
+        for k, t in self._aux_state.items():
+            sd[f"aux_{k}"] = t
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        for name, store in self._accumulators.items():
+            for i, p in enumerate(self._parameter_list):
+                key = f"{name}_{i}"
+                if id(p) in store and key in state_dict:
+                    v = state_dict[key]
+                    store[id(p)]._set_value(
+                        v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                    )
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])

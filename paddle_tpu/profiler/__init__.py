@@ -1,0 +1,145 @@
+"""Profiler (reference: python/paddle/profiler/profiler.py:340 over the C++
+host/CUPTI tracers, N36). TPU-native: delegates to the XLA/TPU profiler
+(jax.profiler) which captures host + device (TensorCore) timelines into
+TensorBoard/trace-viewer format — the direct analog of the reference's
+chrome-trace export."""
+from __future__ import annotations
+
+import os
+import time
+from contextlib import contextmanager
+from enum import Enum
+
+import jax
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1  # kept for API compat; maps to the TPU device timeline
+    TPU = 2
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    def scheduler(step):
+        s = step - skip_first
+        if s < 0:
+            return ProfilerState.CLOSED
+        period = closed + ready + record
+        if repeat and s >= period * repeat:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._log_dir = dir_name
+
+    return handler
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False,
+                 with_flops=False):
+        self._log_dir = "./profiler_log"
+        self._timer_only = timer_only
+        self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._running = False
+        self._step = 0
+        self._step_times = []
+        self._t0 = None
+
+    def start(self):
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+        if not self._timer_only:
+            os.makedirs(self._log_dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(self._log_dir)
+                self._running = True
+            except Exception:
+                self._running = False
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._running:
+            jax.profiler.stop_trace()
+            self._running = False
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._t0 is not None:
+            self._step_times.append(now - self._t0)
+        self._t0 = now
+        self._step += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+
+        arr = np.asarray(self._step_times[-10:])
+        return f"avg step {arr.mean()*1000:.2f} ms (last {len(arr)})"
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        print(self.step_info())
+
+    def export(self, path, format="json"):  # noqa: A002
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class RecordEvent:
+    """Annotated range (reference: paddle.profiler.RecordEvent over
+    platform/profiler RecordEvent) — maps to jax.profiler.TraceAnnotation."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def begin(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+@contextmanager
+def profile_annotation(name):
+    with jax.profiler.TraceAnnotation(name):
+        yield

@@ -1,0 +1,21 @@
+"""static: graph-capture compatibility surface.
+
+The reference maintains a full static-graph stack (ProgramDesc + executors,
+SURVEY.md §1-L3b). In the TPU-native design the compiled representation IS
+the jitted XLA program produced by ``jit.to_static``; this namespace keeps
+the user-facing entry points (InputSpec, save/load inference models) without
+a separate graph IR.
+"""
+from .input_spec import InputSpec  # noqa: F401
+from ..jit.save_load import load as load_inference_model_impl  # noqa: F401
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.save(layer, path, input_spec=...) — the jitted "
+        "program is the inference model"
+    )
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    return load_inference_model_impl(path_prefix)

@@ -1,0 +1,318 @@
+"""The Tensor type.
+
+TPU-native equivalent of the reference's eager Tensor
+(reference: paddle/phi/api/include/tensor.h:82 C++ ``paddle::Tensor``; python
+surface monkeypatched in python/paddle/fluid/dygraph/tensor_patch_methods.py
+and pybind paddle/fluid/pybind/eager_method.cc).
+
+A Tensor wraps a ``jax.Array`` (or, during jit tracing, a jax tracer) plus
+autograd metadata — the analog of AutogradMeta
+(paddle/fluid/eager/autograd_meta.h:61). Device memory, layout, and async
+execution are owned by XLA/PJRT — there is no user-visible stream or
+allocator, matching TPU's runtime-managed HBM model.
+
+Most op-methods (``Tensor.add`` …) are attached by ``paddle_tpu.ops`` at
+import time, mirroring the reference's math_op_patch approach.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import dtype as _dtype_mod
+from .core.dtype import DType, convert_dtype, to_jax_dtype
+from .core.place import Place, current_place
+
+__all__ = ["Tensor", "Parameter", "to_tensor"]
+
+# creation-generation counter: jit.to_static bumps this before its scout run
+# so the capture logger can tell pre-existing state (params, buffers, RNG
+# keys) apart from tensors created during the traced call.
+_GENERATION = [0]
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "grad",
+        "_grad_node",
+        "_output_index",
+        "_hooks",
+        "_next_hook_id",
+        "_gen",
+        "name",
+        "__weakref__",
+        "__dict__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad: Optional["Tensor"] = None
+        self._grad_node = None
+        self._output_index = 0
+        self._hooks = {}
+        self._next_hook_id = 0
+        self._gen = _GENERATION[0]
+        self.name = name
+
+    # -- raw value plumbing ------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    def _set_value(self, raw):
+        """Rebind the underlying array (in-place update semantics).
+
+        Under jit.to_static tracing this mutation is logged so the trace can
+        functionalize it (return the new value as a program output)."""
+        from .ops import dispatch as _dispatch
+
+        self._value = raw
+        log = _dispatch._trace_state.mutation_log
+        if log is not None:
+            log[id(self)] = self
+
+    # -- metadata ----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self) -> DType:
+        return convert_dtype(self._value.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self) -> Place:
+        devs = getattr(self._value, "devices", None)
+        if callable(devs):
+            try:
+                dev = next(iter(self._value.devices()))
+                backend = "cpu" if dev.platform == "cpu" else "tpu"
+                return Place(backend, dev.id)
+            except Exception:
+                pass
+        return current_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    def numel(self):
+        from . import ops
+
+        return ops.creation.to_tensor(self.size, dtype="int64")
+
+    # -- conversion --------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def astype(self, dtype):
+        from .ops import dispatch
+
+        jd = to_jax_dtype(dtype)
+        return dispatch.apply(lambda x: x.astype(jd), self, op_name="cast")
+
+    cast = astype
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self) -> "Tensor":
+        from .ops import dispatch
+
+        return dispatch.apply(lambda x: x + 0, self, op_name="clone")
+
+    def to(self, device=None, dtype=None, blocking=None):
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            from .core.place import set_device, current_place
+
+            place = device if isinstance(device, Place) else None
+            if place is None:
+                backend = device.split(":")[0]
+                idx = int(device.split(":")[1]) if ":" in device else 0
+                if backend in ("gpu", "xpu", "npu"):
+                    backend = "tpu"
+                place = Place(backend, idx)
+            dev = place.device
+            if dev is not None:
+                raw = jax.device_put(out._value, dev)
+                t = Tensor(raw, stop_gradient=out.stop_gradient, name=out.name)
+                t._grad_node = out._grad_node
+                t._output_index = out._output_index
+                return t
+        return out
+
+    def cpu(self):
+        return self.to("cpu")
+
+    def cuda(self, *a, **k):  # reference-API compat: accelerator == TPU here
+        return self.to("tpu")
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        from .autograd.engine import run_backward
+
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        hid = self._next_hook_id
+        self._next_hook_id += 1
+        self._hooks[hid] = hook
+
+        class _Handle:
+            def remove(_self):
+                self._hooks.pop(hid, None)
+
+        return _Handle()
+
+    @property
+    def persistable(self):
+        return isinstance(self, Parameter)
+
+    # -- indexing ----------------------------------------------------------
+    def __getitem__(self, idx):
+        from .ops import dispatch
+
+        idx = _unwrap_index(idx)
+        return dispatch.apply(lambda x: x[idx], self, op_name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        v = value._value if isinstance(value, Tensor) else value
+        self._set_value(self._value.at[idx].set(v))
+
+    def __len__(self):
+        if not self._value.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        try:
+            data = np.asarray(self._value)
+            body = np.array2string(data, precision=6, separator=", ")
+        except Exception:
+            body = f"<traced {self._value}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"stop_gradient={sg},\n       {body})"
+        )
+
+    def __bool__(self):
+        return bool(self.numpy())
+
+    def __int__(self):
+        return int(self.numpy())
+
+    def __float__(self):
+        return float(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __format__(self, spec):
+        if self.size == 1:
+            return format(self.item(), spec)
+        return repr(self)
+
+    # operator overloads are installed by paddle_tpu.ops (math_op_patch analog)
+
+
+class Parameter(Tensor):
+    """A trainable Tensor owned by a Layer (reference:
+    python/paddle/fluid/framework.py Parameter / EagerParamBase)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+
+    def __init__(self, value, name=None, trainable=True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    return idx
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor analog (reference: python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        raw = data._value
+        if dtype is not None:
+            raw = raw.astype(to_jax_dtype(dtype))
+        t = Tensor(raw, stop_gradient=stop_gradient)
+        return t
+    if dtype is None:
+        if isinstance(data, (bool, np.bool_)):
+            jd = np.bool_
+        elif isinstance(data, (int, np.integer)):
+            jd = np.int64
+        elif isinstance(data, (float, np.floating)):
+            jd = np.float32
+        elif isinstance(data, np.ndarray):
+            jd = data.dtype  # numpy arrays keep their dtype, like the reference
+        elif isinstance(data, (list, tuple)):
+            # python literals: default float dtype is float32 (reference
+            # paddle.get_default_dtype()); ints stay int64, bools bool
+            arr = np.asarray(data)
+            jd = np.float32 if arr.dtype == np.float64 else arr.dtype
+            data = arr
+        else:
+            jd = None
+        raw = jnp.asarray(data, dtype=jd)
+    else:
+        raw = jnp.asarray(data, dtype=to_jax_dtype(dtype))
+    if place is not None:
+        dev = place.device if isinstance(place, Place) else None
+        if dev is not None:
+            raw = jax.device_put(raw, dev)
+    return Tensor(raw, stop_gradient=stop_gradient)

@@ -1,0 +1,7 @@
+__version__ = "0.1.0"
+full_version = __version__
+major, minor, patch = (int(v) for v in __version__.split("."))
+
+
+def show():
+    print(f"paddle_tpu {__version__} (tpu-native, xla/pallas backend)")

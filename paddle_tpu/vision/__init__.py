@@ -1,0 +1,4 @@
+"""vision namespace (reference: python/paddle/vision/)."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401

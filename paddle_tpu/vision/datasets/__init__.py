@@ -1,0 +1,51 @@
+"""vision.datasets (reference: python/paddle/vision/datasets/) — synthetic
+fallbacks since this environment has no dataset downloads."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data (stand-in for
+    Cifar10/MNIST downloads, which require network access)."""
+
+    def __init__(self, num_samples=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.RandomState(seed)
+        self._images = self._rng.rand(min(num_samples, 64), *self.image_shape).astype(np.float32)
+        self._labels = self._rng.randint(0, num_classes, size=num_samples).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self._images[idx % self._images.shape[0]]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self._labels[idx]
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(FakeData):
+    def __init__(self, mode="train", transform=None, download=False, backend=None):
+        super().__init__(
+            num_samples=60000 if mode == "train" else 10000,
+            image_shape=(1, 28, 28),
+            num_classes=10,
+            transform=transform,
+        )
+
+
+class Cifar10(FakeData):
+    def __init__(self, mode="train", transform=None, download=False, backend=None):
+        super().__init__(
+            num_samples=50000 if mode == "train" else 10000,
+            image_shape=(3, 32, 32),
+            num_classes=10,
+            transform=transform,
+        )

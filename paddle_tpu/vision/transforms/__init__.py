@@ -1,0 +1,110 @@
+"""vision.transforms (reference: python/paddle/vision/transforms/) —
+numpy-based host preprocessing."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor:
+    """HWC uint8 → CHW float32 in [0,1] (numpy; Tensor conversion happens at
+    collate)."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if a.dtype == np.uint8:
+            a = a.astype(np.float32) / 255.0
+        if a.ndim == 2:
+            a = a[..., None]
+        if self.data_format == "CHW":
+            a = np.transpose(a, (2, 0, 1))
+        return a.astype(np.float32)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        a = np.asarray(img, dtype=np.float32)
+        if self.data_format == "CHW":
+            m = self.mean.reshape(-1, 1, 1)
+            s = self.std.reshape(-1, 1, 1)
+        else:
+            m, s = self.mean, self.std
+        return (a - m) / s
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        try:
+            from PIL import Image
+
+            mode_in = Image.fromarray(a if a.dtype == np.uint8 else a.astype(np.uint8))
+            return np.asarray(mode_in.resize((self.size[1], self.size[0])))
+        except ImportError:
+            # nearest-neighbor fallback
+            h, w = a.shape[:2]
+            ys = (np.arange(self.size[0]) * h // self.size[0]).clip(0, h - 1)
+            xs = (np.arange(self.size[1]) * w // self.size[1]).clip(0, w - 1)
+            return a[ys][:, xs]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[:, ::-1].copy()
+        return img
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.padding = padding
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if self.padding:
+            pads = [(self.padding, self.padding), (self.padding, self.padding)] + [
+                (0, 0)
+            ] * (a.ndim - 2)
+            a = np.pad(a, pads, mode="constant")
+        h, w = a.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return a[i : i + th, j : j + tw]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        h, w = a.shape[:2]
+        th, tw = self.size
+        i = (h - th) // 2
+        j = (w - tw) // 2
+        return a[i : i + th, j : j + tw]

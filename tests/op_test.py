@@ -1,0 +1,82 @@
+"""OpTest analog (reference: test/legacy_test/eager_op_test.py:377 —
+check_output against numpy references across execution modes; check_grad
+analytic vs numeric)."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu
+from paddle_tpu import Tensor
+
+
+def check_output(op_fn, numpy_fn, inputs, rtol=1e-5, atol=1e-6, modes=("eager", "static"), **op_kwargs):
+    """Run op_fn over Tensor inputs in eager + to_static modes; compare with
+    numpy_fn over raw arrays."""
+    np_inputs = [np.asarray(i) for i in inputs]
+    expect = numpy_fn(*np_inputs)
+    results = {}
+    if "eager" in modes:
+        ts = [paddle_tpu.to_tensor(i) for i in np_inputs]
+        results["eager"] = op_fn(*ts, **op_kwargs)
+    if "static" in modes:
+        ts = [paddle_tpu.to_tensor(i) for i in np_inputs]
+        static_fn = paddle_tpu.jit.to_static(lambda *a: op_fn(*a, **op_kwargs))
+        static_fn(*ts)  # warmup
+        static_fn(*ts)  # scout+compile
+        results["static"] = static_fn(*ts)  # compiled
+    for mode, out in results.items():
+        if isinstance(out, (tuple, list)):
+            outs = out
+            expects = expect if isinstance(expect, (tuple, list)) else [expect]
+        else:
+            outs = [out]
+            expects = [expect]
+        for o, e in zip(outs, expects):
+            np.testing.assert_allclose(
+                o.numpy().astype(np.float64) if np.issubdtype(np.asarray(e).dtype, np.floating) else o.numpy(),
+                np.asarray(e),
+                rtol=rtol,
+                atol=atol,
+                err_msg=f"mode={mode}",
+            )
+
+
+def check_grad(op_fn, inputs, output_grad=None, rtol=1e-3, atol=1e-4, eps=1e-3, **op_kwargs):
+    """Numeric-vs-analytic gradient check (reference check_grad:2323)."""
+    np_inputs = [np.asarray(i, dtype=np.float64) for i in inputs]
+
+    def f(*arrays):
+        ts = [paddle_tpu.to_tensor(a.astype(np.float64), stop_gradient=False) for a in arrays]
+        out = op_fn(*ts, **op_kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return out
+
+    # analytic
+    ts = [paddle_tpu.to_tensor(a, stop_gradient=False) for a in np_inputs]
+    out = op_fn(*ts, **op_kwargs)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    og = (
+        np.ones(out.shape, np.float64)
+        if output_grad is None
+        else np.asarray(output_grad, np.float64)
+    )
+    out.backward(paddle_tpu.to_tensor(og))
+    analytic = [t.grad.numpy() if t.grad is not None else np.zeros_like(a) for t, a in zip(ts, np_inputs)]
+
+    # numeric central difference
+    for idx, base in enumerate(np_inputs):
+        num = np.zeros_like(base)
+        flat = base.reshape(-1)
+        nf = num.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            hi = float((f(*np_inputs).numpy() * og).sum())
+            flat[i] = orig - eps
+            lo = float((f(*np_inputs).numpy() * og).sum())
+            flat[i] = orig
+            nf[i] = (hi - lo) / (2 * eps)
+        np.testing.assert_allclose(analytic[idx], num, rtol=rtol, atol=atol,
+                                   err_msg=f"grad wrt input {idx}")

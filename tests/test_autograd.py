@@ -1,0 +1,137 @@
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu import Tensor
+
+
+def test_simple_backward():
+    x = paddle_tpu.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_chain_backward():
+    x = paddle_tpu.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = paddle_tpu.exp(x)
+    z = (y * 2).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 2 * np.exp([1.0, 2.0]), rtol=1e-5)
+
+
+def test_branching_accumulation():
+    x = paddle_tpu.to_tensor([3.0], stop_gradient=False)
+    a = x * 2
+    b = x * 3
+    (a + b).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_matmul_grad():
+    a = paddle_tpu.to_tensor(np.random.rand(3, 4).astype(np.float32), stop_gradient=False)
+    b = paddle_tpu.to_tensor(np.random.rand(4, 5).astype(np.float32), stop_gradient=False)
+    out = paddle_tpu.matmul(a, b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.numpy(), np.ones((3, 5)) @ b.numpy().T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.numpy(), a.numpy().T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_grad_accumulate_across_backward():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_no_grad():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    with paddle_tpu.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._grad_node is None
+
+
+def test_stop_gradient_blocks():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    z = y * 3
+    assert z.stop_gradient
+
+
+def test_retain_graph():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])
+
+
+def test_double_backward_without_retain_raises():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_paddle_grad_api():
+    x = paddle_tpu.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle_tpu.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [12.0], rtol=1e-6)
+    assert x.grad is None  # grad() must not pollute .grad
+
+
+def test_hooks():
+    x = paddle_tpu.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle_tpu.to_tensor(np.array([[3.0, 1.0], [2.0, 4.0]], np.float32), stop_gradient=False)
+    vals, idx = paddle_tpu.topk(x, k=1, axis=1)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 0], [0, 1]])
+
+
+def test_autograd_backward_api():
+    x = paddle_tpu.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * x).sum()
+    paddle_tpu.autograd.backward([y])
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
+
+
+def test_pylayer():
+    class Double(paddle_tpu.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = paddle_tpu.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_broadcast_grad():
+    x = paddle_tpu.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+    b = paddle_tpu.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    (x + b).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [3, 3, 3, 3])

@@ -1,0 +1,212 @@
+import numpy as np
+import pytest
+
+import paddle_tpu
+from op_test import check_grad, check_output
+
+RNG = np.random.RandomState(7)
+
+
+@pytest.mark.parametrize(
+    "op,ref",
+    [
+        (paddle_tpu.add, np.add),
+        (paddle_tpu.subtract, np.subtract),
+        (paddle_tpu.multiply, np.multiply),
+        (paddle_tpu.divide, np.divide),
+        (paddle_tpu.maximum, np.maximum),
+        (paddle_tpu.minimum, np.minimum),
+    ],
+)
+def test_binary_elementwise(op, ref):
+    a = RNG.rand(3, 4).astype(np.float32) + 0.5
+    b = RNG.rand(3, 4).astype(np.float32) + 0.5
+    check_output(op, ref, [a, b])
+
+
+@pytest.mark.parametrize(
+    "op,ref",
+    [
+        (paddle_tpu.exp, np.exp),
+        (paddle_tpu.log, np.log),
+        (paddle_tpu.sqrt, np.sqrt),
+        (paddle_tpu.abs, np.abs),
+        (paddle_tpu.tanh, np.tanh),
+        (paddle_tpu.floor, np.floor),
+        (paddle_tpu.ceil, np.ceil),
+        (paddle_tpu.sin, np.sin),
+        (paddle_tpu.cos, np.cos),
+        (paddle_tpu.square, np.square),
+    ],
+)
+def test_unary(op, ref):
+    # fp32 transcendental kernels: 1e-4 tolerance class (reference
+    # test/white_list/op_accuracy_white_list.py)
+    a = RNG.rand(2, 5).astype(np.float32) + 0.5
+    check_output(op, ref, [a], rtol=1e-4, atol=1e-5)
+
+
+def test_broadcasting():
+    a = RNG.rand(3, 1, 4).astype(np.float32)
+    b = RNG.rand(2, 4).astype(np.float32)
+    check_output(paddle_tpu.add, np.add, [a, b])
+
+
+def test_scalar_mix():
+    a = RNG.rand(3).astype(np.float32)
+    out = paddle_tpu.add(paddle_tpu.to_tensor(a), 2.0)
+    np.testing.assert_allclose(out.numpy(), a + 2.0, rtol=1e-6)
+
+
+@pytest.mark.parametrize("keepdim", [False, True])
+@pytest.mark.parametrize("axis", [None, 0, 1, [0, 1]])
+def test_reductions(axis, keepdim):
+    a = RNG.rand(3, 4).astype(np.float32)
+    ax = tuple(axis) if isinstance(axis, list) else axis
+    check_output(
+        paddle_tpu.sum, lambda x: np.sum(x, axis=ax, keepdims=keepdim), [a],
+        axis=axis, keepdim=keepdim,
+    )
+    check_output(
+        paddle_tpu.mean, lambda x: np.mean(x, axis=ax, keepdims=keepdim), [a],
+        axis=axis, keepdim=keepdim,
+    )
+    check_output(
+        paddle_tpu.max, lambda x: np.max(x, axis=ax, keepdims=keepdim), [a],
+        axis=axis, keepdim=keepdim,
+    )
+
+
+def test_matmul_variants():
+    a = RNG.rand(3, 4).astype(np.float32)
+    b = RNG.rand(4, 5).astype(np.float32)
+    check_output(paddle_tpu.matmul, np.matmul, [a, b])
+    check_output(
+        lambda x, y: paddle_tpu.matmul(x, y, transpose_y=True),
+        lambda x, y: x @ y.T,
+        [a, RNG.rand(5, 4).astype(np.float32)],
+    )
+    # batched
+    a3 = RNG.rand(2, 3, 4).astype(np.float32)
+    b3 = RNG.rand(2, 4, 5).astype(np.float32)
+    check_output(paddle_tpu.bmm, np.matmul, [a3, b3])
+
+
+def test_manipulation():
+    a = RNG.rand(2, 3, 4).astype(np.float32)
+    check_output(paddle_tpu.reshape, lambda x: x.reshape(6, 4), [a], shape=[6, 4])
+    check_output(paddle_tpu.reshape, lambda x: x.reshape(2, 12), [a], shape=[0, -1])
+    check_output(paddle_tpu.transpose, lambda x: x.transpose(2, 0, 1), [a], perm=[2, 0, 1])
+    check_output(paddle_tpu.flatten, lambda x: x.reshape(2, 12), [a], start_axis=1)
+    check_output(paddle_tpu.squeeze, np.squeeze, [RNG.rand(1, 3, 1).astype(np.float32)])
+    check_output(paddle_tpu.unsqueeze, lambda x: x[:, None], [RNG.rand(3).astype(np.float32)], axis=1)
+    check_output(paddle_tpu.flip, lambda x: np.flip(x, 0), [a], axis=0)
+    check_output(paddle_tpu.tile, lambda x: np.tile(x, (2, 1, 1)), [a], repeat_times=[2, 1, 1])
+
+
+def test_concat_split_stack():
+    a = RNG.rand(2, 3).astype(np.float32)
+    b = RNG.rand(2, 3).astype(np.float32)
+    out = paddle_tpu.concat([paddle_tpu.to_tensor(a), paddle_tpu.to_tensor(b)], axis=0)
+    np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 0))
+    st = paddle_tpu.stack([paddle_tpu.to_tensor(a), paddle_tpu.to_tensor(b)], axis=0)
+    np.testing.assert_allclose(st.numpy(), np.stack([a, b], 0))
+    parts = paddle_tpu.split(paddle_tpu.to_tensor(a), 3, axis=1)
+    assert len(parts) == 3
+    np.testing.assert_allclose(parts[1].numpy(), a[:, 1:2])
+    parts = paddle_tpu.split(paddle_tpu.to_tensor(a), [1, -1], axis=1)
+    np.testing.assert_allclose(parts[1].numpy(), a[:, 1:])
+
+
+def test_gather_scatter():
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    idx = np.array([0, 2], dtype=np.int64)
+    check_output(
+        paddle_tpu.gather, lambda x, i: np.take(x, i, axis=0), [a, idx]
+    )
+    out = paddle_tpu.scatter(
+        paddle_tpu.to_tensor(a),
+        paddle_tpu.to_tensor(idx),
+        paddle_tpu.to_tensor(np.ones((2, 3), np.float32)),
+    )
+    exp = a.copy()
+    exp[[0, 2]] = 1.0
+    np.testing.assert_allclose(out.numpy(), exp)
+
+
+def test_index_select_where():
+    a = RNG.rand(4, 3).astype(np.float32)
+    idx = np.array([1, 3], np.int64)
+    check_output(paddle_tpu.index_select, lambda x, i: np.take(x, i, 0), [a, idx])
+    cond = a > 0.5
+    check_output(
+        lambda c, x, y: paddle_tpu.where(c, x, y),
+        np.where,
+        [cond, a, np.zeros_like(a)],
+    )
+
+
+def test_topk_sort_argmax():
+    a = RNG.rand(3, 5).astype(np.float32)
+    vals, idx = paddle_tpu.topk(paddle_tpu.to_tensor(a), k=2, axis=1)
+    exp = np.sort(a, 1)[:, ::-1][:, :2]
+    np.testing.assert_allclose(vals.numpy(), exp, rtol=1e-6)
+    check_output(paddle_tpu.sort, lambda x: np.sort(x, -1), [a])
+    check_output(paddle_tpu.argmax, lambda x: np.argmax(x, 1), [a], axis=1)
+    check_output(paddle_tpu.argsort, lambda x: np.argsort(x, -1), [a])
+
+
+def test_cumsum_clip():
+    a = RNG.rand(3, 4).astype(np.float32)
+    check_output(paddle_tpu.cumsum, lambda x: np.cumsum(x, 1), [a], axis=1)
+    check_output(paddle_tpu.cumsum, lambda x: np.cumsum(x.reshape(-1)), [a])
+    check_output(paddle_tpu.clip, lambda x: np.clip(x, 0.2, 0.8), [a], min=0.2, max=0.8)
+
+
+def test_logic_ops():
+    a = RNG.rand(5).astype(np.float32)
+    b = a.copy()
+    b[2] += 1
+    assert not bool(paddle_tpu.equal_all(paddle_tpu.to_tensor(a), paddle_tpu.to_tensor(b)))
+    assert bool(paddle_tpu.allclose(paddle_tpu.to_tensor(a), paddle_tpu.to_tensor(a)))
+    check_output(paddle_tpu.equal, np.equal, [a, b])
+
+
+def test_einsum():
+    a = RNG.rand(3, 4).astype(np.float32)
+    b = RNG.rand(4, 5).astype(np.float32)
+    out = paddle_tpu.einsum("ij,jk->ik", paddle_tpu.to_tensor(a), paddle_tpu.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_linalg():
+    a = RNG.rand(4, 4).astype(np.float32)
+    spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+    check_output(paddle_tpu.cholesky, np.linalg.cholesky, [spd], rtol=1e-4)
+    check_output(paddle_tpu.inverse, np.linalg.inv, [spd], rtol=1e-4)
+    check_output(paddle_tpu.det, np.linalg.det, [spd], rtol=1e-4)
+    n = paddle_tpu.norm(paddle_tpu.to_tensor(a))
+    np.testing.assert_allclose(float(n), np.linalg.norm(a), rtol=1e-5)
+
+
+def test_grad_checks():
+    a = RNG.rand(3, 2).astype(np.float64) + 0.5
+    b = RNG.rand(3, 2).astype(np.float64) + 0.5
+    check_grad(paddle_tpu.multiply, [a, b])
+    check_grad(paddle_tpu.exp, [a])
+    check_grad(lambda x: paddle_tpu.sum(x * x), [a])
+    check_grad(
+        paddle_tpu.matmul,
+        [RNG.rand(2, 3).astype(np.float64), RNG.rand(3, 2).astype(np.float64)],
+    )
+
+
+def test_pad():
+    import paddle_tpu.nn.functional as F
+
+    a = RNG.rand(2, 3, 4, 4).astype(np.float32)
+    out = F.pad(paddle_tpu.to_tensor(a), [1, 1, 2, 2])
+    assert out.shape == [2, 3, 8, 6]
+    np.testing.assert_allclose(
+        out.numpy(), np.pad(a, [(0, 0), (0, 0), (2, 2), (1, 1)])
+    )
