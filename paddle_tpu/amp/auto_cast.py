@@ -7,6 +7,8 @@ from contextlib import contextmanager
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import dtype as _dtype_mod
+
 from ..core.dtype import to_jax_dtype
 from ..ops import dispatch as _dispatch
 from ..tensor import Tensor
@@ -51,7 +53,7 @@ def _maybe_cast_inputs(op_name, inputs):
         return inputs
     out = []
     for t in inputs:
-        if np.issubdtype(np.dtype(t._value.dtype), np.floating) and t._value.dtype != tgt:
+        if _dtype_mod.is_float_raw(t._value.dtype) and t._value.dtype != tgt:
             out.append(t.astype(tgt))
         else:
             out.append(t)

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import dtype as _dtype_mod
+
 __all__ = ["GradNode", "run_backward", "grad"]
 
 _float0 = jax.dtypes.float0
@@ -131,7 +133,7 @@ def run_backward(
         cotangents = []
         for slot, (shape, dtype) in zip(buf, node.out_avals):
             if slot is None:
-                if np.issubdtype(np.dtype(dtype), np.inexact):
+                if _dtype_mod.is_inexact_raw(dtype):
                     slot = jnp.zeros(shape, dtype)
                 else:
                     slot = np.zeros(shape, _float0)

@@ -9,7 +9,10 @@ from __future__ import annotations
 
 from typing import Any, List
 
+import jax.numpy as jnp
 import numpy as np
+
+from ..core import dtype as _dtype_mod
 
 from ..tensor import Tensor
 
@@ -89,7 +92,7 @@ class PyLayer(metaclass=PyLayerMeta):
         wrapped = []
         for i, o in enumerate(outs):
             t = Tensor(o._value, stop_gradient=False)
-            if np.issubdtype(np.dtype(o._value.dtype), np.inexact):
+            if _dtype_mod.is_inexact_raw(o._value.dtype):
                 t._grad_node = node
                 t._output_index = i
             else:

@@ -113,6 +113,16 @@ def to_jax_dtype(dtype):
     return convert_dtype(dtype).np_dtype
 
 
+def is_float_raw(dtype) -> bool:
+    """bf16-aware floating check for raw np/jnp dtypes (np.issubdtype
+    misclassifies ml_dtypes extension types like bfloat16)."""
+    return jnp.issubdtype(dtype, jnp.floating)
+
+
+def is_inexact_raw(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.inexact)
+
+
 def is_floating_point_dtype(dtype) -> bool:
     d = convert_dtype(dtype)
     return d.name in ("float16", "bfloat16", "float32", "float64")

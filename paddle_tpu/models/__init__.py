@@ -1,0 +1,16 @@
+"""Flagship model zoo (reference: model fixtures used throughout the
+reference's test and benchmark suites — GPT at
+test/auto_parallel/get_gpt_model.py and
+test/collective/fleet/hybrid_parallel_gpt fixtures; vision models live in
+paddle_tpu.vision.models)."""
+from . import gpt  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTModel,
+    GPTForPretraining,
+    GPTPretrainingCriterion,
+    gpt_tiny,
+    gpt_small,
+    gpt_1p3b,
+    gpt_13b,
+)

@@ -1,0 +1,279 @@
+"""GPT model family — the flagship decoder-only LM.
+
+Reference fixtures: test/auto_parallel/get_gpt_model.py and the hybrid
+parallel GPT used across test/collective/fleet/* (Megatron-style TP layers
+from fleet/layers/mpu/mp_layers.py, PP partitioning from
+parallel_layers/pp_layers.py, recompute from fleet/recompute/recompute.py).
+
+TPU-native design decisions:
+- TP is expressed through the mpu layers (Column/Row/VocabParallel), which
+  annotate weights with 'mp'-axis NamedShardings; XLA's SPMD partitioner
+  inserts the all-reduces the reference hand-codes in mp_ops.py.
+- Sequence parallelism (ABSENT in the reference — SURVEY.md §2.2) is a
+  first-class option: hidden states are sharded over the sequence axis
+  ('sp') between attention blocks, and attention itself may run as ring
+  attention over the 'sp' axis (paddle_tpu.nn.functional.attention).
+- Attention keeps the whole [B, S, H] computation as large batched matmuls
+  (MXU-friendly); causal masking uses an additive mask computed inside the
+  traced program (no dynamic shapes).
+- recompute_interval enables activation rematerialization per decoder block
+  (jax.checkpoint under the hood via fleet.recompute).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .. import ops
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.modules.common import Dropout, Embedding, Linear
+from ..nn.modules.norm import LayerNorm
+from ..ops.sharding_ops import shard_constraint
+from ..distributed import mesh as _mesh
+from ..distributed.fleet.layers.mpu.mp_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..distributed.fleet.recompute import recompute
+from ..tensor import Tensor
+
+__all__ = [
+    "GPTConfig",
+    "GPTModel",
+    "GPTForPretraining",
+    "GPTPretrainingCriterion",
+    "gpt_tiny",
+    "gpt_small",
+    "gpt_1p3b",
+    "gpt_13b",
+]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: Optional[int] = None  # default 4*hidden
+    max_position_embeddings: int = 1024
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    use_tensor_parallel: bool = False   # mpu layers over the 'mp' axis
+    sequence_parallel: bool = False     # shard activations over 'sp'
+    recompute_interval: int = 0         # 0 = off; k = remat every k blocks
+    use_flash_attention: bool = False   # route SDPA through the pallas kernel
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        assert self.hidden_size % self.num_heads == 0
+        return self.hidden_size // self.num_heads
+
+
+def gpt_tiny(**kw) -> "GPTConfig":
+    return GPTConfig(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+                     max_position_embeddings=128, **kw)
+
+
+def gpt_small(**kw) -> "GPTConfig":
+    """GPT-2 small class (117M)."""
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                     max_position_embeddings=1024, **kw)
+
+
+def gpt_1p3b(**kw) -> "GPTConfig":
+    """GPT-3 1.3B (BASELINE config 2)."""
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                     max_position_embeddings=2048, **kw)
+
+
+def gpt_13b(**kw) -> "GPTConfig":
+    """GPT-3 13B (BASELINE config 3)."""
+    return GPTConfig(hidden_size=5120, num_layers=40, num_heads=40,
+                     max_position_embeddings=2048, **kw)
+
+
+def _winit(cfg: GPTConfig):
+    """N(0, initializer_range) weight attr (reference GPT fixtures)."""
+    from ..nn.initializer import Normal
+    from ..nn.param_attr import ParamAttr
+
+    return ParamAttr(initializer=Normal(0.0, cfg.initializer_range))
+
+
+def _seq_shard(x: Tensor, cfg: GPTConfig) -> Tensor:
+    """Sequence-parallel layout constraint: [B, S, H] sharded (dp, sp, -)."""
+    if cfg.sequence_parallel and _mesh.has_mesh() and _mesh.axis_size("sp") > 1:
+        return shard_constraint(x, "dp", "sp", None)
+    return x
+
+
+class GPTEmbeddings(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        wa = _winit(cfg)
+        if cfg.use_tensor_parallel:
+            self.word_embeddings = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size, weight_attr=wa)
+        else:
+            self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size, weight_attr=wa)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings, cfg.hidden_size, weight_attr=_winit(cfg))
+        self.dropout = Dropout(cfg.hidden_dropout)
+        self._cfg = cfg
+
+    def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None) -> Tensor:
+        if position_ids is None:
+            seq_len = input_ids.shape[-1]
+            position_ids = ops.arange(0, seq_len, dtype="int64")
+            position_ids = ops.expand(ops.unsqueeze(position_ids, 0), list(input_ids.shape))
+        h = self.word_embeddings(input_ids) + self.position_embeddings(position_ids)
+        h = self.dropout(h)
+        return _seq_shard(h, self._cfg)
+
+
+class GPTAttention(Layer):
+    """Causal multi-head self-attention, fused-QKV (single [H, 3H] matmul so
+    the MXU sees one large GEMM, like the reference's fused_attention op —
+    paddle/fluid/operators/fused/fused_attention_op.cu — but here fusion is
+    a layout choice + XLA, not a handwritten kernel)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self._cfg = cfg
+        h = cfg.hidden_size
+        wa = _winit(cfg)
+        if cfg.use_tensor_parallel:
+            self.qkv_proj = ColumnParallelLinear(h, 3 * h, gather_output=False, weight_attr=wa)
+            self.out_proj = RowParallelLinear(h, h, input_is_parallel=True, weight_attr=_winit(cfg))
+        else:
+            self.qkv_proj = Linear(h, 3 * h, weight_attr=wa)
+            self.out_proj = Linear(h, h, weight_attr=_winit(cfg))
+        self.dropout = Dropout(cfg.hidden_dropout)
+
+    def forward(self, x: Tensor, attn_mask: Optional[Tensor] = None) -> Tensor:
+        cfg = self._cfg
+        b, s = x.shape[0], x.shape[1]
+        nh, hd = cfg.num_heads, cfg.head_dim
+        qkv = self.qkv_proj(x)                              # [B, S, 3H]
+        qkv = ops.reshape(qkv, [b, s, 3, nh, hd])
+        q = ops.squeeze(ops.slice(qkv, [2], [0], [1]), 2)   # [B, S, nh, hd]
+        k = ops.squeeze(ops.slice(qkv, [2], [1], [2]), 2)
+        v = ops.squeeze(ops.slice(qkv, [2], [2], [3]), 2)
+        out = F.scaled_dot_product_attention(
+            q, k, v,
+            attn_mask=attn_mask,
+            dropout_p=cfg.attention_dropout,
+            is_causal=attn_mask is None,
+            training=self.training,
+        )                                                   # [B, S, nh, hd]
+        out = ops.reshape(out, [b, s, nh * hd])
+        out = self.out_proj(out)
+        return self.dropout(out)
+
+
+class GPTMLP(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        h, f = cfg.hidden_size, cfg.ffn_size
+        wa = _winit(cfg)
+        if cfg.use_tensor_parallel:
+            self.fc1 = ColumnParallelLinear(h, f, gather_output=False, weight_attr=wa)
+            self.fc2 = RowParallelLinear(f, h, input_is_parallel=True, weight_attr=_winit(cfg))
+        else:
+            self.fc1 = Linear(h, f, weight_attr=wa)
+            self.fc2 = Linear(f, h, weight_attr=_winit(cfg))
+        self.dropout = Dropout(cfg.hidden_dropout)
+
+    def forward(self, x: Tensor) -> Tensor:
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTDecoderLayer(Layer):
+    """Pre-LN decoder block (reference GPT fixtures use pre-normalization)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self._cfg = cfg
+        self.ln1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.mlp = GPTMLP(cfg)
+
+    def forward(self, x: Tensor, attn_mask: Optional[Tensor] = None) -> Tensor:
+        x = x + self.attn(self.ln1(x), attn_mask)
+        x = x + self.mlp(self.ln2(x))
+        return _seq_shard(x, self._cfg)
+
+
+class GPTModel(Layer):
+    """Decoder-only transformer body -> final LayerNorm hidden states."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = GPTEmbeddings(cfg)
+        self.layers = [GPTDecoderLayer(cfg) for _ in range(cfg.num_layers)]
+        for i, layer in enumerate(self.layers):
+            self.add_sublayer(f"layer_{i}", layer)
+        self.final_ln = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None,
+                attn_mask: Optional[Tensor] = None) -> Tensor:
+        h = self.embeddings(input_ids, position_ids)
+        k = self.config.recompute_interval
+        for i, layer in enumerate(self.layers):
+            if k and (i % k == 0) and self.training:
+                h = recompute(layer, h, attn_mask)
+            else:
+                h = layer(h, attn_mask)
+        return self.final_ln(h)
+
+
+class GPTForPretraining(Layer):
+    """LM head tied to the word embedding (reference GPT fixtures tie
+    weights; logits = h @ E^T, a vocab-sharded matmul under TP)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.gpt = GPTModel(cfg)
+        self.config = cfg
+
+    def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None,
+                attn_mask: Optional[Tensor] = None) -> Tensor:
+        h = self.gpt(input_ids, position_ids, attn_mask)
+        w = self.gpt.embeddings.word_embeddings.weight  # [V, H]
+        logits = ops.matmul(h, w, transpose_y=True)     # [B, S, V]
+        return logits
+
+
+class GPTPretrainingCriterion(Layer):
+    """Next-token cross entropy with an optional loss mask (reference
+    fixture GPTPretrainingCriterion)."""
+
+    def __init__(self, cfg: Optional[GPTConfig] = None):
+        super().__init__()
+        tp = bool(cfg and cfg.use_tensor_parallel)
+        self.loss_fn = ParallelCrossEntropy() if tp else None
+
+    def forward(self, logits: Tensor, labels: Tensor,
+                loss_mask: Optional[Tensor] = None) -> Tensor:
+        if self.loss_fn is not None:
+            losses = self.loss_fn(logits, labels)        # [B, S]
+        else:
+            losses = F.cross_entropy(logits, labels, reduction="none")
+        losses = ops.reshape(losses, [-1])
+        if loss_mask is not None:
+            mask = ops.reshape(loss_mask, [-1]).astype(losses.dtype)
+            return ops.sum(losses * mask) / ops.clip(ops.sum(mask), min=1.0)
+        return ops.mean(losses)

@@ -6,6 +6,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...core import dtype as _dtype_mod
+
 from ...ops import dispatch
 from ...ops._factory import ensure_tensor
 from .conv import _padding_for, _tuple_n
@@ -60,7 +62,7 @@ def _max_pool(x, kernel_size, stride, padding, return_mask, ceil_mode, data_form
             pad_arg = pairs
         else:
             pad_arg = _full_pad(pairs, a.ndim, off)
-        neg = jnp.finfo(a.dtype).min if np.issubdtype(np.dtype(a.dtype), np.floating) else np.iinfo(np.dtype(a.dtype)).min
+        neg = jnp.finfo(a.dtype).min if _dtype_mod.is_float_raw(a.dtype) else np.iinfo(np.dtype(a.dtype)).min
         return jax.lax.reduce_window(a, neg, jax.lax.max, dims, strides, pad_arg)
 
     out = dispatch.apply(fn, x, op_name="max_pool")
@@ -82,7 +84,7 @@ def _argmax_pool(a, dims, strides, pairs, off):
     pad_arg = "VALID" if isinstance(pairs, str) and pairs == "VALID" else (
         pairs if isinstance(pairs, str) else _full_pad(pairs, a.ndim, off)
     )
-    neg = jnp.finfo(a.dtype).min if np.issubdtype(np.dtype(a.dtype), np.floating) else np.iinfo(np.dtype(a.dtype)).min
+    neg = jnp.finfo(a.dtype).min if _dtype_mod.is_float_raw(a.dtype) else np.iinfo(np.dtype(a.dtype)).min
     vals, idx = jax.lax.reduce_window(
         (a, flat_idx),
         (jnp.asarray(neg, a.dtype), jnp.asarray(-1.0, jnp.float64)),

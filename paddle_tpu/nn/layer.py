@@ -13,6 +13,8 @@ from typing import Callable, Dict, Iterator, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import dtype as _dtype_mod
+
 from ..core.dtype import convert_dtype, to_jax_dtype
 from ..tensor import Parameter, Tensor
 
@@ -281,10 +283,10 @@ class Layer:
         if dtype is not None:
             jd = to_jax_dtype(dtype)
             for p in self.parameters():
-                if np.issubdtype(np.dtype(p._value.dtype), np.floating):
+                if _dtype_mod.is_float_raw(p._value.dtype):
                     p._set_value(p._value.astype(jd))
             for b in self.buffers():
-                if b is not None and np.issubdtype(np.dtype(b._value.dtype), np.floating):
+                if b is not None and _dtype_mod.is_float_raw(b._value.dtype):
                     b._set_value(b._value.astype(jd))
             self._dtype = convert_dtype(dtype).name
         return self

@@ -77,6 +77,7 @@ from .manipulation import (  # noqa: F401
     scatter_nd,
     scatter_nd_add,
     shard_index,
+    slice,
     split,
     squeeze,
     stack,

@@ -5,6 +5,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import dtype as _dtype_mod
+
 from ..core.dtype import to_jax_dtype
 from ..tensor import Tensor, to_tensor
 from . import dispatch
@@ -171,7 +173,7 @@ def assign(x, output=None):
     """reference ops.yaml 'assign'."""
     if not isinstance(x, Tensor):
         x = to_tensor(np.asarray(x))
-    out = dispatch.apply(lambda a: a + 0 if np.issubdtype(np.dtype(a.dtype), np.inexact) else a, x, op_name="assign")
+    out = dispatch.apply(lambda a: a + 0 if _dtype_mod.is_inexact_raw(a.dtype) else a, x, op_name="assign")
     if output is not None:
         output._set_value(out._value)
         return output

@@ -19,7 +19,10 @@ import threading
 from typing import Any, Callable, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+
+from ..core import dtype as _dtype_mod
 
 from ..core import flags as _flags
 
@@ -115,7 +118,7 @@ class enable_grad:
 def _check_finite(name, raws):
     level = _flags.flag("FLAGS_check_nan_inf_level")
     for r in raws:
-        if hasattr(r, "dtype") and np.issubdtype(np.dtype(r.dtype), np.floating):
+        if hasattr(r, "dtype") and _dtype_mod.is_float_raw(r.dtype):
             finite = bool(jax.numpy.isfinite(r).all())
             if not finite:
                 msg = f"nan/inf detected in output of op '{name}'"
@@ -171,7 +174,7 @@ def apply(raw_fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
     )
     outs = []
     for i, o in enumerate(outs_raw):
-        sg = not np.issubdtype(np.dtype(o.dtype), np.inexact)
+        sg = not _dtype_mod.is_inexact_raw(o.dtype)
         t = Tensor(o, stop_gradient=sg)
         if not sg:
             t._grad_node = node

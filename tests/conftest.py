@@ -6,6 +6,38 @@ tested without real accelerators — XLA's CPU backend with
 fake "custom device" plugin + multi-process harness.
 """
 import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _hijacked_backend() -> bool:
+    if os.environ.get("JAX_PLATFORMS", "cpu") != "cpu":
+        return True
+    # site-hooks can select a TPU backend without exporting JAX_PLATFORMS
+    return any("axon" in p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep))
+
+
+if _hijacked_backend():
+    # A TPU site-hook (e.g. an axon/PJRT plugin in PYTHONPATH) force-selects
+    # a single-chip TPU backend at interpreter start — before conftest runs.
+    # The suite needs the 8-device virtual CPU mesh, so re-exec into a clean
+    # interpreter. Mirrors the reference's fake-device test strategy.
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # keep the repo importable but drop site-hook entries
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+         if p and "axon" not in p] + [_REPO_ROOT]
+    )
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    if "pytest" in os.path.basename(sys.argv[0]) or sys.argv[0].endswith(".py"):
+        argv = [sys.executable, *sys.argv]  # script path preserves all args
+    else:
+        argv = [sys.executable, "-m", "pytest", *sys.argv[1:]]
+    os.execvpe(sys.executable, argv, env)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")

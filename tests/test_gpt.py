@@ -1,0 +1,105 @@
+"""GPT flagship model tests (reference fixtures:
+test/auto_parallel/get_gpt_model.py, hybrid-parallel GPT under
+test/collective/fleet/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import (
+    GPTForPretraining,
+    GPTPretrainingCriterion,
+    gpt_tiny,
+)
+
+
+def _batch(cfg, b=2, s=16, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = pt.to_tensor(rng.randint(0, cfg.vocab_size, (b, s)), dtype="int64")
+    labels = pt.to_tensor(rng.randint(0, cfg.vocab_size, (b, s)), dtype="int64")
+    return ids, labels
+
+
+def test_gpt_forward_shapes_and_init_loss():
+    cfg = gpt_tiny()
+    m = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    ids, labels = _batch(cfg)
+    logits = m(ids)
+    assert logits.shape == [2, 16, cfg.vocab_size]
+    loss = crit(logits, labels)
+    # untrained model ≈ uniform over vocab
+    assert abs(float(loss) - np.log(cfg.vocab_size)) < 0.5
+
+
+def test_gpt_train_step_descends():
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    m = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    ids, labels = _batch(cfg)
+    losses = []
+    for _ in range(5):
+        loss = crit(m(ids), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.1
+
+
+def test_gpt_to_static_train_step_matches_eager():
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    pt.seed(7)
+    m1 = GPTForPretraining(cfg)
+    pt.seed(7)
+    m2 = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    o1 = pt.optimizer.SGD(learning_rate=1e-2, parameters=m1.parameters())
+    o2 = pt.optimizer.SGD(learning_rate=1e-2, parameters=m2.parameters())
+    ids, labels = _batch(cfg)
+
+    def step(model, opt, ids, labels):
+        loss = crit(model(ids), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    static_step = pt.jit.to_static(lambda i, l: step(m2, o2, i, l))
+    eager_losses, static_losses = [], []
+    for _ in range(4):
+        eager_losses.append(float(step(m1, o1, ids, labels)))
+        static_losses.append(float(static_step(ids, labels)))
+    np.testing.assert_allclose(eager_losses, static_losses, rtol=2e-4, atol=2e-5)
+
+
+def test_gpt_loss_mask():
+    cfg = gpt_tiny()
+    crit = GPTPretrainingCriterion(cfg)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    ids, labels = _batch(cfg)
+    logits = m(ids)
+    mask = np.zeros((2, 16), dtype=np.float32)
+    mask[:, :8] = 1.0
+    masked = crit(logits, labels, pt.to_tensor(mask))
+    assert np.isfinite(float(masked))
+
+
+def test_gpt_recompute_matches():
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    pt.seed(11)
+    m1 = GPTForPretraining(cfg)
+    cfg2 = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0, recompute_interval=1)
+    pt.seed(11)
+    m2 = GPTForPretraining(cfg2)
+    crit = GPTPretrainingCriterion(cfg)
+    ids, labels = _batch(cfg)
+    l1 = crit(m1(ids), labels)
+    l2 = crit(m2(ids), labels)
+    l1.backward()
+    l2.backward()
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    g1 = m1.gpt.embeddings.word_embeddings.weight.grad.numpy()
+    g2 = m2.gpt.embeddings.word_embeddings.weight.grad.numpy()
+    np.testing.assert_allclose(g1, g2, rtol=1e-4, atol=1e-6)
