@@ -21,20 +21,27 @@ os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "default")
 
 
 def _peak_flops_per_chip(device_kind: str) -> float:
-    """bf16 peak FLOP/s by TPU generation (public spec sheet numbers)."""
+    """bf16 peak FLOP/s by TPU generation (public spec sheet numbers).
+
+    device_kind strings vary ('TPU v5', 'TPU v5 lite', 'TPU v5p', ...);
+    'lite' marks the e-class parts, bare v5 is v5p-class."""
+    gen = (os.environ.get("PALLAS_AXON_TPU_GEN", "") or "").lower()
     kind = (device_kind or "").lower()
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    table = {
-        "v6": 918e12,
-        "v5p": 459e12,
-        "v5e": 197e12,
-        "v4": 275e12,
-        "v3": 123e12,
-        "v2": 45e12,
-    }
-    for key, val in table.items():
-        if key in kind or key in gen:
-            return val
+    for probe in (gen, kind):
+        if not probe:
+            continue
+        if "v6" in probe:
+            return 918e12
+        if "v5e" in probe or ("v5" in probe and "lite" in probe):
+            return 197e12
+        if "v5" in probe:
+            return 459e12
+        if "v4" in probe:
+            return 275e12
+        if "v3" in probe:
+            return 123e12
+        if "v2" in probe:
+            return 45e12
     return 197e12  # conservative default (v5e class)
 
 

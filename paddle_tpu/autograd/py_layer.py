@@ -9,7 +9,6 @@ from __future__ import annotations
 
 from typing import Any, List
 
-import jax.numpy as jnp
 import numpy as np
 
 from ..core import dtype as _dtype_mod

@@ -8,6 +8,8 @@ from .gpt import (  # noqa: F401
     GPTConfig,
     GPTModel,
     GPTForPretraining,
+    GPTStackedDecoder,
+    GPTStackedForPretraining,
     GPTPretrainingCriterion,
     gpt_tiny,
     gpt_small,

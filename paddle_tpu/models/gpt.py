@@ -21,10 +21,11 @@ TPU-native design decisions:
 """
 from __future__ import annotations
 
-import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from .. import ops
@@ -41,12 +42,14 @@ from ..distributed.fleet.layers.mpu.mp_layers import (
     VocabParallelEmbedding,
 )
 from ..distributed.fleet.recompute import recompute
-from ..tensor import Tensor
+from ..tensor import Parameter, Tensor
 
 __all__ = [
     "GPTConfig",
     "GPTModel",
     "GPTForPretraining",
+    "GPTStackedDecoder",
+    "GPTStackedForPretraining",
     "GPTPretrainingCriterion",
     "gpt_tiny",
     "gpt_small",
@@ -82,27 +85,31 @@ class GPTConfig:
         return self.hidden_size // self.num_heads
 
 
+def _preset(defaults, kw):
+    return GPTConfig(**{**defaults, **kw})
+
+
 def gpt_tiny(**kw) -> "GPTConfig":
-    return GPTConfig(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
-                     max_position_embeddings=128, **kw)
+    return _preset(dict(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+                        max_position_embeddings=128), kw)
 
 
 def gpt_small(**kw) -> "GPTConfig":
     """GPT-2 small class (117M)."""
-    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
-                     max_position_embeddings=1024, **kw)
+    return _preset(dict(hidden_size=768, num_layers=12, num_heads=12,
+                        max_position_embeddings=1024), kw)
 
 
 def gpt_1p3b(**kw) -> "GPTConfig":
     """GPT-3 1.3B (BASELINE config 2)."""
-    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
-                     max_position_embeddings=2048, **kw)
+    return _preset(dict(hidden_size=2048, num_layers=24, num_heads=16,
+                        max_position_embeddings=2048), kw)
 
 
 def gpt_13b(**kw) -> "GPTConfig":
     """GPT-3 13B (BASELINE config 3)."""
-    return GPTConfig(hidden_size=5120, num_layers=40, num_heads=40,
-                     max_position_embeddings=2048, **kw)
+    return _preset(dict(hidden_size=5120, num_layers=40, num_heads=40,
+                        max_position_embeddings=2048), kw)
 
 
 def _winit(cfg: GPTConfig):
@@ -255,6 +262,190 @@ class GPTForPretraining(Layer):
         w = self.gpt.embeddings.word_embeddings.weight  # [V, H]
         logits = ops.matmul(h, w, transpose_y=True)     # [B, S, V]
         return logits
+
+
+class GPTStackedDecoder(Layer):
+    """All decoder blocks as STACKED parameters ([L, ...], homogeneous
+    blocks) executed via lax.scan — and, when the mesh has a 'pp' axis > 1,
+    as an SPMD microbatch pipeline (pp_spmd.pipeline_blocks).
+
+    This is the performance path: the block body compiles once instead of
+    L times, remat applies per block, the stacked leading dim shards over
+    'pp', and the TP dims shard over 'mp' (GSPMD propagates the Megatron
+    collectives from the parameter shardings). Reference analog:
+    PipelineLayer segmenting + 1F1B runtime + recompute, fused into one
+    XLA program.
+    """
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self._cfg = cfg
+        L, h, f = cfg.num_layers, cfg.hidden_size, cfg.ffn_size
+        if _mesh.has_mesh() and "pp" in _mesh.get_mesh().axis_names:
+            pp = _mesh.get_mesh().shape["pp"]
+            if L % pp != 0:
+                raise ValueError(
+                    f"num_layers={L} must be divisible by the pp mesh axis "
+                    f"size {pp} (uniform stage segmenting)")
+        std = cfg.initializer_range
+        # derive the init stream from the global generator so pt.seed()
+        # controls stacked-decoder init like every other layer
+        from ..ops.random import default_generator
+
+        rng = np.random.RandomState(
+            int(np.asarray(default_generator.split())[0]) % (2**31))
+
+        def mk(shape, init="normal"):
+            if init == "zeros":
+                raw = jnp.zeros(shape, jnp.float32)
+            elif init == "ones":
+                raw = jnp.ones(shape, jnp.float32)
+            else:
+                raw = jnp.asarray(rng.randn(*shape).astype(np.float32) * std)
+            return Parameter(raw, trainable=True)
+
+        self.ln1_g = mk([L, h], "ones")
+        self.ln1_b = mk([L, h], "zeros")
+        self.qkv_w = mk([L, h, 3 * h])
+        self.qkv_b = mk([L, 3 * h], "zeros")
+        self.proj_w = mk([L, h, h])
+        self.proj_b = mk([L, h], "zeros")
+        self.ln2_g = mk([L, h], "ones")
+        self.ln2_b = mk([L, h], "zeros")
+        self.fc1_w = mk([L, h, f])
+        self.fc1_b = mk([L, f], "zeros")
+        self.fc2_w = mk([L, f, h])
+        self.fc2_b = mk([L, h], "zeros")
+        self._shard_params()
+
+    _PARAM_NAMES = ("ln1_g", "ln1_b", "qkv_w", "qkv_b", "proj_w", "proj_b",
+                    "ln2_g", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b")
+
+    def _stacked(self):
+        return [getattr(self, n) for n in self._PARAM_NAMES]
+
+    def _shard_params(self):
+        """Leading (layer) dim over 'pp'; TP dims over 'mp'."""
+        if not _mesh.has_mesh():
+            return
+        mesh = _mesh.get_mesh()
+        pp = "pp" if ("pp" in mesh.axis_names and mesh.shape["pp"] > 1) else None
+        mp = "mp" if ("mp" in mesh.axis_names and mesh.shape["mp"] > 1) else None
+        from ..ops.sharding_ops import shard_param
+
+        col = {"qkv_w": (pp, None, mp), "fc1_w": (pp, None, mp),
+               "qkv_b": (pp, mp), "fc1_b": (pp, mp),
+               "proj_w": (pp, mp, None), "fc2_w": (pp, mp, None)}
+        for name in self._PARAM_NAMES:
+            p = getattr(self, name)
+            spec = col.get(name, (pp,) + (None,) * (p.ndim - 1))
+            spec = spec + (None,) * (p.ndim - len(spec))
+            shard_param(p, *spec)
+
+    def _block_fn(self):
+        cfg = self._cfg
+        nh, hd = cfg.num_heads, cfg.head_dim
+        eps = cfg.layer_norm_eps
+
+        attn_p = cfg.attention_dropout
+        hid_p = cfg.hidden_dropout
+        with_dropout = self.training and (attn_p > 0.0 or hid_p > 0.0)
+
+        def ln(x, g, b):
+            mu = x.mean(-1, keepdims=True)
+            var = ((x - mu) ** 2).mean(-1, keepdims=True)
+            return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+        def drop(x, rate, key):
+            if not with_dropout or rate <= 0.0:
+                return x
+            keep = 1.0 - rate
+            mask = jax.random.bernoulli(key, keep, x.shape)
+            return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+        def block(p, h):
+            if with_dropout:
+                *p, key = p
+                k1, k2, k3 = jax.random.split(key, 3)
+            else:
+                k1 = k2 = k3 = None
+            (l1g, l1b, qkvw, qkvb, pw, pb, l2g, l2b, f1w, f1b, f2w, f2b) = p
+            b, s, hidden = h.shape
+            x = ln(h, l1g, l1b)
+            qkv = (x @ qkvw + qkvb).reshape(b, s, 3, nh, hd)
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            scores = jnp.einsum("bqnd,bknd->bnqk", q, k) * float(1.0 / np.sqrt(hd))
+            causal = jnp.tril(jnp.ones((s, s), jnp.bool_))
+            scores = jnp.where(causal, scores, jnp.asarray(-1e9, scores.dtype))
+            att = jax.nn.softmax(scores, axis=-1)
+            att = drop(att, attn_p, k1)
+            out = jnp.einsum("bnqk,bknd->bqnd", att, v).reshape(b, s, hidden)
+            h = h + drop(out @ pw + pb, hid_p, k2)
+            y = ln(h, l2g, l2b)
+            y = jax.nn.gelu(y @ f1w + f1b, approximate=True) @ f2w + f2b
+            return h + drop(y, hid_p, k3)
+
+        return block, with_dropout
+
+    def forward(self, hidden: Tensor, n_micro: int = 1) -> Tensor:
+        """hidden: [B, S, H]. With a pp axis > 1, splits B into n_micro
+        microbatches and pipelines; else scans layers."""
+        from ..ops import dispatch
+        from ..distributed.fleet.meta_parallel import pp_spmd
+
+        cfg = self._cfg
+        block, with_dropout = self._block_fn()
+        mesh = _mesh.get_mesh() if _mesh.has_mesh() else None
+        pp = mesh.shape["pp"] if (mesh and "pp" in mesh.axis_names) else 1
+        remat = cfg.recompute_interval > 0 and self.training
+
+        stacked_in = list(self._stacked())
+        if with_dropout:
+            # one key per layer, scanned alongside the stacked params
+            from ..ops.random import default_generator
+            from ..tensor import Tensor as _T
+
+            base = default_generator.split()
+            keys = jax.random.split(base, cfg.num_layers)
+            stacked_in.append(_T(keys, stop_gradient=True))
+
+        if pp > 1:
+            lps = cfg.num_layers // pp
+
+            def raw(h, *stacked):
+                b = h.shape[0]
+                mb = b // n_micro
+                xm = h.reshape(n_micro, mb, *h.shape[1:])
+                out = pp_spmd.pipeline_blocks(
+                    block, stacked, xm, layers_per_stage=lps, remat=remat)
+                return out.reshape(b, *h.shape[1:])
+        else:
+            def raw(h, *stacked):
+                return pp_spmd.scan_blocks(block, stacked, h, remat=remat)
+
+        return dispatch.apply(raw, hidden, *stacked_in,
+                              op_name="gpt_stacked_decoder")
+
+
+class GPTStackedForPretraining(Layer):
+    """Flagship perf model: embeddings + stacked/pipelined decoder + tied
+    LM head. Single-chip it scans; on a dp×sp×mp×pp mesh it runs the full
+    hybrid-parallel SPMD program."""
+
+    def __init__(self, cfg: GPTConfig, n_micro: int = 1):
+        super().__init__()
+        self.config = cfg
+        self.n_micro = n_micro
+        self.embeddings = GPTEmbeddings(cfg)
+        self.decoder = GPTStackedDecoder(cfg)
+        self.final_ln = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None) -> Tensor:
+        h = self.embeddings(input_ids, position_ids)
+        h = self.decoder(h, n_micro=self.n_micro)
+        h = self.final_ln(h)
+        w = self.embeddings.word_embeddings.weight
+        return ops.matmul(h, w, transpose_y=True)
 
 
 class GPTPretrainingCriterion(Layer):

@@ -19,7 +19,6 @@ import threading
 from typing import Any, Callable, Optional, Sequence
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..core import dtype as _dtype_mod
