@@ -1,0 +1,66 @@
+"""auto_parallel Strategy (reference: auto_parallel/strategy.py +
+constants.py — nested config objects with an `enable` switch per pass)."""
+from __future__ import annotations
+
+
+class _Config:
+    _fields = {}
+
+    def __init__(self, **kw):
+        for k, v in {**self._fields, **kw}.items():
+            setattr(self, k, v)
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in self._fields}
+
+
+class AMPConfig(_Config):
+    _fields = {"enable": False, "dtype": "bfloat16", "level": "O1",
+               "init_loss_scaling": 32768.0, "custom_white_list": None,
+               "custom_black_list": None, "use_master_weights": True}
+
+
+class RecomputeConfig(_Config):
+    _fields = {"enable": False, "checkpoints": None, "refined_ops_patterns": None}
+
+
+class ShardingConfig(_Config):
+    _fields = {"enable": False, "stage": 1, "degree": 1,
+               "overlap_grad_comm": True}
+
+
+class GradientMergeConfig(_Config):
+    _fields = {"enable": False, "k_steps": 1, "avg": True}
+
+
+class PipelineConfig(_Config):
+    _fields = {"enable": False, "schedule_mode": "1F1B",
+               "micro_batch_size": 1, "accumulate_steps": 1}
+
+
+class MPConfig(_Config):
+    _fields = {"enable": False, "degree": 1}
+
+
+class Strategy(_Config):
+    """reference auto_parallel/strategy.py Strategy."""
+
+    _fields = {"auto_mode": "semi", "seed": None, "split_data": True}
+
+    _nested = {"amp": AMPConfig, "recompute": RecomputeConfig,
+               "sharding": ShardingConfig, "gradient_merge": GradientMergeConfig,
+               "pipeline": PipelineConfig, "mp": MPConfig}
+
+    def __init__(self, config=None):
+        config = dict(config or {})
+        nested_cfg = {k: config.pop(k) for k in list(config) if k in self._nested}
+        unknown = set(config) - set(self._fields)
+        if unknown:
+            raise ValueError(f"unknown Strategy keys: {sorted(unknown)}")
+        super().__init__(**config)
+        for name, cls in self._nested.items():
+            sub = nested_cfg.get(name, {})
+            if isinstance(sub, _Config):
+                setattr(self, name, sub)
+            else:
+                setattr(self, name, cls(**sub))

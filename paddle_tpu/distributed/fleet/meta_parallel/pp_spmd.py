@@ -65,11 +65,13 @@ def scan_blocks(block_fn: Callable, stacked: Sequence, x, *, remat: bool = False
 
 def pipeline_blocks(block_fn: Callable, stacked: Sequence, x_micro, *,
                     layers_per_stage: int, pp_axis: str = "pp",
-                    remat: bool = False):
+                    remat: bool = False, block_takes_index: bool = False):
     """Microbatch-pipelined execution of stacked blocks over the pp axis.
 
     Args:
-      block_fn: (params_tuple, h) -> h for ONE block.
+      block_fn: (params_tuple, h) -> h for ONE block; with
+        ``block_takes_index`` it is (params_tuple, h, mb_idx) -> h, letting
+        stochastic blocks (dropout) decorrelate across microbatches.
       stacked: tuple of [L, ...] arrays, L = n_stages * layers_per_stage,
         leading dim sharded over ``pp_axis``.
       x_micro: [M, mb, ...] microbatched input activations (replicated over
@@ -81,12 +83,15 @@ def pipeline_blocks(block_fn: Callable, stacked: Sequence, x_micro, *,
     mesh = _mesh.get_mesh()
     n_stages = mesh.shape[pp_axis]
     n_micro = x_micro.shape[0]
+    if not block_takes_index:
+        base = block_fn
+        block_fn = lambda p, h, idx: base(p, h)  # noqa: E731
     body = jax.checkpoint(block_fn) if remat else block_fn
 
-    def stage_fn(local_params, h):
+    def stage_fn(local_params, h, mb_idx):
         # local_params: [layers_per_stage, ...] slices owned by this stage
         def step(carry, params):
-            return body(params, carry), None
+            return body(params, carry, mb_idx), None
 
         out, _ = jax.lax.scan(step, h, local_params)
         return out
@@ -107,7 +112,7 @@ def pipeline_blocks(block_fn: Callable, stacked: Sequence, x_micro, *,
             active = (mb_idx >= 0) & (mb_idx < n_micro)
             safe_idx = jnp.clip(mb_idx, 0, n_micro - 1)
             inp = jnp.where(is_first, x_local[safe_idx], state)
-            y = stage_fn(stacked_local, inp)
+            y = stage_fn(stacked_local, inp, safe_idx)
             y = jnp.where(active, y, jnp.zeros_like(y))
             outputs = jax.lax.dynamic_update_index_in_dim(
                 outputs,
@@ -136,7 +141,7 @@ def pipeline_blocks(block_fn: Callable, stacked: Sequence, x_micro, *,
         PartitionSpec(),  # microbatches replicated over pp (dp/sp stay auto)
     )
     fn = jax.shard_map(
-        partial(spmd),
+        spmd,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=PartitionSpec(),

@@ -1,0 +1,1 @@
+"""incubate.distributed (reference: python/paddle/incubate/distributed)."""

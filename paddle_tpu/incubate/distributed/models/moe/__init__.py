@@ -1,0 +1,4 @@
+"""MoE expert-parallel models (reference:
+python/paddle/incubate/distributed/models/moe/)."""
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+from .moe_layer import ExpertFFN, MoELayer  # noqa: F401

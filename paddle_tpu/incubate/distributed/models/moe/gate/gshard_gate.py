@@ -1,0 +1,13 @@
+"""GShard top-2 gate (reference gate/gshard_gate.py): top-2 routing with
+auxiliary load-balance loss and random second-expert sampling."""
+from __future__ import annotations
+
+from .naive_gate import NaiveGate
+
+
+class GShardGate(NaiveGate):
+    def __init__(self, d_model, num_expert, world_size=1, topk=2,
+                 capacity=(1.2, 2.4), random_routing=True, group=None):
+        super().__init__(d_model, num_expert, world_size, topk=topk)
+        self.capacity = capacity
+        self.random_routing = random_routing

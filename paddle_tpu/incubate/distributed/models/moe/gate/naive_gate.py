@@ -1,0 +1,16 @@
+"""Naive top-k gate (reference gate/naive_gate.py): a linear scorer with
+no load-balancing loss."""
+from __future__ import annotations
+
+from ......nn.modules.common import Linear
+from .base_gate import BaseGate
+
+
+class NaiveGate(BaseGate):
+    def __init__(self, d_model, num_expert, world_size=1, topk=2):
+        super().__init__(num_expert, world_size)
+        self.gate = Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp):
+        return self.gate(inp)  # raw logits; MoELayer does routing

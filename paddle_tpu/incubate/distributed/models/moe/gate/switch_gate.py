@@ -1,0 +1,12 @@
+"""Switch (top-1) gate (reference gate/switch_gate.py)."""
+from __future__ import annotations
+
+from .naive_gate import NaiveGate
+
+
+class SwitchGate(NaiveGate):
+    def __init__(self, d_model, num_expert, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+        self.capacity = capacity

@@ -1,0 +1,160 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+Reference: python/paddle/incubate/distributed/models/moe/moe_layer.py:261
+(MoELayer) — dispatch via global_scatter/global_gather collective ops
+(moe_layer.py:117,138; C++ operators/collective/global_scatter_op.cu.cc).
+
+TPU-native redesign (GShard): routing is expressed as dense einsums with a
+one-hot dispatch mask; the expert dimension is sharded over the 'ep' mesh
+axis, so XLA's SPMD partitioner lowers the token->expert dispatch einsum to
+the all-to-all the reference codes by hand in global_scatter. Experts are
+STACKED ([E, ...] parameters, like pp_spmd stage stacking), so every expert
+runs as one batched matmul on the MXU rather than E small ones.
+
+Capacity semantics follow GShard: each expert takes at most
+C = ceil(topk * tokens / E * capacity_factor); overflow tokens are dropped
+(their combine weight is zero) — same behavior as the reference's capacity
+clipping in prune_gate_by_capacity.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .....distributed import mesh as _mesh
+from .....nn.layer import Layer
+from .....ops import dispatch as _dispatch
+from .....tensor import Parameter, Tensor
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["MoELayer", "ExpertFFN"]
+
+
+class ExpertFFN(Layer):
+    """Stacked expert FFNs: [E, H, F] / [E, F, H] parameters, 'ep'-sharded."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation="gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        from .....ops.random import derive_numpy_rng
+
+        rng = derive_numpy_rng()
+        std = 0.02
+
+        def mk(shape, zero=False):
+            raw = (jnp.zeros(shape, jnp.float32) if zero else
+                   jnp.asarray(rng.randn(*shape).astype(np.float32) * std))
+            return Parameter(raw)
+
+        self.w1 = mk([num_experts, d_model, d_hidden])
+        self.b1 = mk([num_experts, d_hidden], zero=True)
+        self.w2 = mk([num_experts, d_hidden, d_model])
+        self.b2 = mk([num_experts, d_model], zero=True)
+        self.activation = activation
+        self._shard()
+
+    def _shard(self):
+        if not _mesh.has_mesh():
+            return
+        mesh = _mesh.get_mesh()
+        if "ep" not in mesh.axis_names or mesh.shape["ep"] <= 1:
+            return
+        from .....ops.sharding_ops import shard_param
+
+        for p in (self.w1, self.b1, self.w2, self.b2):
+            shard_param(p, *("ep",) + (None,) * (p.ndim - 1))
+
+    def stacked(self):
+        return (self.w1, self.b1, self.w2, self.b2)
+
+
+class MoELayer(Layer):
+    """reference moe_layer.py:261 MoELayer(d_model, experts, gate, ...).
+
+    Accepts either an ExpertFFN (fast stacked path) or constructs one from
+    (num_experts, d_hidden). gate: 'naive' | 'gshard' | 'switch' or a
+    BaseGate instance.
+    """
+
+    def __init__(self, d_model, num_experts=None, experts: Optional[ExpertFFN] = None,
+                 gate="gshard", top_k=2, capacity_factor=1.25, d_hidden=None,
+                 group=None, recompute_interval=0, name=None):
+        super().__init__()
+        self.d_model = d_model
+        if experts is None:
+            assert num_experts is not None
+            experts = ExpertFFN(num_experts, d_model, d_hidden or 4 * d_model)
+        self.experts = experts
+        self.num_experts = experts.num_experts
+        if isinstance(gate, BaseGate):
+            self.gate = gate
+            self.top_k = getattr(gate, "top_k", top_k)
+        else:
+            cls = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}[gate]
+            self.top_k = 1 if gate == "switch" else top_k
+            self.gate = cls(d_model, self.num_experts, topk=self.top_k)
+        self.capacity_factor = capacity_factor
+        self.aux_loss: Optional[Tensor] = None
+
+    def forward(self, x: Tensor) -> Tensor:
+        """x: [B, S, H] (or [T, H]). Returns same shape; sets self.aux_loss."""
+        orig_shape = x.shape
+        E, K, cf = self.num_experts, self.top_k, self.capacity_factor
+        logits = self.gate(x)  # [..., E]
+
+        def route(xr, lg):
+            T = int(np.prod(lg.shape[:-1]))
+            xt = xr.reshape(T, -1)
+            lt = lg.reshape(T, E)
+            C = max(1, int(np.ceil(K * T / E * cf)))
+            probs = jax.nn.softmax(lt, axis=-1)                      # [T, E]
+
+            # top-k expert choice per token
+            topv, topi = jax.lax.top_k(probs, K)
+            # one-hot per choice: [K, T, E]
+            choice = jax.nn.one_hot(jnp.swapaxes(topi, 0, 1), E, dtype=xt.dtype)
+
+            # capacity: position of each token in its expert's queue,
+            # counted across choices in priority order (GShard)
+            flat = choice.reshape(K * T, E)
+            pos = jnp.cumsum(flat, axis=0) - flat                    # [K*T, E]
+            pos = pos.reshape(K, T, E)
+            within = pos < C
+            choice_raw = choice                                       # pre-capacity assignment
+            choice = choice * within                                  # drop overflow
+
+            gates = jnp.swapaxes(topv, 0, 1)[..., None] * choice      # [K, T, E]
+            denom = jnp.sum(gates, axis=(0, 2), keepdims=True) + 1e-9
+            gates = gates / denom                                     # renormalize
+
+            pos_idx = jnp.sum(pos * choice, axis=-1).astype(jnp.int32)  # [K, T]
+            cap_oh = jax.nn.one_hot(pos_idx, C, dtype=xt.dtype)       # [K, T, C]
+            # dispatch/combine tensors [T, E, C]
+            dispatch = jnp.einsum("kte,ktc->tec", choice, cap_oh)
+            combine = jnp.einsum("kte,ktc->tec", gates, cap_oh)
+
+            # aux load-balance loss (GShard eq.4): E * sum(mean_prob * frac),
+            # computed from the PRE-capacity assignment so the rebalance
+            # gradient keeps growing with imbalance even when experts overflow
+            me = jnp.mean(probs, axis=0)                              # [E]
+            frac = jnp.sum(choice_raw[0], axis=0) / max(T, 1)         # [E]
+            aux = E * jnp.sum(me * frac)
+
+            ex_in = jnp.einsum("tec,th->ech", dispatch, xt)           # [E, C, H]
+            return dispatch, combine, ex_in, aux
+
+        def moe_fwd(xr, lg, w1, b1, w2, b2):
+            dispatchT, combine, ex_in, aux = route(xr, lg)
+            hmid = jnp.einsum("ech,ehf->ecf", ex_in, w1) + b1[:, None, :]
+            hmid = jax.nn.gelu(hmid, approximate=True)
+            ex_out = jnp.einsum("ecf,efh->ech", hmid, w2) + b2[:, None, :]
+            yt = jnp.einsum("tec,ech->th", combine, ex_out)
+            return yt.reshape(xr.shape), aux
+
+        out, aux = _dispatch.apply(
+            moe_fwd, x, logits, *self.experts.stacked(), op_name="moe_layer")
+        self.aux_loss = aux
+        return out

@@ -290,10 +290,9 @@ class GPTStackedDecoder(Layer):
         std = cfg.initializer_range
         # derive the init stream from the global generator so pt.seed()
         # controls stacked-decoder init like every other layer
-        from ..ops.random import default_generator
+        from ..ops.random import derive_numpy_rng
 
-        rng = np.random.RandomState(
-            int(np.asarray(default_generator.split())[0]) % (2**31))
+        rng = derive_numpy_rng()
 
         def mk(shape, init="normal"):
             if init == "zeros":
@@ -412,12 +411,22 @@ class GPTStackedDecoder(Layer):
         if pp > 1:
             lps = cfg.num_layers // pp
 
+            if with_dropout:
+                # decorrelate dropout across microbatches: fold the
+                # microbatch index into the per-layer key
+                def block_mb(p, h, idx):
+                    *rest, key = p
+                    return block((*rest, jax.random.fold_in(key, idx)), h)
+            else:
+                block_mb = None
+
             def raw(h, *stacked):
                 b = h.shape[0]
                 mb = b // n_micro
                 xm = h.reshape(n_micro, mb, *h.shape[1:])
                 out = pp_spmd.pipeline_blocks(
-                    block, stacked, xm, layers_per_stage=lps, remat=remat)
+                    block_mb or block, stacked, xm, layers_per_stage=lps,
+                    remat=remat, block_takes_index=block_mb is not None)
                 return out.reshape(b, *h.shape[1:])
         else:
             def raw(h, *stacked):

@@ -1,0 +1,6 @@
+"""First-party Pallas TPU kernels + wrappers over jax's pallas op library.
+
+The reference keeps its hot ops as handwritten CUDA
+(paddle/phi/kernels/fusion/, operators/fused/); here the hot ops are
+Pallas kernels compiled through Mosaic for the TPU's MXU/VMEM.
+"""

@@ -1,0 +1,43 @@
+"""Flash attention on TPU via Pallas (reference analog:
+paddle/phi/kernels/gpu/flash_attn_kernel.cu dynloading third_party/flashattn).
+
+On TPU the memory-hierarchy-aware attention kernel is a Pallas/Mosaic
+program; jax ships a maintained implementation
+(jax.experimental.pallas.ops.tpu.flash_attention) which we use as the
+kernel body — the wrapper adapts layouts ([B,S,N,D] <-> [B,N,S,D]) and
+falls back to the XLA einsum expression on CPU (pallas interpret mode is
+too slow for tests)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def flash_attention_bshd(q, k, v, *, causal: bool = False):
+    """q/k/v: [B, S, N, D] -> [B, S, N, D]."""
+    scale = float(1.0 / (q.shape[-1] ** 0.5))
+    if _on_tpu():
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as _pallas_flash,
+        )
+
+        qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))  # [B,N,S,D]
+        out = _pallas_flash(qh, kh, vh, causal=causal, sm_scale=scale)
+        return jnp.swapaxes(out, 1, 2)
+
+    # CPU fallback: numerically identical XLA expression
+    qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    s = jnp.einsum("bnqd,bnkd->bnqk", qh, kh) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, jnp.finfo(s.dtype).min)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.swapaxes(jnp.einsum("bnqk,bnkd->bnqd", p, vh), 1, 2)

@@ -54,6 +54,13 @@ class Generator:
 default_generator = Generator(0)
 
 
+def derive_numpy_rng():
+    """A numpy RandomState seeded from the global generator stream, for
+    host-side init code (stacked parameter construction)."""
+    sub = default_generator.split()
+    return np.random.RandomState(int(np.asarray(sub)[0]) % (2**31))
+
+
 def seed(s: int):
     """paddle.seed analog."""
     default_generator.manual_seed(int(s))

@@ -1,0 +1,136 @@
+"""MoE expert-parallel, ring attention, and auto-parallel Engine tests
+(reference: incubate/distributed/models/moe tests, test/auto_parallel/
+engine_api.py; ring attention is a new TPU capability — SURVEY.md §2.2)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import mesh as M
+
+
+@pytest.fixture
+def clean_mesh():
+    prev = M._global_mesh
+    M._global_mesh = None
+    yield
+    M._global_mesh = prev
+
+
+def test_moe_forward_backward(clean_mesh):
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    pt.seed(0)
+    moe = MoELayer(d_model=32, num_experts=4, gate="gshard", top_k=2)
+    x = pt.to_tensor(np.random.RandomState(0).randn(2, 8, 32).astype(np.float32),
+                     stop_gradient=False)
+    y = moe(x)
+    assert y.shape == [2, 8, 32]
+    assert float(moe.aux_loss) > 0
+    loss = pt.mean(y * y) + moe.aux_loss * 0.01
+    loss.backward()
+    assert np.isfinite(moe.experts.w1.grad.numpy()).all()
+    assert np.isfinite(moe.gate.gate.weight.grad.numpy()).all()
+
+
+def test_moe_expert_parallel_mesh(clean_mesh):
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    M.set_mesh(M.build_mesh({"dp": 2, "ep": 4}))
+    pt.seed(0)
+    moe = MoELayer(d_model=16, num_experts=8, gate="switch")
+    x = pt.to_tensor(np.random.RandomState(1).randn(2, 8, 16).astype(np.float32),
+                     stop_gradient=False)
+    y = moe(x)
+    assert y.shape == [2, 8, 16]
+    (pt.mean(y * y) + moe.aux_loss).backward()
+    assert np.isfinite(moe.experts.w1.grad.numpy()).all()
+
+
+def test_moe_identity_when_experts_identity(clean_mesh):
+    """With top-1 routing and ample capacity every token reaches exactly one
+    expert and combine weights sum to 1."""
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    pt.seed(2)
+    moe = MoELayer(d_model=8, num_experts=2, gate="switch", capacity_factor=4.0)
+    x = pt.to_tensor(np.random.RandomState(2).randn(1, 4, 8).astype(np.float32))
+    y = moe(x)
+    assert np.isfinite(y.numpy()).all()
+
+
+def _np_causal_attention(q, k, v):
+    B, S, N, D = q.shape
+    s = np.einsum("bqnd,bknd->bnqk", q, k) / np.sqrt(D)
+    mask = np.tril(np.ones((S, S), bool))
+    s = np.where(mask[None, None], s, -1e9)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bnqk,bknd->bqnd", p, v)
+
+
+def test_ring_attention_matches_reference(clean_mesh):
+    from paddle_tpu.nn.functional.ring_attention import ring_attention
+
+    rng = np.random.RandomState(0)
+    B, S, N, D = 2, 16, 4, 8
+    q, k, v = (rng.randn(B, S, N, D).astype(np.float32) for _ in range(3))
+    ref = _np_causal_attention(q, k, v)
+
+    out0 = ring_attention(pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v))
+    np.testing.assert_allclose(out0.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    M.set_mesh(M.build_mesh({"dp": 2, "sp": 4}))
+    tq = pt.to_tensor(q, stop_gradient=False)
+    out = ring_attention(tq, pt.to_tensor(k), pt.to_tensor(v))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    pt.sum(out * out).backward()
+
+    def jref(q, k, v):
+        s = jnp.einsum("bqnd,bknd->bnqk", q, k) / np.sqrt(D)
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -1e9)
+        p = jax.nn.softmax(s, -1)
+        return jnp.sum(jnp.einsum("bnqk,bknd->bqnd", p, v) ** 2)
+
+    gq = jax.grad(jref)(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(tq.grad.numpy(), np.asarray(gq), rtol=1e-4, atol=1e-5)
+
+
+def test_engine_fit_descends(clean_mesh):
+    from paddle_tpu.distributed.auto_parallel import Engine, Strategy
+    from paddle_tpu.models import GPTPretrainingCriterion, GPTForPretraining, gpt_tiny
+
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    pt.seed(0)
+    model = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    engine = Engine(model=model, loss=crit, optimizer=opt, strategy=Strategy())
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (2, 16))
+    batches = [(ids, ids) for _ in range(6)]
+    hist = engine.fit(batches, epochs=1, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_engine_save_load(tmp_path, clean_mesh):
+    from paddle_tpu.distributed.auto_parallel import Engine
+    from paddle_tpu.models import GPTPretrainingCriterion, GPTForPretraining, gpt_tiny
+
+    cfg = gpt_tiny()
+    pt.seed(0)
+    model = GPTForPretraining(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    engine = Engine(model=model, loss=GPTPretrainingCriterion(cfg), optimizer=opt)
+    path = str(tmp_path / "ckpt")
+    engine.save(path)
+    w_before = model.gpt.embeddings.word_embeddings.weight.numpy().copy()
+    model.gpt.embeddings.word_embeddings.weight._set_value(
+        jnp.zeros_like(model.gpt.embeddings.word_embeddings.weight.value))
+    engine.load(path)
+    np.testing.assert_allclose(
+        model.gpt.embeddings.word_embeddings.weight.numpy(), w_before)
