@@ -1,0 +1,4 @@
+"""Native (C++) runtime components, built on demand with g++ and bound via
+ctypes (the reference's native layer uses pybind11 — paddle/fluid/pybind;
+here the ABI surface is small C functions so ctypes suffices)."""
+from .build import load_native  # noqa: F401

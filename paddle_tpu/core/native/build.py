@@ -1,0 +1,46 @@
+"""On-demand native build: compile <name>.cpp into a cached shared object
+and load it with ctypes. Analog of the reference's CMake native build,
+scaled to this repo's small C-ABI surface."""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_cache: dict = {}
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def load_native(name: str):
+    """Compile (if needed) and dlopen paddle_tpu/core/native/<name>.cpp.
+    Returns a ctypes.CDLL, or None when no C++ toolchain is available."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_DIR, f"{name}.cpp")
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        cache_dir = os.environ.get(
+            "PADDLE_TPU_NATIVE_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu", "native"))
+        os.makedirs(cache_dir, exist_ok=True)
+        so_path = os.path.join(cache_dir, f"{name}-{digest}.so")
+        if not os.path.exists(so_path):
+            cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+                   src, "-o", so_path + ".tmp"]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+                os.replace(so_path + ".tmp", so_path)
+            except (OSError, subprocess.SubprocessError):
+                _cache[name] = None
+                return None
+        try:
+            lib = ctypes.CDLL(so_path)
+        except OSError:
+            lib = None
+        _cache[name] = lib
+        return lib

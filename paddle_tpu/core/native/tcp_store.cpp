@@ -1,0 +1,254 @@
+// TCPStore: socket key-value store for multi-host rendezvous.
+//
+// Native C++ equivalent of the reference's TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h:120, tcp_utils.cc) — the
+// bootstrap KV used to exchange coordinator addresses before the XLA
+// distributed runtime comes up. Exposed through a C ABI consumed by
+// ctypes (paddle_tpu/core/native/tcp_store.py); no pybind dependency.
+//
+// Protocol (little-endian):
+//   request : u8 op | u32 klen | key bytes | u32 vlen | value bytes
+//   response: u32 vlen | value bytes   (vlen == 0xFFFFFFFF => not found)
+// Ops: 0=SET 1=GET(blocking-wait) 2=ADD(returns new i64) 3=CHECK 4=DELETE
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::vector<uint8_t>> data;
+  std::atomic<bool> running{true};
+  int listen_fd = -1;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void serve_client(Store* st, int fd) {
+  for (;;) {
+    uint8_t op;
+    if (!read_full(fd, &op, 1)) break;
+    uint32_t klen;
+    if (!read_full(fd, &klen, 4) || klen > (1u << 20)) break;
+    std::string key(klen, '\0');
+    if (!read_full(fd, key.data(), klen)) break;
+    uint32_t vlen;
+    if (!read_full(fd, &vlen, 4) || (vlen != 0 && vlen > (1u << 28))) break;
+    std::vector<uint8_t> val(vlen);
+    if (vlen && !read_full(fd, val.data(), vlen)) break;
+
+    std::vector<uint8_t> resp;
+    if (op == 0) {  // SET
+      {
+        std::lock_guard<std::mutex> lk(st->mu);
+        st->data[key] = std::move(val);
+      }
+      st->cv.notify_all();
+    } else if (op == 1) {  // GET (blocking wait until key exists)
+      std::unique_lock<std::mutex> lk(st->mu);
+      st->cv.wait(lk, [&] { return !st->running || st->data.count(key); });
+      if (!st->running) break;
+      resp = st->data[key];
+    } else if (op == 2) {  // ADD: value = i64 delta; returns new value
+      int64_t delta = 0;
+      if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+      int64_t cur = 0;
+      {
+        std::lock_guard<std::mutex> lk(st->mu);
+        auto it = st->data.find(key);
+        if (it != st->data.end() && it->second.size() == 8)
+          std::memcpy(&cur, it->second.data(), 8);
+        cur += delta;
+        std::vector<uint8_t> nv(8);
+        std::memcpy(nv.data(), &cur, 8);
+        st->data[key] = nv;
+      }
+      st->cv.notify_all();
+      resp.resize(8);
+      std::memcpy(resp.data(), &cur, 8);
+    } else if (op == 3) {  // CHECK (non-blocking)
+      std::lock_guard<std::mutex> lk(st->mu);
+      uint8_t found = st->data.count(key) ? 1 : 0;
+      resp.assign(1, found);
+    } else if (op == 4) {  // DELETE
+      std::lock_guard<std::mutex> lk(st->mu);
+      st->data.erase(key);
+    } else {
+      break;
+    }
+    uint32_t rlen = static_cast<uint32_t>(resp.size());
+    if (!write_full(fd, &rlen, 4)) break;
+    if (rlen && !write_full(fd, resp.data(), rlen)) break;
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- server -------------------------------------------------------------
+void* tcp_store_server_start(uint16_t port) {
+  auto* st = new Store();
+  st->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (st->listen_fd < 0) {
+    delete st;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(st->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (::bind(st->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(st->listen_fd, 128) != 0) {
+    ::close(st->listen_fd);
+    delete st;
+    return nullptr;
+  }
+  st->accept_thread = std::thread([st] {
+    while (st->running) {
+      int fd = ::accept(st->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      st->workers.emplace_back(serve_client, st, fd);
+    }
+  });
+  return st;
+}
+
+void tcp_store_server_stop(void* handle) {
+  auto* st = static_cast<Store*>(handle);
+  if (!st) return;
+  st->running = false;
+  st->cv.notify_all();
+  ::shutdown(st->listen_fd, SHUT_RDWR);
+  ::close(st->listen_fd);
+  if (st->accept_thread.joinable()) st->accept_thread.join();
+  for (auto& w : st->workers)
+    if (w.joinable()) w.detach();  // blocked clients may hold these
+  delete st;
+}
+
+// ---- client -------------------------------------------------------------
+int tcp_store_connect(const char* host, uint16_t port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+static int request(int fd, uint8_t op, const char* key, uint32_t klen,
+                   const uint8_t* val, uint32_t vlen, uint8_t** out,
+                   uint32_t* out_len) {
+  if (!write_full(fd, &op, 1)) return -1;
+  if (!write_full(fd, &klen, 4)) return -1;
+  if (klen && !write_full(fd, key, klen)) return -1;
+  if (!write_full(fd, &vlen, 4)) return -1;
+  if (vlen && !write_full(fd, val, vlen)) return -1;
+  uint32_t rlen;
+  if (!read_full(fd, &rlen, 4)) return -1;
+  *out_len = rlen;
+  *out = nullptr;
+  if (rlen) {
+    *out = static_cast<uint8_t*>(::malloc(rlen));
+    if (!read_full(fd, *out, rlen)) {
+      ::free(*out);
+      return -1;
+    }
+  }
+  return 0;
+}
+
+int tcp_store_set(int fd, const char* key, const uint8_t* val, uint32_t vlen) {
+  uint8_t* out;
+  uint32_t olen;
+  return request(fd, 0, key, static_cast<uint32_t>(strlen(key)), val, vlen,
+                 &out, &olen);
+}
+
+int tcp_store_get(int fd, const char* key, uint8_t** out, uint32_t* out_len) {
+  return request(fd, 1, key, static_cast<uint32_t>(strlen(key)), nullptr, 0,
+                 out, out_len);
+}
+
+int64_t tcp_store_add(int fd, const char* key, int64_t delta) {
+  uint8_t buf[8];
+  std::memcpy(buf, &delta, 8);
+  uint8_t* out;
+  uint32_t olen;
+  if (request(fd, 2, key, static_cast<uint32_t>(strlen(key)), buf, 8, &out,
+              &olen) != 0 || olen != 8)
+    return -1;
+  int64_t v;
+  std::memcpy(&v, out, 8);
+  ::free(out);
+  return v;
+}
+
+int tcp_store_check(int fd, const char* key) {
+  uint8_t* out;
+  uint32_t olen;
+  if (request(fd, 3, key, static_cast<uint32_t>(strlen(key)), nullptr, 0, &out,
+              &olen) != 0 || olen != 1)
+    return -1;
+  int v = out[0];
+  ::free(out);
+  return v;
+}
+
+void tcp_store_close(int fd) { ::close(fd); }
+
+void tcp_store_free(uint8_t* p) { ::free(p); }
+
+}  // extern "C"

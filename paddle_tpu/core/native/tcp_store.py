@@ -1,0 +1,138 @@
+"""TCPStore python surface over the native C++ store (reference:
+paddle/phi/core/distributed/store/tcp_store.h:120). Falls back to an
+in-process dict store when the native library is unavailable (keeps
+single-host tests hermetic)."""
+from __future__ import annotations
+
+import ctypes
+import socket
+import threading
+import time
+from typing import Optional
+
+from .build import load_native
+
+__all__ = ["TCPStore"]
+
+
+def _lib():
+    lib = load_native("tcp_store")
+    if lib is None:
+        return None
+    lib.tcp_store_server_start.restype = ctypes.c_void_p
+    lib.tcp_store_server_start.argtypes = [ctypes.c_uint16]
+    lib.tcp_store_server_stop.argtypes = [ctypes.c_void_p]
+    lib.tcp_store_connect.restype = ctypes.c_int
+    lib.tcp_store_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16]
+    lib.tcp_store_set.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                  ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32]
+    lib.tcp_store_get.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                  ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                                  ctypes.POINTER(ctypes.c_uint32)]
+    lib.tcp_store_add.restype = ctypes.c_int64
+    lib.tcp_store_add.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int64]
+    lib.tcp_store_check.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.tcp_store_close.argtypes = [ctypes.c_int]
+    lib.tcp_store_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+    return lib
+
+
+class TCPStore:
+    """KV + counter store. is_master=True also hosts the server."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1, timeout: float = 60.0):
+        self._lib = _lib()
+        self._server = None
+        self._fd = None
+        self._local: Optional[dict] = None
+        self.host, self.port = host, port
+        if self._lib is None:
+            # pure-python single-process fallback
+            self._local = {}
+            self._lock = threading.Lock()
+            return
+        if is_master:
+            self._server = self._lib.tcp_store_server_start(ctypes.c_uint16(port))
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+        deadline = time.time() + timeout
+        while True:
+            self._fd = self._lib.tcp_store_connect(host.encode(), ctypes.c_uint16(port))
+            if self._fd >= 0:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(f"TCPStore: cannot connect {host}:{port}")
+            time.sleep(0.05)
+
+    # -- KV ----------------------------------------------------------------
+    def set(self, key: str, value: bytes):
+        if self._local is not None:
+            with self._lock:
+                self._local[key] = bytes(value)
+            return
+        buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value) if value else None
+        rc = self._lib.tcp_store_set(self._fd, key.encode(), buf, len(value))
+        if rc != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str) -> bytes:
+        if self._local is not None:
+            deadline = time.time() + 60
+            while True:
+                with self._lock:
+                    if key in self._local:
+                        return self._local[key]
+                if time.time() > deadline:
+                    raise TimeoutError(f"key {key} never set")
+                time.sleep(0.01)
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        olen = ctypes.c_uint32()
+        rc = self._lib.tcp_store_get(self._fd, key.encode(),
+                                     ctypes.byref(out), ctypes.byref(olen))
+        if rc != 0:
+            raise RuntimeError("TCPStore.get failed")
+        data = ctypes.string_at(out, olen.value) if olen.value else b""
+        if olen.value:
+            self._lib.tcp_store_free(out)
+        return data
+
+    def add(self, key: str, delta: int = 1) -> int:
+        if self._local is not None:
+            with self._lock:
+                cur = int.from_bytes(self._local.get(key, b"\0" * 8), "little", signed=True)
+                cur += delta
+                self._local[key] = cur.to_bytes(8, "little", signed=True)
+                return cur
+        v = self._lib.tcp_store_add(self._fd, key.encode(), delta)
+        if v < 0:
+            raise RuntimeError("TCPStore.add failed")
+        return int(v)
+
+    def check(self, key: str) -> bool:
+        if self._local is not None:
+            with self._lock:
+                return key in self._local
+        return self._lib.tcp_store_check(self._fd, key.encode()) == 1
+
+    def wait(self, key: str, timeout: float = 60.0) -> bytes:
+        return self.get(key)
+
+    def barrier(self, name: str, world_size: int, timeout: float = 60.0):
+        """Counter barrier: every rank adds 1 then waits for world_size."""
+        n = self.add(f"__barrier__/{name}", 1)
+        deadline = time.time() + timeout
+        while n < world_size:
+            time.sleep(0.02)
+            n = self.add(f"__barrier__/{name}", 0)
+            if time.time() > deadline:
+                raise TimeoutError(f"barrier {name}: {n}/{world_size}")
+
+    def __del__(self):
+        try:
+            if self._lib is not None and self._fd is not None and self._fd >= 0:
+                self._lib.tcp_store_close(self._fd)
+            if self._lib is not None and self._server:
+                self._lib.tcp_store_server_stop(self._server)
+        except Exception:
+            pass

@@ -121,10 +121,14 @@ class Engine:
             self._model, self._optimizer, _ = group_sharded_parallel(
                 self._model, self._optimizer, level)
             self._sharding_applied = True
+        if callbacks:
+            import warnings
+
+            warnings.warn("Engine.fit callbacks are not supported yet; "
+                          "use hapi.Model for callback-driven training")
         if self._train_step is None:
             self._train_step = self._build_train_step()
         self._model.train()
-        outputs = []
         for epoch in range(epochs):
             for step_idx, batch in enumerate(train_data):
                 if steps_per_epoch is not None and step_idx >= steps_per_epoch:
@@ -133,9 +137,13 @@ class Engine:
                 loss = self._train_step(*batch)
                 lv = float(loss)
                 self.history["loss"].append(lv)
-                outputs.append(lv)
                 if verbose and step_idx % log_freq == 0:
                     print(f"[Engine] epoch {epoch} step {step_idx} loss {lv:.6f}")
+            if valid_data is not None:
+                ev = self.evaluate(valid_data, n_labels=n_labels)
+                self.history.setdefault("eval_loss", []).append(ev["eval_loss"])
+                if verbose:
+                    print(f"[Engine] epoch {epoch} eval_loss {ev['eval_loss']:.6f}")
         return self.history
 
     def evaluate(self, valid_data, batch_size=None, steps=None, verbose=1,

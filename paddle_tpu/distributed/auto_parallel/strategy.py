@@ -7,6 +7,10 @@ class _Config:
     _fields = {}
 
     def __init__(self, **kw):
+        unknown = set(kw) - set(self._fields)
+        if unknown:
+            raise ValueError(
+                f"unknown {type(self).__name__} keys: {sorted(unknown)}")
         for k, v in {**self._fields, **kw}.items():
             setattr(self, k, v)
 

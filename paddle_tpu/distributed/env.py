@@ -82,6 +82,20 @@ def init_parallel_env():
         return env
     coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ENDPOINT")
     if env.world_size > 1 and coord and not os.environ.get("PADDLE_TPU_NO_JAX_DIST"):
+        # rendezvous barrier through the native TCPStore (reference
+        # tcp_store.h:120): rank 0 hosts; all ranks sync before the XLA
+        # coordinator handshake so slow-starting ranks don't time out
+        try:
+            from ..core.native.tcp_store import TCPStore
+
+            host, port = coord.split(":")[0], int(coord.split(":")[1])
+            store = TCPStore(host=host, port=port + 1,
+                             is_master=(env.rank == 0), world_size=env.world_size)
+            if store._local is None:  # real socket store only — the
+                # in-process fallback cannot synchronize separate ranks
+                store.barrier("init_parallel_env", env.world_size)
+        except Exception:
+            pass  # rendezvous is best-effort; jax.distributed retries anyway
         try:
             jax.distributed.initialize(
                 coordinator_address=coord,

@@ -1,0 +1,10 @@
+"""Process launcher (reference: python/paddle/distributed/launch/ —
+controllers/collective.py builds per-rank env and forks pods;
+master rendezvous in controllers/master.py).
+
+TPU-native: on TPU pods each HOST runs one process that owns all local
+chips (SPMD single-controller), so the launcher's job is to start one
+worker per host entry (or N local workers for CPU simulation), wire the
+PADDLE_* env contract, stream logs, and propagate failures.
+"""
+from .main import launch_main  # noqa: F401

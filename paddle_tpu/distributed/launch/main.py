@@ -1,0 +1,99 @@
+"""`python -m paddle_tpu.distributed.launch [--nproc_per_node N] script.py args...`
+
+Single-host multi-process launcher (reference launch/main.py +
+controllers/collective.py: per-rank PADDLE_TRAINER_ID / endpoints env,
+log files per rank, tail-on-failure job/container.py behavior).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch_main"]
+
+
+def _parse():
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
+    p.add_argument("--nproc_per_node", "--nprocs", type=int, default=1)
+    p.add_argument("--master", default="127.0.0.1:23571",
+                   help="coordinator host:port (rank0)")
+    p.add_argument("--rank", type=int, default=0, help="this host's index")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--devices", default=None,
+                   help="accepted for reference-API parity (TPU chips are "
+                        "owned by the single process per host)")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def launch_main(argv=None):
+    args = _parse()
+    nproc = args.nproc_per_node
+    world = args.nnodes * nproc
+    procs = []
+    log_files = []
+    log_dir = args.log_dir
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+
+    for local_rank in range(nproc):
+        rank = args.rank * nproc + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_WORLD_SIZE": str(world),
+            "PADDLE_MASTER": args.master,
+            "MASTER_ENDPOINT": args.master,
+        })
+        cmd = [sys.executable, "-u", args.script, *args.script_args]
+        if log_dir:
+            lf = open(os.path.join(log_dir, f"workerlog.{rank}"), "wb")
+            log_files.append(lf)
+            procs.append(subprocess.Popen(cmd, env=env, stdout=lf, stderr=lf))
+        else:
+            procs.append(subprocess.Popen(cmd, env=env))
+
+    exit_code = 0
+    try:
+        while procs:
+            for i, pr in enumerate(list(procs)):
+                rc = pr.poll()
+                if rc is None:
+                    continue
+                procs.remove(pr)
+                if rc != 0:
+                    exit_code = rc
+                    # a failed rank kills the pod (reference container watch)
+                    for other in procs:
+                        other.send_signal(signal.SIGTERM)
+                    for other in procs:
+                        other.wait(timeout=30)
+                    procs = []
+                    break
+            time.sleep(0.2)
+    finally:
+        for lf in log_files:
+            lf.close()
+        if exit_code != 0 and log_dir:
+            # tail the failing logs (reference tail-on-failure)
+            for rank in range(world):
+                path = os.path.join(log_dir, f"workerlog.{rank}")
+                if os.path.exists(path):
+                    with open(path, "rb") as f:
+                        tail = f.read()[-2000:]
+                    sys.stderr.write(f"----- {path} -----\n")
+                    sys.stderr.buffer.write(tail)
+                    sys.stderr.write("\n")
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(launch_main())

@@ -1,0 +1,162 @@
+"""hapi Model — the high-level trainer (reference:
+python/paddle/hapi/model.py:1050 Model, :1741 fit).
+
+TPU-native: prepare() compiles the train/eval steps whole-program via
+jit.to_static; fit() is a host loop feeding the compiled steps.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .. import ops as _ops
+from ..jit.api import to_static
+from ..nn.layer import Layer
+from ..tensor import Tensor, to_tensor
+from .callbacks import Callback, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+def _to_tensors(batch):
+    if isinstance(batch, (list, tuple)):
+        return tuple(b if isinstance(b, Tensor) else to_tensor(np.asarray(b))
+                     for b in batch)
+    return (batch if isinstance(batch, Tensor) else to_tensor(np.asarray(batch)),)
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs_spec = inputs
+        self._labels_spec = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self._eval_step = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics else [])
+        self._amp = amp_configs or None
+
+        net, opt, loss_fn = self.network, optimizer, loss
+
+        def train_step(*batch):
+            n_in = 1 if self._labels_spec is None else len(batch) - len(self._labels_spec)
+            inputs, labels = batch[:n_in], batch[n_in:]
+            out = net(*inputs)
+            l = loss_fn(out, *labels) if loss_fn else out
+            l.backward()
+            opt.step()
+            opt.clear_grad()
+            return l
+
+        def eval_step(*batch):
+            n_in = 1 if self._labels_spec is None else len(batch) - len(self._labels_spec)
+            inputs, labels = batch[:n_in], batch[n_in:]
+            with _ops.no_grad():
+                out = net(*inputs)
+                l = loss_fn(out, *labels) if loss_fn else out
+            return l, out
+
+        self._train_step = to_static(train_step) if optimizer else None
+        self._eval_step = eval_step
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        cbs: List[Callback] = list(callbacks or [])
+        if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
+            cbs.append(ProgBarLogger(log_freq=log_freq, verbose=verbose))
+        for c in cbs:
+            c.set_model(self)
+        self.network.train()
+        for c in cbs:
+            c.on_train_begin()
+        history = []
+        it = 0
+        for epoch in range(epochs):
+            for c in cbs:
+                c.on_epoch_begin(epoch)
+            for step, batch in enumerate(train_data):
+                for c in cbs:
+                    c.on_train_batch_begin(step)
+                loss = self._train_step(*_to_tensors(batch))
+                lv = float(loss)
+                history.append(lv)
+                for c in cbs:
+                    c.on_train_batch_end(step, {"loss": lv})
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    break
+            logs = {"loss": history[-1] if history else float("nan")}
+            if eval_data is not None and epoch % eval_freq == 0:
+                logs.update(self.evaluate(eval_data, verbose=0))
+                for c in cbs:
+                    c.on_eval_end(logs)
+            for c in cbs:
+                c.on_epoch_end(epoch, logs)
+            if save_dir and epoch % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+            if any(getattr(c, "stop_training", False) for c in cbs):
+                break
+        for c in cbs:
+            c.on_train_end()
+        return {"loss": history}
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        self.network.eval()
+        losses, n_correct, n_total = [], 0, 0
+        for batch in eval_data:
+            l, out = self._eval_step(*_to_tensors(batch))
+            losses.append(float(l))
+        self.network.train()
+        res = {"eval_loss": float(np.mean(losses)) if losses else float("nan")}
+        return res
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        self.network.eval()
+        outs = []
+        for batch in test_data:
+            with _ops.no_grad():
+                outs.append(self.network(*_to_tensors(batch)))
+        self.network.train()
+        return outs
+
+    def save(self, path, training=True):
+        from ..framework.io import save
+
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+
+        from ..framework.io import load
+
+        self.network.set_state_dict(load(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(load(path + ".pdopt"))
+
+    def summary(self, input_size=None, dtype=None):
+        total = 0
+        lines = []
+        for name, p in self.network.named_parameters():
+            n = int(np.prod(p.shape))
+            total += n
+            lines.append(f"  {name:40s} {str(p.shape):20s} {n}")
+        text = "\n".join(lines) + f"\nTotal params: {total}"
+        print(text)
+        return {"total_params": total}
+
+    def parameters(self, *a, **k):
+        return self.network.parameters(*a, **k)

@@ -1,5 +1,8 @@
-"""GShard top-2 gate (reference gate/gshard_gate.py): top-2 routing with
-auxiliary load-balance loss and random second-expert sampling."""
+"""GShard top-2 gate (reference gate/gshard_gate.py): top-2 routing; the
+aux load-balance loss is computed by MoELayer from the pre-capacity
+assignment. `capacity` feeds MoELayer's capacity factor. random_routing
+(stochastic second-expert drop) is accepted for API parity but not yet
+implemented — routing is deterministic top-2."""
 from __future__ import annotations
 
 from .naive_gate import NaiveGate

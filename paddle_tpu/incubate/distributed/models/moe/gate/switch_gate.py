@@ -1,4 +1,5 @@
-"""Switch (top-1) gate (reference gate/switch_gate.py)."""
+"""Switch (top-1) gate (reference gate/switch_gate.py): logits are
+multiplicatively jittered by U(1-eps, 1+eps) during training."""
 from __future__ import annotations
 
 from .naive_gate import NaiveGate
@@ -10,3 +11,14 @@ class SwitchGate(NaiveGate):
         super().__init__(d_model, num_expert, world_size, topk=1)
         self.switch_eps = switch_eps
         self.capacity = capacity
+
+    def forward(self, inp):
+        logits = super().forward(inp)
+        if self.training and self.switch_eps > 0:
+            from ......ops import random as _random
+
+            noise = _random.uniform(
+                logits.shape, dtype="float32",
+                min=1.0 - self.switch_eps, max=1.0 + self.switch_eps)
+            logits = logits * noise
+        return logits

@@ -80,7 +80,7 @@ class MoELayer(Layer):
     """
 
     def __init__(self, d_model, num_experts=None, experts: Optional[ExpertFFN] = None,
-                 gate="gshard", top_k=2, capacity_factor=1.25, d_hidden=None,
+                 gate="gshard", top_k=2, capacity_factor=None, d_hidden=None,
                  group=None, recompute_interval=0, name=None):
         super().__init__()
         self.d_model = d_model
@@ -96,7 +96,12 @@ class MoELayer(Layer):
             cls = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}[gate]
             self.top_k = 1 if gate == "switch" else top_k
             self.gate = cls(d_model, self.num_experts, topk=self.top_k)
-        self.capacity_factor = capacity_factor
+        # gates may carry their own capacity config (reference API); the
+        # layer-level capacity_factor wins only when explicitly set
+        gate_cap = getattr(self.gate, "capacity", None)
+        if capacity_factor is None and gate_cap:
+            capacity_factor = float(gate_cap[0])
+        self.capacity_factor = capacity_factor if capacity_factor is not None else 1.25
         self.aux_loss: Optional[Tensor] = None
 
     def forward(self, x: Tensor) -> Tensor:
@@ -146,10 +151,14 @@ class MoELayer(Layer):
             ex_in = jnp.einsum("tec,th->ech", dispatch, xt)           # [E, C, H]
             return dispatch, combine, ex_in, aux
 
+        act = {"gelu": lambda a: jax.nn.gelu(a, approximate=True),
+               "relu": jax.nn.relu, "silu": jax.nn.silu,
+               "swish": jax.nn.silu}[self.experts.activation]
+
         def moe_fwd(xr, lg, w1, b1, w2, b2):
             dispatchT, combine, ex_in, aux = route(xr, lg)
             hmid = jnp.einsum("ech,ehf->ecf", ex_in, w1) + b1[:, None, :]
-            hmid = jax.nn.gelu(hmid, approximate=True)
+            hmid = act(hmid)
             ex_out = jnp.einsum("ecf,efh->ech", hmid, w2) + b2[:, None, :]
             yt = jnp.einsum("tec,ech->th", combine, ex_out)
             return yt.reshape(xr.shape), aux

@@ -1,0 +1,186 @@
+"""BERT encoder family (reference fixture:
+test/dygraph_to_static/bert_dygraph_model.py — BASELINE config 1 is
+BERT-base dygraph_to_static single-chip).
+
+TPU-first: the encoder reuses the framework's Transformer building blocks
+(nn.modules.transformer) so the whole pretraining step traces into one XLA
+program under jit.to_static; masked-LM uses dense gather on masked
+positions (static shapes, MXU-friendly)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from .. import ops
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.modules.common import Dropout, Embedding, Linear
+from ..nn.modules.norm import LayerNorm
+from ..tensor import Tensor
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertPretrainingCriterion", "bert_tiny", "bert_base"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+
+
+def bert_tiny(**kw):
+    d = dict(vocab_size=1024, hidden_size=64, num_layers=2, num_heads=4,
+             intermediate_size=256, max_position_embeddings=128)
+    d.update(kw)
+    return BertConfig(**d)
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def _winit(cfg):
+    from ..nn.initializer import Normal
+    from ..nn.param_attr import ParamAttr
+
+    return ParamAttr(initializer=Normal(0.0, cfg.initializer_range))
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size, weight_attr=_winit(cfg))
+        self.position_embeddings = Embedding(cfg.max_position_embeddings, cfg.hidden_size, weight_attr=_winit(cfg))
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size, cfg.hidden_size, weight_attr=_winit(cfg))
+        self.layer_norm = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        if position_ids is None:
+            s = input_ids.shape[-1]
+            position_ids = ops.expand(
+                ops.unsqueeze(ops.arange(0, s, dtype="int64"), 0), list(input_ids.shape))
+        if token_type_ids is None:
+            token_type_ids = ops.zeros_like(input_ids)
+        h = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(h))
+
+
+class BertSelfAttention(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.qkv = Linear(h, 3 * h, weight_attr=_winit(cfg))
+        self.out = Linear(h, h, weight_attr=_winit(cfg))
+        self.dropout = Dropout(cfg.hidden_dropout)
+        self._cfg = cfg
+
+    def forward(self, x, attn_mask=None):
+        cfg = self._cfg
+        b, s = x.shape[0], x.shape[1]
+        nh = cfg.num_heads
+        hd = cfg.hidden_size // nh
+        qkv = ops.reshape(self.qkv(x), [b, s, 3, nh, hd])
+        q = ops.squeeze(ops.slice(qkv, [2], [0], [1]), 2)
+        k = ops.squeeze(ops.slice(qkv, [2], [1], [2]), 2)
+        v = ops.squeeze(ops.slice(qkv, [2], [2], [3]), 2)
+        o = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=cfg.attention_dropout,
+            is_causal=False, training=self.training)
+        return self.dropout(self.out(ops.reshape(o, [b, s, nh * hd])))
+
+
+class BertLayer(Layer):
+    """Post-LN encoder block (BERT convention)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.attention = BertSelfAttention(cfg)
+        self.ln1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.fc1 = Linear(cfg.hidden_size, cfg.intermediate_size, weight_attr=_winit(cfg))
+        self.fc2 = Linear(cfg.intermediate_size, cfg.hidden_size, weight_attr=_winit(cfg))
+        self.ln2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout)
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln1(x + self.attention(x, attn_mask))
+        y = self.fc2(F.gelu(self.fc1(x)))
+        return self.ln2(x + self.dropout(y))
+
+
+class BertModel(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.layers = [BertLayer(cfg) for _ in range(cfg.num_layers)]
+        for i, l in enumerate(self.layers):
+            self.add_sublayer(f"layer_{i}", l)
+        self.pooler = Linear(cfg.hidden_size, cfg.hidden_size, weight_attr=_winit(cfg))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is not None:
+            # [B, S] 1/0 -> additive [B, 1, 1, S]
+            m = ops.unsqueeze(ops.unsqueeze(attention_mask, 1), 1)
+            attention_mask = (1.0 - m.astype("float32")) * -1e9
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        for l in self.layers:
+            h = l(h, attention_mask)
+        pooled = ops.tanh(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (reference PretrainModelLayer)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.mlm_transform = Linear(cfg.hidden_size, cfg.hidden_size, weight_attr=_winit(cfg))
+        self.mlm_ln = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
+        self.nsp_head = Linear(cfg.hidden_size, 2, weight_attr=_winit(cfg))
+        self.config = cfg
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, masked_positions=None):
+        h, pooled = self.bert(input_ids, token_type_ids, position_ids, attention_mask)
+        if masked_positions is not None:
+            # gather masked positions: [B, M, H]
+            g = ops.take_along_axis(
+                h, ops.unsqueeze(masked_positions, -1).astype("int64"), 1)
+        else:
+            g = h
+        g = self.mlm_ln(F.gelu(self.mlm_transform(g)))
+        w = self.bert.embeddings.word_embeddings.weight
+        mlm_logits = ops.matmul(g, w, transpose_y=True)
+        nsp_logits = self.nsp_head(pooled)
+        return mlm_logits, nsp_logits
+
+
+class BertPretrainingCriterion(Layer):
+    def forward(self, mlm_logits, nsp_logits, mlm_labels, nsp_labels=None,
+                mlm_weights=None):
+        mlm = F.cross_entropy(mlm_logits, mlm_labels, reduction="none")
+        if mlm_weights is not None:
+            w = mlm_weights.astype(mlm.dtype)
+            mlm = ops.sum(mlm * w) / ops.clip(ops.sum(w), min=1.0)
+        else:
+            mlm = ops.mean(mlm)
+        if nsp_labels is None:
+            return mlm
+        nsp = F.cross_entropy(nsp_logits, nsp_labels)
+        return mlm + nsp

@@ -62,7 +62,7 @@ def _max_pool(x, kernel_size, stride, padding, return_mask, ceil_mode, data_form
             pad_arg = pairs
         else:
             pad_arg = _full_pad(pairs, a.ndim, off)
-        neg = jnp.finfo(a.dtype).min if _dtype_mod.is_float_raw(a.dtype) else np.iinfo(np.dtype(a.dtype)).min
+        neg = -jnp.inf if _dtype_mod.is_float_raw(a.dtype) else np.iinfo(np.dtype(a.dtype)).min
         return jax.lax.reduce_window(a, neg, jax.lax.max, dims, strides, pad_arg)
 
     out = dispatch.apply(fn, x, op_name="max_pool")

@@ -22,15 +22,28 @@ from . import dispatch
 
 
 class Generator:
-    """Splittable functional RNG (analog of phi::Generator)."""
+    """Splittable functional RNG (analog of phi::Generator).
+
+    Key creation is lazy so `import paddle_tpu` does not initialize a jax
+    backend (keeps CLI tools like the launcher importable before workers
+    choose their platform)."""
 
     def __init__(self, seed: int = 0):
-        self._state = Tensor(jax.random.PRNGKey(seed))
+        self._state_t = None
         self._seed = seed
+
+    @property
+    def _state(self):
+        if self._state_t is None:
+            self._state_t = Tensor(jax.random.PRNGKey(self._seed))
+        return self._state_t
 
     def manual_seed(self, seed: int):
         self._seed = seed
-        self._state._set_value(jax.random.PRNGKey(seed))
+        if self._state_t is None:
+            self._state_t = Tensor(jax.random.PRNGKey(seed))
+        else:
+            self._state_t._set_value(jax.random.PRNGKey(seed))
         return self
 
     def get_state(self):
@@ -41,9 +54,10 @@ class Generator:
 
     def split(self):
         """Return a fresh subkey; advances the stored state."""
-        dispatch.note_read(self._state)
-        new, sub = jax.random.split(self._state._value)
-        self._state._set_value(new)
+        st = self._state
+        dispatch.note_read(st)
+        new, sub = jax.random.split(st._value)
+        st._set_value(new)
         return sub
 
     @property

@@ -1,0 +1,166 @@
+"""BERT (BASELINE config 1), hapi Model, vision models (config 0), and the
+native TCPStore (reference test analogs: test/dygraph_to_static/test_bert.py,
+hapi tests, phi/core/distributed/store/test_tcp_store.cc)."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models.bert import (
+    BertForPretraining,
+    BertPretrainingCriterion,
+    bert_tiny,
+)
+
+
+def _bert_batch(cfg, b=2, s=16, n_mask=4, seed=0):
+    rng = np.random.RandomState(seed)
+    ids = pt.to_tensor(rng.randint(0, cfg.vocab_size, (b, s)), dtype="int64")
+    seg = pt.to_tensor(rng.randint(0, 2, (b, s)), dtype="int64")
+    pos = pt.to_tensor(rng.randint(0, s, (b, n_mask)), dtype="int64")
+    mlm_labels = pt.to_tensor(rng.randint(0, cfg.vocab_size, (b, n_mask)), dtype="int64")
+    nsp = pt.to_tensor(rng.randint(0, 2, (b,)), dtype="int64")
+    return ids, seg, pos, mlm_labels, nsp
+
+
+def test_bert_pretraining_trains():
+    cfg = bert_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    pt.seed(0)
+    m = BertForPretraining(cfg)
+    crit = BertPretrainingCriterion()
+    opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    ids, seg, pos, mlm_labels, nsp = _bert_batch(cfg)
+    losses = []
+    for _ in range(4):
+        mlm_logits, nsp_logits = m(ids, token_type_ids=seg, masked_positions=pos)
+        loss = crit(mlm_logits, nsp_logits, mlm_labels, nsp)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_bert_to_static_matches_eager():
+    """BASELINE config 1: BERT dygraph_to_static numeric parity."""
+    cfg = bert_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    crit = BertPretrainingCriterion()
+    ids, seg, pos, mlm_labels, nsp = _bert_batch(cfg)
+
+    pt.seed(9)
+    m1 = BertForPretraining(cfg)
+    pt.seed(9)
+    m2 = BertForPretraining(cfg)
+    o1 = pt.optimizer.SGD(learning_rate=1e-2, parameters=m1.parameters())
+    o2 = pt.optimizer.SGD(learning_rate=1e-2, parameters=m2.parameters())
+
+    def step(m, o):
+        mlm_logits, nsp_logits = m(ids, token_type_ids=seg, masked_positions=pos)
+        loss = crit(mlm_logits, nsp_logits, mlm_labels, nsp)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    static_step = pt.jit.to_static(lambda: step(m2, o2))
+    eager, static = [], []
+    for _ in range(4):
+        eager.append(float(step(m1, o1)))
+        static.append(float(static_step()))
+    np.testing.assert_allclose(eager, static, rtol=2e-4, atol=2e-5)
+
+
+def test_hapi_model_fit():
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.nn.modules.common import Linear
+    from paddle_tpu.nn.modules.container import Sequential
+    import paddle_tpu.nn.functional as F
+
+    pt.seed(0)
+    net = Sequential(Linear(8, 16), Linear(16, 2))
+
+    class XentLoss(pt.nn.Layer):
+        def forward(self, logits, label):
+            return F.cross_entropy(logits, label)
+
+    model = Model(net)
+    model.prepare(
+        optimizer=pt.optimizer.Adam(learning_rate=1e-2, parameters=net.parameters()),
+        loss=XentLoss(),
+    )
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int64)
+    data = [(x, y)] * 6
+    hist = model.fit(data, epochs=1, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+    ev = model.evaluate(data[:2])
+    assert np.isfinite(ev["eval_loss"])
+    assert model.summary()["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
+
+
+def test_vision_resnet_builds_and_lenet_trains():
+    from paddle_tpu.vision.models import resnet50
+    from paddle_tpu.vision.models.lenet import LeNet
+    import paddle_tpu.nn.functional as F
+
+    # config 0 parity: resnet50 constructs and runs forward
+    pt.seed(0)
+    r50 = resnet50(num_classes=10)
+    x = pt.to_tensor(np.random.RandomState(0).randn(1, 3, 32, 32).astype(np.float32))
+    logits = r50(x)
+    assert logits.shape == [1, 10]
+
+    # small CNN end-to-end training
+    net = LeNet(num_classes=4)
+    opt = pt.optimizer.Adam(learning_rate=1e-3, parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    imgs = pt.to_tensor(rng.randn(4, 1, 28, 28).astype(np.float32))
+    labels = pt.to_tensor(rng.randint(0, 4, (4,)), dtype="int64")
+    losses = []
+    for _ in range(4):
+        loss = F.cross_entropy(net(imgs), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_native_tcp_store():
+    from paddle_tpu.core.native.tcp_store import TCPStore
+
+    master = TCPStore(port=29891, is_master=True)
+    master.set("k", b"v1")
+    assert master.get("k") == b"v1"
+    assert master.add("n", 3) == 3
+    assert master.add("n", 4) == 7
+    assert master.check("k") and not master.check("missing")
+
+    results = []
+
+    def worker(i):
+        c = TCPStore(port=29891)
+        c.barrier("b", 3)
+        results.append(i)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert sorted(results) == [0, 1, 2]
+
+    got = []
+
+    def getter():
+        got.append(TCPStore(port=29891).get("late"))
+
+    t = threading.Thread(target=getter)
+    t.start()
+    time.sleep(0.2)
+    master.set("late", b"ok")
+    t.join(timeout=10)
+    assert got == [b"ok"]
