@@ -58,6 +58,54 @@ from .autograd import grad  # noqa: E402,F401
 
 CUDAPlace = TPUPlace  # reference-API compat: the accelerator is the TPU
 XPUPlace = TPUPlace
+CUDAPinnedPlace = CPUPlace  # host-staging memory is plain host memory here
+
+# reference-API compat aliases: the TPU generator is the device generator
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+# dtype aliases (reference exports paddle.bool / paddle.dtype)
+bool = bool8  # noqa: A001
+dtype = _dtype.DType
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Reader transformer grouping samples into lists (reference
+    python/paddle/batch.py:17)."""
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def disable_signal_handler():
+    """Parity no-op: the reference installs C++ crash handlers
+    (paddle/fluid/platform/init.cc signal handlers); this runtime installs
+    none, so there is nothing to disable."""
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Free-standing parameter factory (reference
+    python/paddle/tensor/creation.py create_parameter)."""
+    from .nn.initializer import Constant, XavierNormal
+    from .nn.param_attr import ParamAttr as _PA
+
+    attr = attr if isinstance(attr, _PA) else _PA(name=name)
+    init = (default_initializer or attr.initializer
+            or (Constant(0.0) if is_bias else XavierNormal()))
+    raw = init(shape, _dtype.to_jax_dtype(dtype))
+    # NB: `bool`/`dtype` module attrs shadow the builtins in this namespace
+    return Parameter(raw, name=attr.name or name,
+                     trainable=True if attr.trainable else False)
 
 
 def is_compiled_with_cuda():
@@ -89,6 +137,8 @@ def enable_static():
 
 
 # subsystem namespaces
+from . import fft  # noqa: E402,F401
+from . import signal  # noqa: E402,F401
 from . import nn  # noqa: E402,F401
 from . import optimizer  # noqa: E402,F401
 from . import io  # noqa: E402,F401
@@ -99,6 +149,12 @@ from . import vision  # noqa: E402,F401
 from . import framework  # noqa: E402,F401
 from .framework.io import load, save  # noqa: E402,F401
 from . import distributed  # noqa: E402,F401
+from . import distribution  # noqa: E402,F401
+from .distributed import DataParallel  # noqa: E402,F401
+from . import hapi  # noqa: E402,F401
+from .hapi import Model  # noqa: E402,F401
+from .hapi.model import summary, flops  # noqa: E402,F401
+from .nn.param_attr import ParamAttr  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import static  # noqa: E402,F401

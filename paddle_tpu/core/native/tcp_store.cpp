@@ -10,6 +10,7 @@
 //   request : u8 op | u32 klen | key bytes | u32 vlen | value bytes
 //   response: u32 vlen | value bytes   (vlen == 0xFFFFFFFF => not found)
 // Ops: 0=SET 1=GET(blocking-wait) 2=ADD(returns new i64) 3=CHECK 4=DELETE
+//      5=WAIT(value = i64 timeout_ms; returns u8 1=found 0=timeout)
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -17,6 +18,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -34,8 +36,14 @@ struct Store {
   std::map<std::string, std::vector<uint8_t>> data;
   std::atomic<bool> running{true};
   int listen_fd = -1;
+  uint16_t bound_port = 0;
   std::thread accept_thread;
+  // workers is mutated only by the accept thread (stop() joins it first);
+  // client_fds is registered by the accept thread and de-registered by each
+  // worker on disconnect, both under conn_mu.
+  std::mutex conn_mu;
   std::vector<std::thread> workers;
+  std::vector<int> client_fds;
 };
 
 bool read_full(int fd, void* buf, size_t n) {
@@ -109,12 +117,46 @@ void serve_client(Store* st, int fd) {
     } else if (op == 4) {  // DELETE
       std::lock_guard<std::mutex> lk(st->mu);
       st->data.erase(key);
+    } else if (op == 5) {  // WAIT with timeout (ms); resp = u8 found | value
+      // The value rides along in the response so the caller needs no
+      // follow-up GET (which could block forever if the key is deleted
+      // between the two round trips).
+      int64_t timeout_ms = -1;
+      if (val.size() == 8) std::memcpy(&timeout_ms, val.data(), 8);
+      std::unique_lock<std::mutex> lk(st->mu);
+      bool found;
+      auto pred = [&] { return !st->running || st->data.count(key); };
+      if (timeout_ms < 0) {
+        st->cv.wait(lk, pred);
+        found = st->data.count(key) != 0;
+      } else {
+        found = st->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred) &&
+                st->data.count(key) != 0;
+      }
+      if (!st->running) break;
+      resp.assign(1, found ? 1 : 0);
+      if (found) {
+        const auto& v = st->data[key];
+        resp.insert(resp.end(), v.begin(), v.end());
+      }
     } else {
       break;
     }
     uint32_t rlen = static_cast<uint32_t>(resp.size());
     if (!write_full(fd, &rlen, 4)) break;
     if (rlen && !write_full(fd, resp.data(), rlen)) break;
+  }
+  // De-register before closing: the kernel may recycle this fd number for an
+  // unrelated socket, and stop() must not shutdown() a recycled fd.
+  {
+    std::lock_guard<std::mutex> lk(st->conn_mu);
+    auto& fds = st->client_fds;
+    for (auto it = fds.begin(); it != fds.end(); ++it) {
+      if (*it == fd) {
+        fds.erase(it);
+        break;
+      }
+    }
   }
   ::close(fd);
 }
@@ -143,28 +185,55 @@ void* tcp_store_server_start(uint16_t port) {
     delete st;
     return nullptr;
   }
+  sockaddr_in bound{};
+  socklen_t blen = sizeof(bound);
+  if (::getsockname(st->listen_fd, reinterpret_cast<sockaddr*>(&bound), &blen) == 0)
+    st->bound_port = ntohs(bound.sin_port);
   st->accept_thread = std::thread([st] {
     while (st->running) {
       int fd = ::accept(st->listen_fd, nullptr, nullptr);
       if (fd < 0) break;
       int one = 1;
       ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      {
+        std::lock_guard<std::mutex> lk(st->conn_mu);
+        st->client_fds.push_back(fd);
+      }
       st->workers.emplace_back(serve_client, st, fd);
     }
   });
   return st;
 }
 
+uint16_t tcp_store_server_port(void* handle) {
+  auto* st = static_cast<Store*>(handle);
+  return st ? st->bound_port : 0;
+}
+
 void tcp_store_server_stop(void* handle) {
   auto* st = static_cast<Store*>(handle);
   if (!st) return;
-  st->running = false;
+  // Flip `running` UNDER mu: a worker between its pred evaluation and the cv
+  // block would otherwise miss the notify and sleep forever (and the join
+  // below would deadlock).
+  {
+    std::lock_guard<std::mutex> lk(st->mu);
+    st->running = false;
+  }
   st->cv.notify_all();
   ::shutdown(st->listen_fd, SHUT_RDWR);
   ::close(st->listen_fd);
   if (st->accept_thread.joinable()) st->accept_thread.join();
+  // Unblock workers stuck in recv() by shutting their sockets down, then
+  // JOIN them all before freeing the Store — a detached worker touching the
+  // freed mutex/cv/map was a use-after-free.
+  {
+    std::lock_guard<std::mutex> lk(st->conn_mu);
+    for (int fd : st->client_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  st->cv.notify_all();
   for (auto& w : st->workers)
-    if (w.joinable()) w.detach();  // blocked clients may hold these
+    if (w.joinable()) w.join();
   delete st;
 }
 
@@ -222,7 +291,9 @@ int tcp_store_get(int fd, const char* key, uint8_t** out, uint32_t* out_len) {
                  out, out_len);
 }
 
-int64_t tcp_store_add(int fd, const char* key, int64_t delta) {
+// Returns 0 on success with *result set (out-param so legitimate negative
+// counter values are not misread as failures), -1 on transport error.
+int tcp_store_add(int fd, const char* key, int64_t delta, int64_t* result) {
   uint8_t buf[8];
   std::memcpy(buf, &delta, 8);
   uint8_t* out;
@@ -230,10 +301,32 @@ int64_t tcp_store_add(int fd, const char* key, int64_t delta) {
   if (request(fd, 2, key, static_cast<uint32_t>(strlen(key)), buf, 8, &out,
               &olen) != 0 || olen != 8)
     return -1;
-  int64_t v;
-  std::memcpy(&v, out, 8);
+  std::memcpy(result, out, 8);
   ::free(out);
-  return v;
+  return 0;
+}
+
+// 1 = key present (*out/*out_len hold the value, caller frees), 0 = timed
+// out, -1 = transport error.  timeout_ms < 0 blocks indefinitely.
+int tcp_store_wait(int fd, const char* key, int64_t timeout_ms, uint8_t** out,
+                   uint32_t* out_len) {
+  uint8_t buf[8];
+  std::memcpy(buf, &timeout_ms, 8);
+  uint8_t* resp;
+  uint32_t rlen;
+  *out = nullptr;
+  *out_len = 0;
+  if (request(fd, 5, key, static_cast<uint32_t>(strlen(key)), buf, 8, &resp,
+              &rlen) != 0 || rlen < 1)
+    return -1;
+  int found = resp[0];
+  if (found && rlen > 1) {
+    *out_len = rlen - 1;
+    *out = static_cast<uint8_t*>(::malloc(rlen - 1));
+    std::memcpy(*out, resp + 1, rlen - 1);
+  }
+  ::free(resp);
+  return found;
 }
 
 int tcp_store_check(int fd, const char* key) {

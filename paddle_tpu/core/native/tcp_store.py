@@ -29,8 +29,15 @@ def _lib():
     lib.tcp_store_get.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                   ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
                                   ctypes.POINTER(ctypes.c_uint32)]
-    lib.tcp_store_add.restype = ctypes.c_int64
-    lib.tcp_store_add.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int64]
+    lib.tcp_store_add.restype = ctypes.c_int
+    lib.tcp_store_add.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
+                                  ctypes.POINTER(ctypes.c_int64)]
+    lib.tcp_store_wait.restype = ctypes.c_int
+    lib.tcp_store_wait.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
+                                   ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                                   ctypes.POINTER(ctypes.c_uint32)]
+    lib.tcp_store_server_port.restype = ctypes.c_uint16
+    lib.tcp_store_server_port.argtypes = [ctypes.c_void_p]
     lib.tcp_store_check.argtypes = [ctypes.c_int, ctypes.c_char_p]
     lib.tcp_store_close.argtypes = [ctypes.c_int]
     lib.tcp_store_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
@@ -56,6 +63,8 @@ class TCPStore:
             self._server = self._lib.tcp_store_server_start(ctypes.c_uint16(port))
             if not self._server:
                 raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            # port=0 binds an ephemeral port; surface the real one
+            self.port = port = int(self._lib.tcp_store_server_port(self._server))
         deadline = time.time() + timeout
         while True:
             self._fd = self._lib.tcp_store_connect(host.encode(), ctypes.c_uint16(port))
@@ -104,10 +113,12 @@ class TCPStore:
                 cur += delta
                 self._local[key] = cur.to_bytes(8, "little", signed=True)
                 return cur
-        v = self._lib.tcp_store_add(self._fd, key.encode(), delta)
-        if v < 0:
+        result = ctypes.c_int64()
+        rc = self._lib.tcp_store_add(self._fd, key.encode(), delta,
+                                     ctypes.byref(result))
+        if rc != 0:
             raise RuntimeError("TCPStore.add failed")
-        return int(v)
+        return int(result.value)
 
     def check(self, key: str) -> bool:
         if self._local is not None:
@@ -116,7 +127,32 @@ class TCPStore:
         return self._lib.tcp_store_check(self._fd, key.encode()) == 1
 
     def wait(self, key: str, timeout: float = 60.0) -> bytes:
-        return self.get(key)
+        """Block until ``key`` exists (up to ``timeout`` seconds), then return
+        its value. Raises TimeoutError if the key never arrives."""
+        if self._local is not None:
+            deadline = time.time() + timeout
+            while True:
+                with self._lock:
+                    if key in self._local:
+                        return self._local[key]
+                if time.time() > deadline:
+                    raise TimeoutError(f"TCPStore.wait: key {key!r} not set "
+                                       f"within {timeout}s")
+                time.sleep(0.01)
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        olen = ctypes.c_uint32()
+        rc = self._lib.tcp_store_wait(self._fd, key.encode(),
+                                      ctypes.c_int64(int(timeout * 1000)),
+                                      ctypes.byref(out), ctypes.byref(olen))
+        if rc < 0:
+            raise RuntimeError("TCPStore.wait failed")
+        if rc == 0:
+            raise TimeoutError(f"TCPStore.wait: key {key!r} not set within "
+                               f"{timeout}s")
+        data = ctypes.string_at(out, olen.value) if olen.value else b""
+        if olen.value:
+            self._lib.tcp_store_free(out)
+        return data
 
     def barrier(self, name: str, world_size: int, timeout: float = 60.0):
         """Counter barrier: every rank adds 1 then waits for world_size."""

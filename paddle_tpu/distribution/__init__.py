@@ -1,0 +1,46 @@
+"""paddle.distribution parity (reference:
+python/paddle/distribution/__init__.py — 18 exported symbols)."""
+from .distribution import Distribution  # noqa: F401
+from .continuous import (  # noqa: F401
+    Beta,
+    Cauchy,
+    Dirichlet,
+    ExponentialFamily,
+    Gumbel,
+    Laplace,
+    LogNormal,
+    Normal,
+    Uniform,
+)
+from .discrete import Bernoulli, Categorical, Geometric, Multinomial  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .transform import (  # noqa: F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    Independent,
+    IndependentTransform,
+    PowerTransform,
+    ReshapeTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    StackTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    Transform,
+    TransformedDistribution,
+)
+
+__all__ = [
+    "Distribution", "ExponentialFamily",
+    "Normal", "LogNormal", "Uniform", "Laplace", "Cauchy", "Gumbel",
+    "Beta", "Dirichlet",
+    "Bernoulli", "Categorical", "Geometric", "Multinomial",
+    "Independent", "TransformedDistribution",
+    "kl_divergence", "register_kl",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]

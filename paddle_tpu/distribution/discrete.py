@@ -1,0 +1,229 @@
+"""Discrete distributions (reference: python/paddle/distribution/
+bernoulli.py, categorical.py, geometric.py, multinomial.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ops
+from ..ops import dispatch
+from ..ops.random import default_generator
+from ..tensor import Tensor
+from .continuous import _key_op
+from .distribution import Distribution
+
+__all__ = ["Bernoulli", "Categorical", "Geometric", "Multinomial"]
+
+_EPS = 1e-7
+
+
+def _clip_probs(p):
+    return ops.clip(p, min=_EPS, max=1.0 - _EPS)
+
+
+class Bernoulli(Distribution):
+    """reference bernoulli.py Bernoulli(probs)."""
+
+    def __init__(self, probs, name=None):
+        self.probs = self._to_tensor(probs)[0]
+        self.logits = ops.log(_clip_probs(self.probs)) - ops.log1p(-_clip_probs(self.probs))
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        full = self._extend_shape(shape)
+
+        def fn(key, p):
+            return jax.random.bernoulli(key, p, full).astype(p.dtype)
+
+        out = _key_op(fn, self.probs, op_name="bernoulli_sample")
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax relaxation (reference bernoulli.py rsample)."""
+        full = self._extend_shape(shape)
+
+        def fn(key, logits):
+            u = jax.random.uniform(key, full, logits.dtype, minval=_EPS,
+                                   maxval=1.0 - _EPS)
+            l_noise = jnp.log(u) - jnp.log1p(-u)
+            return jax.nn.sigmoid((logits + l_noise) / temperature)
+
+        return _key_op(fn, self.logits, op_name="bernoulli_rsample")
+
+    def log_prob(self, value):
+        value = self._to_tensor(value)[0]
+        p = _clip_probs(self.probs)
+        return value * ops.log(p) + (1.0 - value) * ops.log1p(-p)
+
+    def entropy(self):
+        p = _clip_probs(self.probs)
+        return -(p * ops.log(p) + (1.0 - p) * ops.log1p(-p))
+
+    def cdf(self, value):
+        value = self._to_tensor(value)[0]
+        zero = ops.zeros_like(self.probs)
+        one = ops.ones_like(self.probs)
+        mid = 1.0 - self.probs
+        return ops.where(value < 0.0, zero, ops.where(value < 1.0, mid, one))
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+
+class Categorical(Distribution):
+    """reference categorical.py Categorical(logits) — NB the reference takes
+    UNNORMALIZED category scores; probabilities = softmax."""
+
+    def __init__(self, logits, name=None):
+        self.logits = self._to_tensor(logits)[0]
+        super().__init__(tuple(self.logits.shape[:-1]))
+        self._n = self.logits.shape[-1]
+
+    @property
+    def probs_tensor(self):
+        from ..nn import functional as F
+
+        return F.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+
+        def fn(key, logits):
+            return jax.random.categorical(key, logits, axis=-1, shape=full or None)
+
+        out = _key_op(fn, self.logits, op_name="categorical_sample")
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        from ..nn import functional as F
+
+        value = self._to_tensor(value)[0]
+        logp = F.log_softmax(self.logits, axis=-1)
+        idx = ops.cast(value, "int64")
+        # broadcast the categories table against the value batch
+        if tuple(idx.shape) != tuple(logp.shape[:-1]):
+            logp = ops.broadcast_to(logp, list(idx.shape) + [self._n])
+        return ops.squeeze(ops.take_along_axis(logp, ops.unsqueeze(idx, -1), -1), -1)
+
+    def probs(self, value):
+        return ops.exp(self.log_prob(value))
+
+    def entropy(self):
+        from ..nn import functional as F
+
+        logp = F.log_softmax(self.logits, axis=-1)
+        return -ops.sum(ops.exp(logp) * logp, axis=-1)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+
+class Geometric(Distribution):
+    """reference geometric.py Geometric(probs): #failures before success,
+    support {0, 1, 2, ...}."""
+
+    def __init__(self, probs, name=None):
+        self.probs = self._to_tensor(probs)[0]
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return (1.0 - self.probs) / self.probs
+
+    @property
+    def variance(self):
+        return (1.0 - self.probs) / ops.square(self.probs)
+
+    @property
+    def stddev(self):
+        return ops.sqrt(self.variance)
+
+    def sample(self, shape=()):
+        full = self._extend_shape(shape)
+
+        def fn(key, p):
+            u = jax.random.uniform(key, full, p.dtype, minval=_EPS,
+                                   maxval=1.0 - _EPS)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+
+        out = _key_op(fn, self.probs, op_name="geometric_sample")
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        value = self._to_tensor(value)[0]
+        p = _clip_probs(self.probs)
+        return value * ops.log1p(-p) + ops.log(p)
+
+    def entropy(self):
+        p = _clip_probs(self.probs)
+        q = 1.0 - p
+        return -(q * ops.log(q) + p * ops.log(p)) / p
+
+    def cdf(self, value):
+        value = self._to_tensor(value)[0]
+        return 1.0 - ops.pow(1.0 - self.probs, value + 1.0)
+
+
+class Multinomial(Distribution):
+    """reference multinomial.py Multinomial(total_count, probs)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = self._to_tensor(probs)[0]
+        shape = tuple(self.probs.shape)
+        super().__init__(shape[:-1], shape[-1:])
+
+    @property
+    def mean(self):
+        return self.total_count * self.probs
+
+    @property
+    def variance(self):
+        return self.total_count * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        full = tuple(shape) + self._batch_shape
+        n = self.total_count
+
+        def fn(key, p):
+            logits = jnp.log(jnp.clip(p, _EPS))
+            draws = jax.random.categorical(
+                key, logits, axis=-1, shape=(n,) + full)
+            onehot = jax.nn.one_hot(draws, p.shape[-1], dtype=p.dtype)
+            return jnp.sum(onehot, axis=0)
+
+        out = _key_op(fn, self.probs, op_name="multinomial_sample")
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        value = self._to_tensor(value)[0]
+        logp = ops.log(_clip_probs(self.probs))
+        return (ops.lgamma(ops.full_like(ops.sum(value, axis=-1), self.total_count + 1.0))
+                - ops.sum(ops.lgamma(value + 1.0), axis=-1)
+                + ops.sum(value * logp, axis=-1))
+
+    def entropy(self):
+        # no closed form; Monte-Carlo estimate would be dishonest — reference
+        # computes via enumeration only for tiny supports, so raise like it
+        # does for unsupported cases.
+        raise NotImplementedError(
+            "Multinomial.entropy has no closed form; estimate via samples")

@@ -1,0 +1,83 @@
+"""Distribution base class (reference:
+python/paddle/distribution/distribution.py:33).
+
+TPU-native: parameters live as Tensors; sampling draws jax.random keys from
+the global generator (ops/random.py) so `paddle_tpu.seed` governs
+reproducibility, and every density/entropy expression is a differentiable
+traced op — usable inside ``jit.to_static`` programs.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import dispatch
+from ..ops._factory import ensure_tensor
+from ..tensor import Tensor
+
+__all__ = ["Distribution"]
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        """Non-differentiable draw (wraps rsample with stop_gradient)."""
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from .. import ops
+
+        return ops.exp(self.log_prob(value))
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    # -- helpers -----------------------------------------------------------
+    def _extend_shape(self, sample_shape):
+        return tuple(sample_shape) + self._batch_shape + self._event_shape
+
+    @staticmethod
+    def _to_tensor(*args):
+        """Broadcast scalars/arrays/Tensors to a common-shape Tensor tuple."""
+        ts = [ensure_tensor(a if not isinstance(a, (int, float)) else
+                            np.asarray(a, np.float32)) for a in args]
+        shape = np.broadcast_shapes(*[tuple(t.shape) for t in ts])
+        from .. import ops
+
+        return tuple(ops.broadcast_to(t, list(shape)) if tuple(t.shape) != shape else t
+                     for t in ts)

@@ -1,0 +1,143 @@
+"""KL divergence registry (reference: python/paddle/distribution/kl.py:37
+kl_divergence, :69 register_kl, :103 _dispatch — most-derived match over
+registered (type_p, type_q) pairs)."""
+from __future__ import annotations
+
+import math
+
+from .. import ops
+from .continuous import Beta, Cauchy, Dirichlet, Gumbel, Laplace, LogNormal, Normal, Uniform
+from .discrete import Bernoulli, Categorical, Geometric
+from .distribution import Distribution
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_REGISTRY: dict = {}
+
+
+def register_kl(cls_p, cls_q):
+    if not (issubclass(cls_p, Distribution) and issubclass(cls_q, Distribution)):
+        raise TypeError("cls_p and cls_q must be subclass of Distribution")
+
+    def decorator(f):
+        _REGISTRY[(cls_p, cls_q)] = f
+        return f
+
+    return decorator
+
+
+def _dispatch(type_p, type_q):
+    matches = [(p, q) for (p, q) in _REGISTRY
+               if issubclass(type_p, p) and issubclass(type_q, q)]
+    if not matches:
+        raise NotImplementedError(
+            f"kl_divergence({type_p.__name__}, {type_q.__name__}) is not "
+            f"registered")
+
+    # most-derived pair wins (total subclass-depth ordering, reference :106)
+    def depth(pair):
+        p, q = pair
+        return (type_p.__mro__.index(p), type_q.__mro__.index(q))
+
+    return _REGISTRY[min(matches, key=depth)]
+
+
+def kl_divergence(p, q):
+    return _dispatch(type(p), type(q))(p, q)
+
+
+# -- closed forms (reference kl.py registrations) ---------------------------
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = ops.square(p.scale / q.scale)
+    t1 = ops.square((p.loc - q.loc) / q.scale)
+    return 0.5 * (var_ratio + t1 - 1.0 - ops.log(var_ratio))
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return _kl_normal_normal(p._base, q._base)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    # support(p) must lie inside support(q); else KL = +inf
+    inside = ops.logical_and(q.low <= p.low, p.high <= q.high)
+    val = ops.log((q.high - q.low) / (p.high - p.low))
+    import numpy as np
+
+    return ops.where(inside, val, ops.full_like(val, np.inf))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    delta = ops.abs(p.loc - q.loc) / q.scale
+    term = scale_ratio * ops.exp(-ops.abs(p.loc - q.loc) / p.scale)
+    return -ops.log(scale_ratio) + scale_ratio + delta - 1.0 + (term - scale_ratio)
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    # KL = log(b2/b1) + γ(b1/b2 − 1) + (μ1−μ2)/b2
+    #      + exp((μ2−μ1)/b2)·Γ(1 + b1/b2) − 1
+    import jax.numpy as jnp
+    import jax.scipy.special as jss
+
+    from ..ops import dispatch as _d
+
+    euler = Gumbel._EULER
+
+    def fn(b1, b2, mu1, mu2):
+        ratio = b1 / b2
+        return (jnp.log(b2 / b1) + euler * (ratio - 1.0) + (mu1 - mu2) / b2
+                + jnp.exp((mu2 - mu1) / b2 + jss.gammaln(1.0 + ratio)) - 1.0)
+
+    return _d.apply(fn, p.scale, q.scale, p.loc, q.loc, op_name="kl_gumbel")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    sp = p.alpha + p.beta
+    return ((ops.lgamma(q.alpha) + ops.lgamma(q.beta) - ops.lgamma(q.alpha + q.beta))
+            - (ops.lgamma(p.alpha) + ops.lgamma(p.beta) - ops.lgamma(sp))
+            + (p.alpha - q.alpha) * ops.digamma(p.alpha)
+            + (p.beta - q.beta) * ops.digamma(p.beta)
+            + (q.alpha - p.alpha + q.beta - p.beta) * ops.digamma(sp))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    cp, cq = p.concentration, q.concentration
+    a0 = ops.sum(cp, axis=-1, keepdim=True)
+    return (ops.lgamma(ops.sum(cp, axis=-1)) - ops.lgamma(ops.sum(cq, axis=-1))
+            - ops.sum(ops.lgamma(cp) - ops.lgamma(cq), axis=-1)
+            + ops.sum((cp - cq) * (ops.digamma(cp) - ops.digamma(a0)), axis=-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    from .discrete import _clip_probs
+
+    pp, qq = _clip_probs(p.probs), _clip_probs(q.probs)
+    return (pp * (ops.log(pp) - ops.log(qq))
+            + (1.0 - pp) * (ops.log1p(-pp) - ops.log1p(-qq)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    from ..nn import functional as F
+
+    logp = F.log_softmax(p.logits, axis=-1)
+    logq = F.log_softmax(q.logits, axis=-1)
+    return ops.sum(ops.exp(logp) * (logp - logq), axis=-1)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    from .discrete import _clip_probs
+
+    pp, qq = _clip_probs(p.probs), _clip_probs(q.probs)
+    return (ops.log(pp) - ops.log(qq)
+            + (1.0 - pp) / pp * (ops.log1p(-pp) - ops.log1p(-qq)))

@@ -1,0 +1,333 @@
+"""Transforms + TransformedDistribution + Independent (reference:
+python/paddle/distribution/transform.py — Transform:60, AbsTransform,
+AffineTransform, ExpTransform, SigmoidTransform, SoftmaxTransform,
+TanhTransform, PowerTransform, ChainTransform, StackTransform,
+ReshapeTransform, IndependentTransform; transformed_distribution.py:17;
+independent.py:17)."""
+from __future__ import annotations
+
+import math
+
+from .. import ops
+from .distribution import Distribution
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "PowerTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+    "IndependentTransform", "ReshapeTransform",
+    "TransformedDistribution", "Independent",
+]
+
+
+class Transform:
+    """Bijection y = f(x) with log|det J| (reference transform.py:60)."""
+
+    _is_injective = True
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        return -self.forward_log_det_jacobian(self.inverse(y))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def __call__(self, x):
+        if isinstance(x, Distribution):
+            return TransformedDistribution(x, [self])
+        return self.forward(x)
+
+
+class AbsTransform(Transform):
+    _is_injective = False
+
+    def forward(self, x):
+        return ops.abs(x)
+
+    def inverse(self, y):
+        return y  # principal branch
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        from .distribution import Distribution as _D
+
+        self.loc, self.scale = _D._to_tensor(loc, scale)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return ops.broadcast_to(ops.log(ops.abs(self.scale)), list(x.shape))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return ops.exp(x)
+
+    def inverse(self, y):
+        return ops.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        from .distribution import Distribution as _D
+
+        self.power = _D._to_tensor(power)[0]
+
+    def forward(self, x):
+        return ops.pow(x, self.power)
+
+    def inverse(self, y):
+        return ops.pow(y, 1.0 / self.power)
+
+    def forward_log_det_jacobian(self, x):
+        return ops.log(ops.abs(self.power * ops.pow(x, self.power - 1.0)))
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return ops.sigmoid(x)
+
+    def inverse(self, y):
+        return ops.log(y) - ops.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        from ..nn import functional as F
+
+        return -F.softplus(-x) - F.softplus(x)
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return ops.tanh(x)
+
+    def inverse(self, y):
+        return 0.5 * (ops.log1p(y) - ops.log1p(-y))
+
+    def forward_log_det_jacobian(self, x):
+        from ..nn import functional as F
+
+        # log(1 - tanh(x)^2) = 2(log 2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - F.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _is_injective = False
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        return F.softmax(x, axis=-1)
+
+    def inverse(self, y):
+        return ops.log(y)
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} → simplex^K (reference transform.py StickBreakingTransform)."""
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops import dispatch
+
+        def fn(a):
+            offset = jnp.arange(a.shape[-1], 0, -1, dtype=a.dtype)
+            z = jax.nn.sigmoid(a - jnp.log(offset))
+            zcp = jnp.cumprod(1 - z, axis=-1)
+            pad = jnp.ones(a.shape[:-1] + (1,), a.dtype)
+            return jnp.concatenate([z, pad], -1) * jnp.concatenate([pad, zcp], -1)
+
+        return dispatch.apply(fn, x, op_name="stick_breaking")
+
+    def inverse(self, y):
+        import jax.numpy as jnp
+
+        from ..ops import dispatch
+
+        def fn(b):
+            k = b.shape[-1] - 1
+            offset = jnp.arange(k, 0, -1, dtype=b.dtype)
+            zcp = 1 - jnp.cumsum(b[..., :-1], axis=-1)
+            shifted = jnp.concatenate(
+                [jnp.ones(b.shape[:-1] + (1,), b.dtype), zcp[..., :-1]], -1)
+            z = b[..., :-1] / shifted
+            return jnp.log(z / (1 - z)) + jnp.log(offset)
+
+        return dispatch.apply(fn, y, op_name="stick_breaking_inv")
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else total + ld
+            x = t.forward(x)
+        return total
+
+
+class StackTransform(Transform):
+    """Apply the i-th transform to the i-th slice along `axis`."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, method, x):
+        parts = ops.unbind(x, self.axis)
+        outs = [getattr(t, method)(p) for t, p in zip(self.transforms, parts)]
+        return ops.stack(outs, self.axis)
+
+    def forward(self, x):
+        return self._map("forward", x)
+
+    def inverse(self, y):
+        return self._map("inverse", y)
+
+    def forward_log_det_jacobian(self, x):
+        return self._map("forward_log_det_jacobian", x)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def forward(self, x):
+        batch = list(x.shape[: x.ndim - len(self.in_event_shape)])
+        return ops.reshape(x, batch + list(self.out_event_shape))
+
+    def inverse(self, y):
+        batch = list(y.shape[: y.ndim - len(self.out_event_shape)])
+        return ops.reshape(y, batch + list(self.in_event_shape))
+
+    def forward_log_det_jacobian(self, x):
+        batch = list(x.shape[: x.ndim - len(self.in_event_shape)])
+        return ops.zeros(batch, dtype=x.dtype)
+
+
+class IndependentTransform(Transform):
+    """Promote the rightmost batch dims of a base transform to event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def forward(self, x):
+        return self.base.forward(x)
+
+    def inverse(self, y):
+        return self.base.inverse(y)
+
+    def forward_log_det_jacobian(self, x):
+        ld = self.base.forward_log_det_jacobian(x)
+        for _ in range(self.rank):
+            ld = ops.sum(ld, axis=-1)
+        return ld
+
+
+class TransformedDistribution(Distribution):
+    """reference transformed_distribution.py:17."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = (list(transforms) if isinstance(transforms, (list, tuple))
+                           else [transforms])
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def sample(self, shape=()):
+        out = self.rsample(shape)
+        out.stop_gradient = True
+        return out
+
+    def log_prob(self, value):
+        lp = None
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ld = t.forward_log_det_jacobian(x)
+            lp = ld if lp is None else lp + ld
+            y = x
+        return self.base.log_prob(y) - lp
+
+
+class Independent(Distribution):
+    """reference independent.py:17 — reinterpret rightmost batch dims as
+    event dims (log_prob sums over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[: len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def _sum_rightmost(self, x):
+        for _ in range(self.rank):
+            x = ops.sum(x, axis=-1)
+        return x
+
+    def log_prob(self, value):
+        return self._sum_rightmost(self.base.log_prob(value))
+
+    def entropy(self):
+        return self._sum_rightmost(self.base.entropy())

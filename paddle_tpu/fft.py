@@ -1,0 +1,199 @@
+"""Discrete Fourier transforms (reference: python/paddle/fft.py, e.g.
+``fft`` at :167 → fft_c2c; the reference lowers to cuFFT/mkl kernels at
+paddle/phi/kernels/funcs/fft.h).
+
+TPU-native: every transform is a differentiable jnp.fft lowering dispatched
+through the eager tape — jax's FFT VJPs replace the reference's handwritten
+fft_grad kernels, and under ``jit.to_static`` they fuse into the XLA
+program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .ops import dispatch
+from .ops._factory import ensure_tensor
+from .tensor import Tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(
+            f"Unexpected norm: {norm!r}. Norm should be 'forward', 'backward' "
+            f"or 'ortho'")
+    return norm
+
+
+def _apply1(fn_name, x, n, axis, norm, *, op_name, to_complex=False):
+    _check_norm(norm)
+    x = ensure_tensor(x)
+    raw_fn = getattr(jnp.fft, fn_name)
+
+    def fn(a):
+        if to_complex and not jnp.iscomplexobj(a):
+            a = a.astype(jnp.complex64 if a.dtype != jnp.float64 else jnp.complex128)
+        return raw_fn(a, n=n, axis=axis, norm=norm)
+
+    return dispatch.apply(fn, x, op_name=op_name)
+
+
+def _applyn(fn_name, x, s, axes, norm, *, op_name, to_complex=False):
+    _check_norm(norm)
+    x = ensure_tensor(x)
+    raw_fn = getattr(jnp.fft, fn_name)
+
+    def fn(a):
+        if to_complex and not jnp.iscomplexobj(a):
+            a = a.astype(jnp.complex64 if a.dtype != jnp.float64 else jnp.complex128)
+        return raw_fn(a, s=s, axes=axes, norm=norm)
+
+    return dispatch.apply(fn, x, op_name=op_name)
+
+
+# -- 1-D ---------------------------------------------------------------------
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    """Complex-to-complex 1-D DFT (reference python/paddle/fft.py:167)."""
+    return _apply1("fft", x, n, axis, norm, op_name="fft", to_complex=True)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _apply1("ifft", x, n, axis, norm, op_name="ifft", to_complex=True)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _apply1("rfft", x, n, axis, norm, op_name="rfft")
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _apply1("irfft", x, n, axis, norm, op_name="irfft", to_complex=True)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _apply1("hfft", x, n, axis, norm, op_name="hfft", to_complex=True)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _apply1("ihfft", x, n, axis, norm, op_name="ihfft")
+
+
+# -- 2-D ---------------------------------------------------------------------
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _applyn("fft2", x, s, axes, norm, op_name="fft2", to_complex=True)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _applyn("ifft2", x, s, axes, norm, op_name="ifft2", to_complex=True)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _applyn("rfft2", x, s, axes, norm, op_name="rfft2")
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _applyn("irfft2", x, s, axes, norm, op_name="irfft2", to_complex=True)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_norm(norm)
+    x = ensure_tensor(x)
+
+    def fn(a):
+        if not jnp.iscomplexobj(a):
+            a = a.astype(jnp.complex64 if a.dtype != jnp.float64 else jnp.complex128)
+        # hfft over the last axis of `axes`, plain ifft over the rest
+        a = jnp.fft.ifftn(a, s=None if s is None else s[:-1], axes=axes[:-1],
+                          norm=norm)
+        n_last = None if s is None else s[-1]
+        return jnp.fft.hfft(a, n=n_last, axis=axes[-1], norm=norm)
+
+    return dispatch.apply(fn, x, op_name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    _check_norm(norm)
+    x = ensure_tensor(x)
+
+    def fn(a):
+        n_last = None if s is None else s[-1]
+        a = jnp.fft.ihfft(a, n=n_last, axis=axes[-1], norm=norm)
+        return jnp.fft.fftn(a, s=None if s is None else s[:-1], axes=axes[:-1],
+                            norm=norm)
+
+    return dispatch.apply(fn, x, op_name="ihfft2")
+
+
+# -- N-D ---------------------------------------------------------------------
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _applyn("fftn", x, s, axes, norm, op_name="fftn", to_complex=True)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _applyn("ifftn", x, s, axes, norm, op_name="ifftn", to_complex=True)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _applyn("rfftn", x, s, axes, norm, op_name="rfftn")
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _applyn("irfftn", x, s, axes, norm, op_name="irfftn", to_complex=True)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    ax = tuple(range(nd)) if axes is None else tuple(axes)
+    return hfft2(x, s, ax, norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    x = ensure_tensor(x)
+    nd = x.ndim
+    ax = tuple(range(nd)) if axes is None else tuple(axes)
+    return ihfft2(x, s, ax, norm)
+
+
+# -- helpers -----------------------------------------------------------------
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    """Sample frequencies for fft output bins (reference fft.py:1236)."""
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .core.dtype import to_jax_dtype
+
+        out = out.astype(to_jax_dtype(dtype))
+    return Tensor(out, stop_gradient=True)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .core.dtype import to_jax_dtype
+
+        out = out.astype(to_jax_dtype(dtype))
+    return Tensor(out, stop_gradient=True)
+
+
+def fftshift(x, axes=None, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.fft.fftshift(a, axes=axes), x,
+                          op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(lambda a: jnp.fft.ifftshift(a, axes=axes), x,
+                          op_name="ifftshift")

@@ -81,6 +81,9 @@ class Model:
             c.on_train_begin()
         history = []
         it = 0
+        # num_iters ends the WHOLE fit, not just the current epoch
+        # (reference hapi/model.py:2364 sets stop_training)
+        stop = False
         for epoch in range(epochs):
             for c in cbs:
                 c.on_epoch_begin(epoch)
@@ -94,8 +97,13 @@ class Model:
                     c.on_train_batch_end(step, {"loss": lv})
                 it += 1
                 if num_iters is not None and it >= num_iters:
+                    stop = True
                     break
             logs = {"loss": history[-1] if history else float("nan")}
+            if stop:
+                for c in cbs:
+                    c.on_epoch_end(epoch, logs)
+                break
             if eval_data is not None and epoch % eval_freq == 0:
                 logs.update(self.evaluate(eval_data, verbose=0))
                 for c in cbs:
@@ -112,23 +120,27 @@ class Model:
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):
+        was_training = getattr(self.network, "training", True)
         self.network.eval()
-        losses, n_correct, n_total = [], 0, 0
+        losses = []
         for batch in eval_data:
             l, out = self._eval_step(*_to_tensors(batch))
             losses.append(float(l))
-        self.network.train()
+        if was_training:
+            self.network.train()
         res = {"eval_loss": float(np.mean(losses)) if losses else float("nan")}
         return res
 
     def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
                 verbose=1, callbacks=None):
+        was_training = getattr(self.network, "training", True)
         self.network.eval()
         outs = []
         for batch in test_data:
             with _ops.no_grad():
                 outs.append(self.network(*_to_tensors(batch)))
-        self.network.train()
+        if was_training:
+            self.network.train()
         return outs
 
     def save(self, path, training=True):
@@ -160,3 +172,51 @@ class Model:
 
     def parameters(self, *a, **k):
         return self.network.parameters(*a, **k)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    """Free-function parameter summary (reference python/paddle/hapi/
+    model_summary.py summary)."""
+    total, trainable = 0, 0
+    lines = []
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape))
+        total += n
+        if getattr(p, "trainable", True):
+            trainable += n
+        lines.append(f"  {name:40s} {str(p.shape):20s} {n}")
+    print("\n".join(lines))
+    print(f"Total params: {total}\nTrainable params: {trainable}")
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Analytic FLOPs estimate by layer walk (reference
+    python/paddle/hapi/dynamic_flops.py flops). Counts the MXU-relevant
+    layers (Linear/Conv2D) exactly and treats elementwise layers as free,
+    mirroring the reference's per-op hooks."""
+    from ..nn.modules.common import Linear
+    total = [0]
+    batch = input_size[0] if input_size else 1
+
+    def walk(layer):
+        for sub in getattr(layer, "_sub_layers", {}).values():
+            walk(sub)
+        if isinstance(layer, Linear):
+            w = layer.weight
+            total[0] += 2 * batch * int(np.prod(w.shape))
+        conv_w = getattr(layer, "weight", None)
+        if layer.__class__.__name__.startswith("Conv") and conv_w is not None:
+            # conv flops need the spatial output size; approximate with the
+            # input spatial size (stride-1 full-padding upper bound)
+            spatial = int(np.prod(input_size[2:])) if input_size and len(input_size) > 2 else 1
+            total[0] += 2 * batch * int(np.prod(conv_w.shape)) * spatial
+        if custom_ops:
+            fn = custom_ops.get(type(layer))
+            if fn:
+                total[0] += int(fn(layer, input_size))
+
+    walk(net)
+    if print_detail:
+        print(f"FLOPs: {total[0]}")
+    return total[0]

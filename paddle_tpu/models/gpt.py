@@ -73,7 +73,10 @@ class GPTConfig:
     use_tensor_parallel: bool = False   # mpu layers over the 'mp' axis
     sequence_parallel: bool = False     # shard activations over 'sp'
     recompute_interval: int = 0         # 0 = off; k = remat every k blocks
-    use_flash_attention: bool = False   # route SDPA through the pallas kernel
+    # Tri-state SDPA routing: None = defer to FLAGS_use_pallas_flash_attention
+    # (default), True = force the pallas kernel (when shape-eligible),
+    # False = force the plain XLA expression.
+    use_flash_attention: Optional[bool] = None
 
     @property
     def ffn_size(self) -> int:
@@ -177,13 +180,24 @@ class GPTAttention(Layer):
         q = ops.squeeze(ops.slice(qkv, [2], [0], [1]), 2)   # [B, S, nh, hd]
         k = ops.squeeze(ops.slice(qkv, [2], [1], [2]), 2)
         v = ops.squeeze(ops.slice(qkv, [2], [2], [3]), 2)
-        out = F.scaled_dot_product_attention(
-            q, k, v,
-            attn_mask=attn_mask,
-            dropout_p=cfg.attention_dropout,
-            is_causal=attn_mask is None,
-            training=self.training,
-        )                                                   # [B, S, nh, hd]
+        # sequence-parallel causal attention runs as a ring over 'sp'
+        # (K/V rotate via ppermute; online-softmax merge) — the S axis stays
+        # sharded instead of being all-gathered for the score matmul
+        if (cfg.sequence_parallel and attn_mask is None
+                and cfg.attention_dropout == 0.0
+                and _mesh.has_mesh() and _mesh.axis_size("sp") > 1):
+            from ..nn.functional.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, causal=True)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v,
+                attn_mask=attn_mask,
+                dropout_p=cfg.attention_dropout,
+                is_causal=attn_mask is None,
+                training=self.training,
+                use_flash=cfg.use_flash_attention,
+            )                                               # [B, S, nh, hd]
         out = ops.reshape(out, [b, s, nh * hd])
         out = self.out_proj(out)
         return self.dropout(out)

@@ -58,8 +58,12 @@ def _flash_eligible(q_shape, dropout_p, mask):
 
 
 def scaled_dot_product_attention(
-    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True, name=None
+    query, key, value, attn_mask=None, dropout_p=0.0, is_causal=False, training=True,
+    name=None, use_flash=None,
 ):
+    """``use_flash``: None = FLAGS_use_pallas_flash_attention decides (default);
+    True/False = explicit per-call routing (still subject to shape
+    eligibility — the Pallas kernel has block/lane constraints)."""
     query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
     mask_t = ensure_tensor(attn_mask) if attn_mask is not None else None
 
@@ -71,9 +75,10 @@ def scaled_dot_product_attention(
     else:
         dropout_p = 0.0
 
+    if use_flash is None:
+        use_flash = _flags.flag("FLAGS_use_pallas_flash_attention")
     use_flash = (
-        _flags.flag("FLAGS_use_pallas_flash_attention")
-        and _flash_eligible(tuple(query._value.shape), dropout_p, mask_t)
+        use_flash and _flash_eligible(tuple(query._value.shape), dropout_p, mask_t)
     )
     if use_flash:
         try:

@@ -6,9 +6,10 @@ onto the Tensor type at import time).
 """
 from __future__ import annotations
 
-from . import creation, dispatch, linalg, logic, manipulation, math, random, reduction, search
+from . import attribute, creation, dispatch, linalg, logic, manipulation, math, random, reduction, search
 from .dispatch import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 
+from .attribute import *  # noqa: F401,F403
 from .creation import *  # noqa: F401,F403
 from .linalg import (  # noqa: F401
     bincount,
@@ -32,20 +33,25 @@ from .linalg import (  # noqa: F401
     inverse,
     kron,
     lstsq,
+    lu,
+    lu_unpack,
     matmul,
     matrix_power,
     matrix_rank,
     matrix_transpose,
     mm,
     multi_dot,
+    mv,
     norm,
     outer,
+    pca_lowrank,
     pinv,
     qr,
     slogdet,
     solve,
     svd,
     t,
+    tensordot,
     triangular_solve,
 )
 from .logic import *  # noqa: F401,F403
@@ -56,12 +62,16 @@ from .manipulation import (  # noqa: F401
     cast,
     chunk,
     concat,
+    crop,
+    dsplit,
     expand,
     expand_as,
     flatten,
+    flatten_,
     flip,
     gather,
     gather_nd,
+    hsplit,
     index_add,
     index_sample,
     index_select,
@@ -71,25 +81,32 @@ from .manipulation import (  # noqa: F401
     repeat_interleave,
     reshape,
     reshape_,
+    reverse,
     roll,
     rot90,
     scatter,
+    scatter_,
     scatter_nd,
     scatter_nd_add,
     shard_index,
     slice,
     split,
     squeeze,
+    squeeze_,
     stack,
+    strided_slice,
     swapaxes,
     take_along_axis,
     tile,
     transpose,
     unbind,
+    unflatten,
     unique,
     unique_consecutive,
     unstack,
     unsqueeze,
+    unsqueeze_,
+    vsplit,
 )
 from .math import *  # noqa: F401,F403
 from .random import (  # noqa: F401
@@ -175,14 +192,23 @@ def _patch():
         "std", "median", "quantile", "amax", "amin",
         # linalg
         "matmul", "mm", "bmm", "dot", "norm", "dist", "t", "inner", "outer",
-        "cholesky", "inverse", "det",
+        "cholesky", "inverse", "det", "mv", "tensordot", "lu", "trace",
+        "diagonal",
+        # attribute / complex
+        "real", "imag", "conj", "angle", "rank",
+        # long-tail math
+        "addmm", "cdist", "trapezoid", "cumulative_trapezoid", "frexp",
+        "ldexp", "i0", "i0e", "i1", "i1e", "polygamma", "logcumsumexp",
+        "sgn", "renorm", "vander", "take", "as_complex", "as_real",
         # manipulation
         "reshape", "reshape_", "flatten", "squeeze", "unsqueeze", "transpose",
         "concat", "split", "chunk", "tile", "expand", "expand_as",
         "broadcast_to", "flip", "roll", "gather", "gather_nd", "scatter",
         "index_select", "index_sample", "index_add", "take_along_axis",
         "put_along_axis", "unbind", "unique", "repeat_interleave", "moveaxis",
-        "swapaxes", "numel",
+        "swapaxes", "numel", "crop", "strided_slice", "unflatten", "vsplit",
+        "hsplit", "dsplit", "reverse", "squeeze_", "unsqueeze_", "scatter_",
+        "flatten_",
         # logic
         "equal", "not_equal", "greater_than", "greater_equal", "less_than",
         "less_equal", "logical_and", "logical_or", "logical_xor",

@@ -1,0 +1,157 @@
+"""Tensor attribute / introspection API (reference:
+python/paddle/tensor/attribute.py — shape, rank, is_complex:62,
+is_floating_point:139, is_integer:172, real/imag; framework dtype helpers
+python/paddle/framework/framework.py set_default_dtype:34,
+finfo/iinfo pybind.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dtype_mod
+from ..tensor import Tensor
+from . import dispatch
+from ._factory import ensure_tensor
+
+__all__ = [
+    "shape", "rank", "is_complex", "is_floating_point", "is_integer",
+    "real", "imag", "conj", "angle", "broadcast_shape", "finfo", "iinfo",
+    "get_default_dtype", "set_default_dtype", "set_printoptions",
+    "is_tensor", "check_shape", "tolist",
+]
+
+_default_dtype = "float32"
+
+
+def set_default_dtype(d):
+    """Reference framework.py:34 — global dtype for float-typed creation ops."""
+    global _default_dtype
+    d = _dtype_mod.convert_dtype(d).name
+    if d not in ("float16", "bfloat16", "float32", "float64"):
+        raise TypeError(f"set_default_dtype only supports float types, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> str:
+    return _default_dtype
+
+
+def shape(input, name=None):  # noqa: A002
+    """Shape as an int32 tensor (reference attribute.py shape — an op, not a
+    python list, so it is usable inside traced programs)."""
+    input = ensure_tensor(input)
+    return Tensor(jnp.asarray(input.shape, dtype=jnp.int32), stop_gradient=True)
+
+
+def rank(input, name=None):  # noqa: A002
+    input = ensure_tensor(input)
+    return Tensor(jnp.asarray(input.ndim, dtype=jnp.int32), stop_gradient=True)
+
+
+def is_complex(x) -> bool:
+    x = ensure_tensor(x)
+    return jnp.issubdtype(x._value.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x) -> bool:
+    x = ensure_tensor(x)
+    return jnp.issubdtype(x._value.dtype, jnp.floating)
+
+
+def is_integer(x) -> bool:
+    x = ensure_tensor(x)
+    return jnp.issubdtype(x._value.dtype, jnp.integer)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def tolist(x):
+    """Nested python list of the tensor's values (reference
+    tensor/manipulation.py tolist)."""
+    return ensure_tensor(x).tolist()
+
+
+def real(x, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(jnp.real, x, op_name="real")
+
+
+def imag(x, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(jnp.imag, x, op_name="imag")
+
+
+def conj(x, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(jnp.conj, x, op_name="conj")
+
+
+def angle(x, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(jnp.angle, x, op_name="angle")
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Static broadcast-shape computation (reference attribute-free helper)."""
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+class _FInfo:
+    def __init__(self, info):
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(getattr(info, "resolution", info.eps))
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+
+class _IInfo:
+    def __init__(self, info):
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+
+def finfo(dtype):
+    return _FInfo(jnp.finfo(_dtype_mod.to_jax_dtype(dtype)))
+
+
+def iinfo(dtype):
+    return _IInfo(jnp.iinfo(_dtype_mod.to_jax_dtype(dtype)))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Numpy-backed printing (reference tensor/to_string.py knobs)."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def check_shape(shape):
+    """Validate a shape argument (reference static check_shape): ints >= -1,
+    at most one -1."""
+    shape = list(shape)
+    if sum(1 for s in shape if s == -1) > 1:
+        raise ValueError(f"shape can contain at most one -1, got {shape}")
+    for s in shape:
+        if not isinstance(s, (int, np.integer)) or s < -1:
+            raise ValueError(f"invalid dim {s!r} in shape {shape}")
+    return shape

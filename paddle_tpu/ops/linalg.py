@@ -295,3 +295,87 @@ def bincount(x, weights=None, minlength=0, name=None):
 
 def matrix_transpose(x, name=None):
     return transpose_last(x)
+
+
+def mv(x, vec, name=None):
+    """Matrix-vector product (reference linalg.py mv)."""
+    x, vec = ensure_tensor(x), ensure_tensor(vec)
+    return dispatch.apply(jnp.matmul, x, vec, op_name="mv")
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    if isinstance(axes, Tensor):
+        axes = axes.numpy().tolist()
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a.numpy().tolist()) if isinstance(a, Tensor)
+                     else (tuple(a) if isinstance(a, (list, tuple)) else a)
+                     for a in axes)
+        if len(axes) == 1:
+            axes = (axes[0], axes[0])
+    return dispatch.apply(
+        lambda a, b: jnp.tensordot(a, b, axes=axes), x, y, op_name="tensordot")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization (reference linalg.py lu → phi lu kernel). Returns
+    (LU_packed, pivots[, infos]); pivots follow the reference's 1-based
+    convention."""
+    x = ensure_tensor(x)
+
+    import jax.scipy.linalg as jsl
+
+    def packed(a):
+        lu_fact, piv = jsl.lu_factor(a)
+        return lu_fact, (piv + 1).astype(jnp.int32)
+
+    out = dispatch.apply(packed, x, op_name="lu")
+    lu_packed, piv = out
+    if get_infos:
+        infos = Tensor(jnp.zeros(x.shape[:-2] or (1,), jnp.int32))
+        return lu_packed, piv, infos
+    return lu_packed, piv
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu()'s output into (P, L, U) (reference linalg.py lu_unpack)."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    m = x.shape[-2]
+
+    def fn(lu_packed, piv):
+        k = min(lu_packed.shape[-2], lu_packed.shape[-1])
+        L = jnp.tril(lu_packed, -1)[..., :, :k] + jnp.eye(
+            lu_packed.shape[-2], k, dtype=lu_packed.dtype)
+        U = jnp.triu(lu_packed)[..., :k, :]
+        # pivots (1-based sequential swaps) → permutation matrix
+        perm = jnp.arange(m)
+        piv0 = piv - 1
+
+        def body(i, p):
+            j = piv0[..., i]
+            pi, pj = p[i], p[j]
+            p = p.at[i].set(pj)
+            return p.at[j].set(pi)
+
+        for i in range(piv0.shape[-1]):  # static unroll (k is small/static)
+            perm = body(i, perm)
+        P = jnp.eye(m, dtype=lu_packed.dtype)[perm].T
+        return P, L, U
+
+    return dispatch.apply(fn, x, y, op_name="lu_unpack")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Low-rank PCA via randomized SVD on x - mean (reference linalg.py
+    pca_lowrank). Returns (U, S, V)."""
+    x = ensure_tensor(x)
+    m, n = x.shape[-2], x.shape[-1]
+    q = q if q is not None else min(6, m, n)
+
+    def fn(a):
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :q], s[..., :q], jnp.swapaxes(vt, -1, -2)[..., :q]
+
+    return dispatch.apply(fn, x, op_name="pca_lowrank")

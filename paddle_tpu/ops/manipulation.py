@@ -405,3 +405,116 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: 
         return jnp.where(inside, a - lo, ignore_value)
 
     return dispatch.apply_nondiff(fn, input)
+
+
+# ---------------------------------------------------------------------------
+# long-tail manipulation (reference python/paddle/tensor/manipulation.py:
+# crop:848, strided_slice:4784, unflatten:5071, vsplit (array-split family),
+# reverse = flip alias, take_along_axis variants; inplace twins follow the
+# reference's `<op>_` convention)
+# ---------------------------------------------------------------------------
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = ensure_tensor(x)
+    if shape is None:
+        shape = list(x.shape)
+    shape = [int(getattr(s, "item", lambda: s)()) if not isinstance(s, int) else s
+             for s in (shape.numpy().tolist() if isinstance(shape, Tensor) else list(shape))]
+    if offsets is None:
+        offsets = [0] * len(shape)
+    offsets = (offsets.numpy().tolist() if isinstance(offsets, Tensor)
+               else list(offsets))
+    shape = [x.shape[i] - offsets[i] if s == -1 else s for i, s in enumerate(shape)]
+
+    def fn(a):
+        return jax.lax.slice(a, offsets, [o + s for o, s in zip(offsets, shape)])
+
+    return dispatch.apply(fn, x, op_name="crop")
+
+
+def reverse(x, axis, name=None):
+    return flip(x, axis)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    import builtins
+
+    x = ensure_tensor(x)
+
+    def fn(a):
+        # builtins.slice — the paddle `slice` op shadows the name here
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(st, en, sd)
+        return a[tuple(idx)]
+
+    return dispatch.apply(fn, x, op_name="strided_slice")
+
+
+def unflatten(x, axis, shape, name=None):
+    x = ensure_tensor(x)
+    shape = (shape.numpy().tolist() if isinstance(shape, Tensor) else list(shape))
+    ax = axis if axis >= 0 else axis + x.ndim
+    new_shape = list(x.shape[:ax]) + list(shape) + list(x.shape[ax + 1:])
+    return reshape(x, new_shape)
+
+
+def vsplit(x, num_or_indices, name=None):
+    x = ensure_tensor(x)
+    if x.ndim < 2:
+        raise ValueError(f"vsplit expects ndim >= 2, got {x.ndim}")
+    if isinstance(num_or_indices, int):
+        return split(x, num_or_indices, axis=0)
+    return split(x, [num_or_indices[0]] +
+                 [b - a for a, b in zip(num_or_indices, num_or_indices[1:])] +
+                 [x.shape[0] - num_or_indices[-1]], axis=0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    x = ensure_tensor(x)
+    axis = 0 if x.ndim == 1 else 1
+    if isinstance(num_or_indices, int):
+        return split(x, num_or_indices, axis=axis)
+    return split(x, [num_or_indices[0]] +
+                 [b - a for a, b in zip(num_or_indices, num_or_indices[1:])] +
+                 [x.shape[axis] - num_or_indices[-1]], axis=axis)
+
+
+def dsplit(x, num_or_indices, name=None):
+    x = ensure_tensor(x)
+    if x.ndim < 3:
+        raise ValueError(f"dsplit expects ndim >= 3, got {x.ndim}")
+    if isinstance(num_or_indices, int):
+        return split(x, num_or_indices, axis=2)
+    return split(x, [num_or_indices[0]] +
+                 [b - a for a, b in zip(num_or_indices, num_or_indices[1:])] +
+                 [x.shape[2] - num_or_indices[-1]], axis=2)
+
+
+def _inplace_from(x, out):
+    x._set_value(out._value)
+    x._grad_node = out._grad_node
+    x._output_index = out._output_index
+    if out._grad_node is not None:
+        x.stop_gradient = False
+    return x
+
+
+def squeeze_(x, axis=None, name=None):
+    return _inplace_from(x, squeeze(x, axis))
+
+
+def unsqueeze_(x, axis, name=None):
+    return _inplace_from(x, unsqueeze(x, axis))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):  # noqa: A002
+    return _inplace_from(x, scatter(x, index, updates, overwrite))
+
+
+def reshape__(x, shape, name=None):
+    return _inplace_from(x, reshape(x, shape))
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return _inplace_from(x, flatten(x, start_axis, stop_axis))

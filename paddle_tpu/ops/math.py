@@ -273,3 +273,248 @@ ceil_ = _make_inplace("ceil")
 round_ = _make_inplace("round")
 reciprocal_ = _make_inplace("reciprocal")
 tanh_ = _make_inplace("tanh")
+
+
+# ---------------------------------------------------------------------------
+# long-tail math (reference python/paddle/tensor/math.py: addmm:1979,
+# trace:2439, diagonal:2539, trapezoid:5473, frexp:5584, ldexp:5733,
+# polygamma:5377, logcumsumexp:3513, sgn:4993, renorm:2202, vander:5519,
+# increment:2905; complex helpers as_complex/as_real/polar
+# python/paddle/tensor/creation.py:2464)
+# ---------------------------------------------------------------------------
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    input, x, y = ensure_tensor(input), ensure_tensor(x), ensure_tensor(y)
+    return dispatch.apply(
+        lambda i, a, b: beta * i + alpha * jnp.matmul(a, b),
+        input, x, y, op_name="addmm")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+        x, op_name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+        x, op_name="diagonal")
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    """Pairwise p-distance between row batches ([..., M, D] × [..., N, D] →
+    [..., M, N]).  The p=2 path is the MXU-friendly |x|²+|y|²-2xy form."""
+    x, y = ensure_tensor(x), ensure_tensor(y)
+
+    def _safe_sqrt(sq):
+        # double-where: subgradient 0 (not inf) where the distance is 0
+        pos = sq > 0
+        return jnp.where(pos, jnp.sqrt(jnp.where(pos, sq, 1.0)), 0.0)
+
+    def fn(a, b):
+        # mm-based euclid form loses ~1e-3 to cancellation in fp32, so (like
+        # the reference/torch *_if_necessary mode) only use it when the
+        # direct-difference tensor would be large
+        big = a.shape[-2] > 25 or b.shape[-2] > 25
+        if p == 2.0 and (compute_mode == "use_mm_for_euclid_dist"
+                         or ("if_necessary" in compute_mode and big)):
+            a2 = jnp.sum(a * a, -1, keepdims=True)          # [..., M, 1]
+            b2 = jnp.sum(b * b, -1, keepdims=True)          # [..., N, 1]
+            sq = a2 + jnp.swapaxes(b2, -1, -2) - 2 * jnp.matmul(a, jnp.swapaxes(b, -1, -2))
+            return _safe_sqrt(jnp.maximum(sq, 0.0))
+        diff = jnp.abs(a[..., :, None, :] - b[..., None, :, :])
+        if p == 0:
+            return jnp.sum((diff != 0).astype(a.dtype), -1)
+        if jnp.isinf(p):
+            return jnp.max(diff, -1)
+        if p == 2.0:
+            return _safe_sqrt(jnp.sum(diff * diff, -1))
+        pos = diff > 0
+        safe = jnp.where(pos, diff, 1.0)
+        return jnp.sum(jnp.where(pos, safe ** p, 0.0), -1) ** (1.0 / p)
+
+    return dispatch.apply(fn, x, y, op_name="cdist")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+    if x is not None:
+        x = ensure_tensor(x)
+        return dispatch.apply(
+            lambda yy, xx: jnp.trapezoid(yy, x=xx, axis=axis), y, x,
+            op_name="trapezoid")
+    return dispatch.apply(
+        lambda yy: jnp.trapezoid(yy, dx=1.0 if dx is None else dx, axis=axis),
+        y, op_name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = ensure_tensor(y)
+
+    def _cumtrapz(yy, xx=None):
+        y1 = jnp.moveaxis(yy, axis, -1)
+        if xx is not None:
+            d = jnp.diff(jnp.moveaxis(xx, axis, -1) if xx.ndim == yy.ndim else xx, axis=-1)
+        else:
+            d = 1.0 if dx is None else dx
+        avg = (y1[..., 1:] + y1[..., :-1]) / 2.0
+        out = jnp.cumsum(avg * d, axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+
+    if x is not None:
+        x = ensure_tensor(x)
+        return dispatch.apply(lambda yy, xx: _cumtrapz(yy, xx), y, x,
+                              op_name="cumulative_trapezoid")
+    return dispatch.apply(_cumtrapz, y, op_name="cumulative_trapezoid")
+
+
+def frexp(x, name=None):
+    """Decompose into mantissa ∈ [0.5, 1) and integer exponent (both returned
+    as float tensors, reference math.py:5584)."""
+    x = ensure_tensor(x)
+
+    def fn(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(a.dtype)
+
+    return dispatch.apply(fn, x, op_name="frexp")
+
+
+def ldexp(x, y, name=None):
+    x, y = ensure_tensor(x), ensure_tensor(y)
+    return dispatch.apply(
+        lambda a, b: (a * jnp.exp2(b.astype(a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32))).astype(
+            a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32),
+        x, y, op_name="ldexp")
+
+
+def i0e(x, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(jax.scipy.special.i0e, x, op_name="i0e")
+
+
+def i1e(x, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(jax.scipy.special.i1e, x, op_name="i1e")
+
+
+def i0(x, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(jax.scipy.special.i0, x, op_name="i0")
+
+
+def i1(x, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(jax.scipy.special.i1, x, op_name="i1")
+
+
+def polygamma(x, n, name=None):
+    x = ensure_tensor(x)
+    if n == 0:
+        return dispatch.apply(jax.scipy.special.digamma, x, op_name="polygamma")
+    return dispatch.apply(
+        lambda a: jax.scipy.special.polygamma(n, a), x, op_name="polygamma")
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    x = ensure_tensor(x)
+
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        return jax.lax.cumlogsumexp(a, axis=ax)
+
+    return dispatch.apply(fn, x, op_name="logcumsumexp")
+
+
+def sgn(x, name=None):
+    """Complex-aware sign: x/|x| (0 where x==0), reference math.py:4993."""
+    x = ensure_tensor(x)
+
+    def fn(a):
+        if jnp.iscomplexobj(a):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0.0 + 0.0j, a / jnp.where(mag == 0, 1.0, mag))
+        return jnp.sign(a)
+
+    return dispatch.apply(fn, x, op_name="sgn")
+
+
+def polar(abs, angle, name=None):  # noqa: A002
+    abs, angle = ensure_tensor(abs), ensure_tensor(angle)
+    return dispatch.apply(
+        lambda r, t: (r * jnp.cos(t) + 1j * r * jnp.sin(t)).astype(
+            jnp.complex128 if r.dtype == jnp.float64 else jnp.complex64),
+        abs, angle, op_name="polar")
+
+
+def as_complex(x, name=None):
+    """[..., 2] float → [...] complex (reference creation.py as_complex)."""
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x, op_name="as_complex")
+
+
+def as_real(x, name=None):
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+        x, op_name="as_real")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp the p-norm of every slice along `axis` to max_norm
+    (reference math.py:2202)."""
+    x = ensure_tensor(x)
+
+    def fn(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+    return dispatch.apply(fn, x, op_name="renorm")
+
+
+def increment(x, value=1.0, name=None):
+    """In-place add on a 1-element tensor (reference math.py:2905)."""
+    out = add(x, ensure_tensor(value))
+    x._set_value(out._value)
+    x._grad_node = out._grad_node
+    x._output_index = out._output_index
+    if out._grad_node is not None:
+        x.stop_gradient = False
+    return x
+
+
+def vander(x, n=None, increasing=False, name=None):
+    x = ensure_tensor(x)
+    cols = n if n is not None else x.shape[0]
+    return dispatch.apply(
+        lambda a: jnp.vander(a, N=cols, increasing=increasing),
+        x, op_name="vander")
+
+
+def take(x, index, mode="raise", name=None):  # noqa: A002
+    """Flattened gather (reference math.py take). mode 'wrap'/'clip' follow
+    numpy; 'raise' clips (no data-dependent errors inside XLA programs)."""
+    x, index = ensure_tensor(x), ensure_tensor(index)
+
+    def fn(a, idx):
+        flat = a.reshape(-1)
+        n = flat.shape[0]
+        if mode == "wrap":
+            idx = ((idx % n) + n) % n
+        else:
+            idx = jnp.clip(jnp.where(idx < 0, idx + n, idx), 0, n - 1)
+        return jnp.take(flat, idx.reshape(-1)).reshape(idx.shape)
+
+    return dispatch.apply(fn, x, index, op_name="take")

@@ -1,0 +1,120 @@
+"""Signal processing: STFT / ISTFT (reference: python/paddle/signal.py:232
+``stft``, :399 ``istft``; lowered there to frame+matmul ops).
+
+TPU-native: framing is a gather-free strided reshape via
+jax.lax.conv_general_dilated_patches-style slicing expressed with
+jnp.stack of lax.dynamic_slice windows — but since hop/len are static we
+can simply use jnp reshape/stride tricks; the DFT itself is jnp.fft. The
+whole transform stays one differentiable XLA program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .ops import dispatch
+from .ops._factory import ensure_tensor
+
+__all__ = ["stft", "istft"]
+
+
+def _frame(a, frame_length, hop_length):
+    """[..., T] -> [..., frame_length, num_frames] (reference frame op)."""
+    t = a.shape[-1]
+    n_frames = 1 + (t - frame_length) // hop_length
+    idx = (np.arange(frame_length)[:, None]
+           + hop_length * np.arange(n_frames)[None, :])   # [fl, nf]
+    return a[..., idx]
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform (reference python/paddle/signal.py:232).
+
+    x: [batch?, T] real or complex. Returns [batch?, n_fft//2+1 or n_fft,
+    num_frames] complex."""
+    x = ensure_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        window = ensure_tensor(window)
+
+    if x.ndim not in (1, 2):
+        raise ValueError(f"stft expects a 1-D or 2-D input, got {x.ndim}-D")
+
+    def fn(a, *w):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, [(0, 0), (pad, pad)], mode=pad_mode)
+        frames = _frame(a, n_fft, hop_length)             # [B, n_fft, nf]
+        if w:
+            win = w[0]
+            if win_length < n_fft:  # center-pad the window to n_fft
+                lp = (n_fft - win_length) // 2
+                win = jnp.pad(win, (lp, n_fft - win_length - lp))
+            frames = frames * win[None, :, None]
+        spec = jnp.fft.fft(frames, axis=1)
+        if onesided and not jnp.iscomplexobj(a):
+            spec = spec[:, : n_fft // 2 + 1]
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(float(n_fft), spec.real.dtype))
+        return spec[0] if squeeze else spec
+
+    args = (x, window) if window is not None else (x,)
+    return dispatch.apply(fn, *args, op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT (reference python/paddle/signal.py:399). Overlap-add with
+    squared-window normalization."""
+    x = ensure_tensor(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        window = ensure_tensor(window)
+
+    def fn(spec, *w):
+        squeeze = spec.ndim == 2
+        if squeeze:
+            spec = spec[None]
+        b, nbins, nf = spec.shape
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(float(n_fft), spec.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=1)
+            if not return_complex:
+                frames = frames.real
+        if w:
+            win = w[0]
+            if win_length < n_fft:
+                lp = (n_fft - win_length) // 2
+                win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        else:
+            win = jnp.ones((n_fft,), frames.real.dtype)
+        frames = frames * win[None, :, None]
+        t_total = n_fft + hop_length * (nf - 1)
+        idx = (np.arange(n_fft)[:, None] + hop_length * np.arange(nf)[None, :])
+        sig = jnp.zeros((b, t_total), frames.dtype)
+        sig = sig.at[:, idx.reshape(-1)].add(
+            frames.reshape(b, -1), indices_are_sorted=False)
+        # squared-window overlap normalization
+        wsq = jnp.zeros((t_total,), win.dtype)
+        wsq = wsq.at[idx.reshape(-1)].add(
+            jnp.broadcast_to((win ** 2)[:, None], (n_fft, nf)).reshape(-1))
+        sig = sig / jnp.maximum(wsq, 1e-11)[None]
+        if center:
+            pad = n_fft // 2
+            sig = sig[:, pad: t_total - pad]
+        if length is not None:
+            sig = sig[:, :length]
+        return sig[0] if squeeze else sig
+
+    args = (x, window) if window is not None else (x,)
+    return dispatch.apply(fn, *args, op_name="istft")

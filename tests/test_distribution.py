@@ -1,0 +1,214 @@
+"""Distribution package tests (reference: test/distribution/
+test_distribution_*.py — moment/log_prob parity vs scipy, KL closed forms
+vs Monte-Carlo, transform round-trips)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu
+from paddle_tpu import distribution as D
+
+RNG = np.random.RandomState(3)
+
+
+def _mc_kl(p, q, n=200_000):
+    x = p.sample((n,))
+    return float(paddle_tpu.mean(p.log_prob(x) - q.log_prob(x)))
+
+
+# ---------------------------------------------------------------------------
+# log_prob / moments vs scipy
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("ours,ref,params", [
+    (D.Normal, st.norm, dict(loc=0.5, scale=2.0)),
+    (D.Laplace, st.laplace, dict(loc=-1.0, scale=1.5)),
+    (D.Cauchy, st.cauchy, dict(loc=0.3, scale=0.7)),
+    (D.Gumbel, st.gumbel_r, dict(loc=1.0, scale=2.0)),
+])
+def test_logprob_parity_loc_scale(ours, ref, params):
+    d = ours(**params)
+    x = np.linspace(-4, 4, 23).astype(np.float32)
+    got = d.log_prob(paddle_tpu.to_tensor(x)).numpy()
+    want = ref.logpdf(x, loc=params["loc"], scale=params["scale"])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_normal_moments_entropy_sampling():
+    d = D.Normal(1.0, 2.0)
+    assert float(d.mean) == pytest.approx(1.0)
+    assert float(d.variance) == pytest.approx(4.0)
+    assert float(d.entropy()) == pytest.approx(st.norm.entropy(1.0, 2.0), rel=1e-5)
+    paddle_tpu.seed(0)
+    s = d.sample((20000,))
+    assert s.shape == [20000]
+    assert float(paddle_tpu.mean(s)) == pytest.approx(1.0, abs=0.06)
+    assert float(paddle_tpu.std(s)) == pytest.approx(2.0, abs=0.06)
+
+
+def test_normal_rsample_pathwise_grad():
+    loc = paddle_tpu.to_tensor(np.float32(0.0), stop_gradient=False)
+    scale = paddle_tpu.to_tensor(np.float32(1.0), stop_gradient=False)
+    d = D.Normal(loc, scale)
+    paddle_tpu.seed(7)
+    x = d.rsample((1000,))
+    loss = paddle_tpu.mean(paddle_tpu.square(x))
+    loss.backward()
+    # d/dscale E[(scale*eps)^2] = 2*scale = 2
+    assert float(scale.grad) == pytest.approx(2.0, abs=0.2)
+
+
+def test_uniform_beta_dirichlet():
+    u = D.Uniform(-1.0, 3.0)
+    assert float(u.entropy()) == pytest.approx(np.log(4.0), rel=1e-6)
+    x = np.float32([-0.5, 0.0, 2.9])
+    np.testing.assert_allclose(u.log_prob(paddle_tpu.to_tensor(x)).numpy(),
+                               st.uniform.logpdf(x, loc=-1, scale=4), rtol=1e-5)
+    b = D.Beta(2.0, 3.0)
+    xs = np.float32([0.1, 0.5, 0.9])
+    np.testing.assert_allclose(b.log_prob(paddle_tpu.to_tensor(xs)).numpy(),
+                               st.beta.logpdf(xs, 2, 3), rtol=1e-4, atol=1e-5)
+    assert float(b.entropy()) == pytest.approx(st.beta.entropy(2, 3), rel=1e-4)
+    conc = np.float32([1.0, 2.0, 3.0])
+    dd = D.Dirichlet(paddle_tpu.to_tensor(conc))
+    p = np.float32([0.2, 0.3, 0.5])
+    np.testing.assert_allclose(float(dd.log_prob(paddle_tpu.to_tensor(p))),
+                               st.dirichlet.logpdf(p / p.sum(), conc), rtol=1e-4)
+    s = dd.sample((7,))
+    assert s.shape == [7, 3]
+    np.testing.assert_allclose(s.numpy().sum(-1), np.ones(7), rtol=1e-5)
+
+
+def test_lognormal():
+    d = D.LogNormal(0.2, 0.5)
+    xs = np.float32([0.5, 1.0, 2.0])
+    np.testing.assert_allclose(d.log_prob(paddle_tpu.to_tensor(xs)).numpy(),
+                               st.lognorm.logpdf(xs, 0.5, scale=np.exp(0.2)),
+                               rtol=1e-4)
+    assert float(d.mean) == pytest.approx(np.exp(0.2 + 0.125), rel=1e-5)
+
+
+def test_discrete():
+    be = D.Bernoulli(0.3)
+    np.testing.assert_allclose(
+        be.log_prob(paddle_tpu.to_tensor(np.float32([0, 1]))).numpy(),
+        [np.log(0.7), np.log(0.3)], rtol=1e-4)
+    assert float(be.entropy()) == pytest.approx(st.bernoulli.entropy(0.3), rel=1e-4)
+
+    logits = np.log(np.float32([0.2, 0.3, 0.5]))
+    c = D.Categorical(paddle_tpu.to_tensor(logits))
+    np.testing.assert_allclose(
+        c.log_prob(paddle_tpu.to_tensor(np.int64([0, 2]))).numpy(),
+        [np.log(0.2), np.log(0.5)], rtol=1e-4)
+    assert float(c.entropy()) == pytest.approx(
+        -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5)), rel=1e-4)
+    paddle_tpu.seed(0)
+    s = c.sample((8000,))
+    freq = np.bincount(s.numpy().astype(int), minlength=3) / 8000
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+
+    g = D.Geometric(0.25)
+    k = np.float32([0, 1, 4])
+    np.testing.assert_allclose(g.log_prob(paddle_tpu.to_tensor(k)).numpy(),
+                               st.geom.logpmf(k + 1, 0.25), rtol=1e-4)
+    assert float(g.mean) == pytest.approx(3.0)
+
+    m = D.Multinomial(10, paddle_tpu.to_tensor(np.float32([0.2, 0.3, 0.5])))
+    val = np.float32([2, 3, 5])
+    np.testing.assert_allclose(float(m.log_prob(paddle_tpu.to_tensor(val))),
+                               st.multinomial.logpmf(val, 10, [0.2, 0.3, 0.5]),
+                               rtol=1e-4)
+    s = m.sample((5,))
+    np.testing.assert_allclose(s.numpy().sum(-1), 10 * np.ones(5), rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# KL: closed forms vs Monte-Carlo
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("p,q", [
+    (D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)),
+    (D.Laplace(0.0, 1.0), D.Laplace(0.5, 2.0)),
+    (D.Beta(2.0, 3.0), D.Beta(3.0, 2.0)),
+    (D.Gumbel(0.0, 1.0), D.Gumbel(0.5, 1.5)),
+    (D.Bernoulli(0.3), D.Bernoulli(0.6)),
+    (D.Geometric(0.3), D.Geometric(0.5)),
+])
+def test_kl_closed_vs_mc(p, q):
+    paddle_tpu.seed(0)
+    closed = float(D.kl_divergence(p, q))
+    mc = _mc_kl(p, q, n=100_000)
+    assert closed == pytest.approx(mc, abs=max(0.05, 0.08 * abs(closed)))
+
+
+def test_kl_categorical_uniform_dirichlet():
+    c1 = D.Categorical(paddle_tpu.to_tensor(np.log(np.float32([0.2, 0.8]))))
+    c2 = D.Categorical(paddle_tpu.to_tensor(np.log(np.float32([0.5, 0.5]))))
+    want = 0.2 * np.log(0.2 / 0.5) + 0.8 * np.log(0.8 / 0.5)
+    assert float(D.kl_divergence(c1, c2)) == pytest.approx(want, rel=1e-4)
+    u1, u2 = D.Uniform(0.0, 1.0), D.Uniform(-1.0, 2.0)
+    assert float(D.kl_divergence(u1, u2)) == pytest.approx(np.log(3.0), rel=1e-5)
+    assert np.isinf(float(D.kl_divergence(u2, u1)))
+    d1 = D.Dirichlet(paddle_tpu.to_tensor(np.float32([1.0, 2.0])))
+    d2 = D.Dirichlet(paddle_tpu.to_tensor(np.float32([2.0, 2.0])))
+    paddle_tpu.seed(0)
+    mc = _mc_kl(d1, d2, n=100_000)
+    assert float(D.kl_divergence(d1, d2)) == pytest.approx(mc, abs=0.05)
+
+
+def test_register_kl_custom():
+    class MyDist(D.Normal):
+        pass
+
+    @D.register_kl(MyDist, MyDist)
+    def _kl(p, q):  # noqa: ANN001
+        return paddle_tpu.to_tensor(np.float32(42.0))
+
+    assert float(D.kl_divergence(MyDist(0.0, 1.0), MyDist(0.0, 1.0))) == 42.0
+    # most-derived beats the (Normal, Normal) registration
+    assert float(D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(0.0, 1.0))) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# transforms / composition
+# ---------------------------------------------------------------------------
+
+def test_transform_roundtrips():
+    x = paddle_tpu.to_tensor(RNG.randn(5).astype(np.float32))
+    for t in [D.AffineTransform(1.0, 2.0), D.ExpTransform(),
+              D.SigmoidTransform(), D.TanhTransform()]:
+        y = t.forward(x)
+        back = t.inverse(y)
+        np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_transformed_distribution_lognormal_equiv():
+    base = D.Normal(0.2, 0.5)
+    td = D.TransformedDistribution(base, [D.ExpTransform()])
+    ln = D.LogNormal(0.2, 0.5)
+    xs = paddle_tpu.to_tensor(np.float32([0.5, 1.0, 2.0]))
+    np.testing.assert_allclose(td.log_prob(xs).numpy(), ln.log_prob(xs).numpy(),
+                               rtol=1e-5)
+    paddle_tpu.seed(0)
+    s = td.sample((11,))
+    assert s.shape == [11] and (s.numpy() > 0).all()
+
+
+def test_independent():
+    base = D.Normal(paddle_tpu.to_tensor(np.zeros((3, 4), np.float32)),
+                    paddle_tpu.to_tensor(np.ones((3, 4), np.float32)))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (3,) and ind.event_shape == (4,)
+    x = paddle_tpu.to_tensor(RNG.randn(3, 4).astype(np.float32))
+    np.testing.assert_allclose(ind.log_prob(x).numpy(),
+                               base.log_prob(x).numpy().sum(-1), rtol=1e-5)
+
+
+def test_stick_breaking():
+    t = D.StickBreakingTransform()
+    x = paddle_tpu.to_tensor(RNG.randn(4).astype(np.float32))
+    y = t.forward(x)
+    assert y.shape == [5]
+    np.testing.assert_allclose(float(paddle_tpu.sum(y)), 1.0, rtol=1e-5)
+    back = t.inverse(y)
+    np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-3, atol=1e-4)
