@@ -91,6 +91,11 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
     if level == "O2":
         for m in model_list:
             m.to(dtype=dtype)
+        # Tensor autograd fields form reference cycles; collect now so the
+        # replaced fp32 buffers leave HBM before training allocates
+        import gc
+
+        gc.collect()
     if optimizers is None:
         return models if single_model else model_list
     return (models if single_model else model_list), optimizers

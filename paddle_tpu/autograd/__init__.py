@@ -5,6 +5,7 @@ from typing import Sequence
 
 from ..ops.dispatch import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 from .engine import GradNode, grad, run_backward  # noqa: F401
+from .functional import hessian, jacobian, jvp, vjp  # noqa: F401
 from .py_layer import PyLayer, PyLayerContext  # noqa: F401
 
 

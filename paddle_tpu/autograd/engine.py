@@ -31,10 +31,15 @@ _float0 = jax.dtypes.float0
 class GradNode:
     """One recorded op in the grad graph (reference grad_node_info.h:50)."""
 
-    __slots__ = ("vjp_fn", "inputs", "out_avals", "name", "_out_tensors", "__weakref__")
+    __slots__ = ("vjp_fn", "fwd", "inputs", "out_avals", "name", "_out_tensors",
+                 "__weakref__")
 
-    def __init__(self, vjp_fn, inputs, out_avals, name="op"):
+    def __init__(self, vjp_fn, inputs, out_avals, name="op", fwd=None):
         self.vjp_fn = vjp_fn
+        # the raw forward callable (attrs already bound): kept so
+        # create_graph=True can re-linearize — the backward op is then
+        # dispatched as a NEW differentiable op (vjp-of-vjp composes in jax)
+        self.fwd = fwd
         self.inputs = inputs  # tuple[Tensor]
         self.out_avals = out_avals  # tuple[(shape, dtype)]
         self.name = name
@@ -79,13 +84,24 @@ def run_backward(
     *,
     capture: Optional[Dict[int, object]] = None,
     accumulate_leaf: bool = True,
+    create_graph: bool = False,
 ):
     """Drive backward from ``tensors`` (reference backward.cc:421 ``Backward``).
 
     capture: optional dict id(Tensor)->None; filled with raw grads for those
     tensors (used by :func:`grad`).
+
+    create_graph: run each node's backward as a freshly-dispatched
+    differentiable op (re-linearizing via the node's saved forward), so the
+    produced grads carry their own grad graph — higher-order AD (reference:
+    eager/general_grad.h + python/paddle/autograd/autograd.py).
     """
     from ..tensor import Tensor
+
+    if create_graph:
+        return _run_backward_create_graph(
+            tensors, grad_tensors, capture=capture,
+            accumulate_leaf=accumulate_leaf)
 
     if grad_tensors is None:
         grad_tensors = [None] * len(tensors)
@@ -186,6 +202,149 @@ def run_backward(
     return leaf_grads
 
 
+def _run_backward_create_graph(tensors, grad_tensors, *, capture=None,
+                               accumulate_leaf=True):
+    """Backward pass whose own computation is recorded for differentiation.
+
+    Each node's VJP is re-derived from its saved forward (``node.fwd``) and
+    dispatched through ``ops.dispatch.apply`` with (cotangents + original
+    inputs) as op inputs — so the grads are ordinary Tensors with grad
+    nodes, and a second backward differentiates through them (jax composes
+    vjp-of-vjp naturally)."""
+    from ..tensor import Tensor
+    from ..ops import dispatch
+
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    buffers: Dict[int, List] = {}
+    leaf_grads: Dict[int, object] = {}
+
+    def acc(slot, value):
+        return value if slot is None else slot + value  # dispatched add
+
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient:
+            raise RuntimeError(
+                "backward() called on a tensor with stop_gradient=True")
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {tuple(t._value.shape)}")
+            g_t = Tensor(jnp.ones(t._value.shape, t._value.dtype),
+                         stop_gradient=True)
+        else:
+            g_t = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g),
+                                                         stop_gradient=True)
+        node = t._grad_node
+        if node is None:
+            leaf_grads[id(t)] = acc(leaf_grads.get(id(t)), g_t)
+            continue
+        buf = buffers.setdefault(id(node), [None] * len(node.out_avals))
+        buf[t._output_index] = acc(buf[t._output_index], g_t)
+        roots.append(node)
+
+    order = _topo_order(roots)
+
+    for node in order:
+        buf = buffers.pop(id(node), None)
+        if buf is None:
+            continue
+        if node.fwd is None:
+            raise RuntimeError(
+                f"op '{node.name}' cannot participate in create_graph=True "
+                "backward (no saved forward)")
+        # inexact-dtype outputs get Tensor cotangents (op inputs); the rest
+        # stay float0 constants closed over by the grad op
+        ct_tensors: List = []
+        ct_slots: List = []
+        for slot, (shape, dtype) in zip(buf, node.out_avals):
+            if _dtype_mod.is_inexact_raw(dtype):
+                if slot is None:
+                    slot = Tensor(jnp.zeros(shape, dtype), stop_gradient=True)
+                ct_slots.append(len(ct_tensors))
+                ct_tensors.append(slot)
+            else:
+                ct_slots.append(("f0", shape))
+
+        # fire tensor hooks on the accumulated output grads (parity with
+        # the first-order path; hooks see/return Tensors and stay in-graph)
+        for ref in node._out_tensors:
+            t = ref()
+            if t is None or not t._hooks:
+                continue
+            spec = ct_slots[t._output_index]
+            if isinstance(spec, tuple) and spec and spec[0] == "f0":
+                continue
+            g_t = ct_tensors[spec]
+            for hook in t._hooks.values():
+                new_g = hook(g_t)
+                if new_g is not None:
+                    g_t = new_g if isinstance(new_g, Tensor) else Tensor(new_g)
+            ct_tensors[spec] = g_t
+
+        n_ct = len(ct_tensors)
+        node_fwd = node.fwd
+        slots_spec = list(ct_slots)
+
+        def grad_op(*args, _fwd=node_fwd, _spec=slots_spec, _n=n_ct):
+            cts_in = args[:_n]
+            xs = args[_n:]
+
+            def fwd_tuple(*xs_):
+                o = _fwd(*xs_)
+                return o if isinstance(o, tuple) else (o,)
+
+            _, vjp_fn = jax.vjp(fwd_tuple, *xs)
+            full_cts = []
+            for spec in _spec:
+                if isinstance(spec, tuple) and spec and spec[0] == "f0":
+                    full_cts.append(np.zeros(spec[1], _float0))
+                else:
+                    full_cts.append(cts_in[spec])
+            gs = vjp_fn(tuple(full_cts))
+            # float0 grads (int inputs) can't be op outputs; return typed
+            # zeros — the engine skips non-inexact grads anyway
+            return tuple(
+                jnp.zeros(x.shape, x.dtype)
+                if (hasattr(g, "dtype") and g.dtype == _float0) else g
+                for g, x in zip(gs, xs)
+            )
+
+        with dispatch.enable_grad():
+            in_grads = dispatch.apply(
+                grad_op, *(tuple(ct_tensors) + tuple(node.inputs)),
+                op_name=f"{node.name}_grad")
+        if not isinstance(in_grads, tuple):
+            in_grads = (in_grads,)
+
+        for t, g in zip(node.inputs, in_grads):
+            if g is None or not _dtype_mod.is_inexact_raw(g._value.dtype):
+                continue
+            if t.stop_gradient and (capture is None or id(t) not in capture):
+                continue
+            prod = t._grad_node
+            if prod is not None:
+                b = buffers.setdefault(id(prod), [None] * len(prod.out_avals))
+                b[t._output_index] = acc(b[t._output_index], g)
+                if capture is not None and id(t) in capture:
+                    leaf_grads[id(t)] = acc(leaf_grads.get(id(t)), g)
+            else:
+                leaf_grads[id(t)] = acc(leaf_grads.get(id(t)), g)
+
+    if capture is not None:
+        for tid in list(capture.keys()):
+            capture[tid] = leaf_grads.get(tid)
+
+    if accumulate_leaf:
+        raw = {k: (v._value if isinstance(v, Tensor) else v)
+               for k, v in leaf_grads.items()}
+        _write_leaf_grads(tensors, raw, capture)
+    return leaf_grads
+
+
 def _write_leaf_grads(root_tensors, leaf_grads, capture):
     from ..tensor import Tensor
 
@@ -230,26 +389,24 @@ def grad(
     allow_unused=False,
 ):
     """Functional gradient API (reference: paddle/fluid/eager/general_grad.h,
-    python ``paddle.grad``). create_graph is not yet supported (the VJP chain
-    is first-order); use jax-level transforms via jit.to_static for higher
-    order."""
+    python ``paddle.grad``).  With ``create_graph=True`` the returned grads
+    carry their own grad graph (backward re-dispatched as differentiable
+    ops), so calling :func:`grad` on them again yields higher-order
+    derivatives."""
     from ..tensor import Tensor
 
     if isinstance(outputs, Tensor):
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use paddle_tpu.jit.functional_grad for higher-order"
-        )
     capture = {id(t): None for t in inputs}
     run_backward(
         outputs,
         grad_outputs,
-        retain_graph=retain_graph,
+        retain_graph=retain_graph or create_graph,
         capture=capture,
         accumulate_leaf=False,
+        create_graph=create_graph,
     )
     results = []
     for t in inputs:
@@ -261,6 +418,8 @@ def grad(
                     "pass allow_unused=True to get None instead"
                 )
             results.append(None)
+        elif isinstance(g, Tensor):
+            results.append(g)
         else:
             results.append(Tensor(g, stop_gradient=True))
     return results

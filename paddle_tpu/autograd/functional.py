@@ -1,0 +1,134 @@
+"""Functional higher-order AD: jacobian / hessian / vjp / jvp.
+
+Reference: python/paddle/autograd/autograd.py (Jacobian/Hessian lazy
+classes) and python/paddle/incubate/autograd/functional.py (vjp/jvp).
+
+TPU-native design: rather than the reference's row-by-row double-grad
+loops, these build on the engine's ``create_graph=True`` backward (which
+re-dispatches VJPs as differentiable ops) — each jacobian row is one
+backward pass; hessian is jacobian of a create_graph gradient.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+from .engine import grad as _grad
+
+__all__ = ["jacobian", "hessian", "vjp", "jvp"]
+
+
+def _ensure_list(x):
+    return [x] if isinstance(x, Tensor) else list(x)
+
+
+def vjp(func, xs, v=None):
+    """Vector-Jacobian product: returns (func(xs), vjp_result).
+    Reference: python/paddle/incubate/autograd/functional.py vjp."""
+    xs_l = _ensure_list(xs)
+    prev_sg = [x.stop_gradient for x in xs_l]
+    for x in xs_l:
+        x.stop_gradient = False
+    try:
+        ys = func(*xs_l)
+        ys_l = _ensure_list(ys)
+        if v is None:
+            grads = _grad(ys_l, xs_l, allow_unused=True)
+        else:
+            v_l = _ensure_list(v)
+            grads = _grad(ys_l, xs_l, grad_outputs=v_l, allow_unused=True)
+    finally:
+        for x, sg in zip(xs_l, prev_sg):
+            x.stop_gradient = sg
+    one = not isinstance(xs, (list, tuple))
+    return ys, (grads[0] if one else grads)
+
+
+def jvp(func, xs, v=None):
+    """Jacobian-vector product via double-vjp (forward-over-reverse):
+    jvp(f, x, v) = vjp(u ↦ vjp(f, x)(u), 0)(v) — standard trick, gives
+    forward-mode without a separate tracer."""
+    xs_l = _ensure_list(xs)
+    prev_sg = [x.stop_gradient for x in xs_l]
+    for x in xs_l:
+        x.stop_gradient = False
+    try:
+        ys = func(*xs_l)
+        ys_l = _ensure_list(ys)
+        if v is None:
+            v_l = [Tensor(jnp.ones_like(x._value), stop_gradient=True)
+                   for x in xs_l]
+        else:
+            v_l = _ensure_list(v)
+        # u is a dummy cotangent with requires-grad; g(u) = vjp_f(u) is linear
+        us = [Tensor(jnp.zeros(y._value.shape, y._value.dtype),
+                     stop_gradient=False) for y in ys_l]
+        gs = _grad(ys_l, xs_l, grad_outputs=us, create_graph=True,
+                   allow_unused=True)
+        gs_live = [g for g in gs if g is not None]
+        v_live = [v for g, v in zip(gs, v_l) if g is not None]
+        jvps = _grad(gs_live, us, grad_outputs=v_live, allow_unused=True)
+    finally:
+        for x, sg in zip(xs_l, prev_sg):
+            x.stop_gradient = sg
+    one = not isinstance(xs, (list, tuple))
+    return ys, (jvps[0] if one else jvps)
+
+
+def _flatten_rows(t: Tensor):
+    return t.reshape([-1]) if hasattr(t, "reshape") else t
+
+
+def jacobian(ys, xs, batch_axis=None) -> Union[Tensor, List]:
+    """Dense jacobian d(ys)/d(xs), computed row-by-row with reverse-mode
+    (each output element seeds one backward).  ys must be produced from xs
+    with stop_gradient=False.  Returns [ys_size, xs_size]-shaped Tensor
+    (or nested lists when ys/xs are sequences).
+
+    Reference: python/paddle/autograd/autograd.py Jacobian (lazy rows);
+    here rows are materialized eagerly — XLA batches the VJP dispatches.
+    """
+    from .. import ops
+
+    ys_l = _ensure_list(ys)
+    xs_l = _ensure_list(xs)
+
+    def one_pair(y: Tensor, x: Tensor):
+        yf = y
+        n = int(np.prod(y._value.shape)) if y._value.shape else 1
+        rows = []
+        for i in range(n):
+            seed = jnp.zeros((n,), y._value.dtype).at[i].set(1.0)
+            seed = seed.reshape(y._value.shape)
+            (gx,) = _grad([yf], [x], grad_outputs=[Tensor(seed, stop_gradient=True)],
+                          retain_graph=True, create_graph=True,
+                          allow_unused=True)
+            if gx is None:
+                gx = Tensor(jnp.zeros(x._value.shape, x._value.dtype),
+                            stop_gradient=True)
+            rows.append(ops.reshape(gx, [-1]))
+        return ops.stack(rows)
+
+    if isinstance(ys, Tensor) and isinstance(xs, Tensor):
+        return one_pair(ys, xs)
+    if isinstance(ys, Tensor):
+        return [one_pair(ys, x) for x in xs_l]
+    if isinstance(xs, Tensor):
+        return [one_pair(y, xs) for y in ys_l]
+    return [[one_pair(y, x) for x in xs_l] for y in ys_l]
+
+
+def hessian(ys, xs, batch_axis=None):
+    """Hessian of a scalar ``ys`` w.r.t. ``xs``: jacobian of the
+    create_graph first gradient (reference autograd.py Hessian)."""
+    ys_l = _ensure_list(ys)
+    if ys_l[0]._value.size != 1:
+        raise ValueError("hessian expects a scalar output")
+    xs_l = _ensure_list(xs)
+    firsts = _grad(ys_l, xs_l, create_graph=True, allow_unused=False)
+    if isinstance(xs, Tensor):
+        return jacobian(firsts[0], xs)
+    return [[jacobian(f, x) for x in xs_l] for f in firsts]

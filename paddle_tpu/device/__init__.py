@@ -1,4 +1,10 @@
 """device namespace (reference: python/paddle/device/)."""
+from ..core.memory import (  # noqa: F401
+    max_memory_allocated,
+    memory_allocated,
+    memory_stats,
+    memory_summary,
+)
 from ..core.place import (  # noqa: F401
     CPUPlace,
     Place,

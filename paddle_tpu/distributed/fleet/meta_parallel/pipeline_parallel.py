@@ -108,11 +108,22 @@ class PipelineLayer(Layer):
 
 
 class PipelineParallel(Layer):
-    """Reference pipeline_parallel.py:229 (1F1B). The public surface is
-    train_batch(data, optimizer, scaler): split into micro-batches, run
-    fwd/bwd per micro-batch accumulating grads, then step. Under
-    jit.to_static the microbatch loop unrolls into one XLA program; with
-    pp>1 mesh axes the stage shardings pipeline via XLA's scheduler."""
+    """Reference pipeline_parallel.py:229 (1F1B schedule).
+
+    ``train_batch(data, optimizer, scaler)`` splits the batch into
+    micro-batches and drives a true 1F1B schedule over the PipelineLayer's
+    stage segments: forward of micro-batch j is immediately followed by
+    backward of micro-batch j-(S-1), so at most S micro-batches'
+    activations are live per stage (the 1F1B residency bound) instead of
+    all M as in plain gradient accumulation.  Stage boundaries are
+    detached Tensors; the boundary gradient is captured by the engine and
+    seeds the previous stage's backward — the single-controller analog of
+    the reference's p2p send/recv of activation grads.  Each stage's
+    compute is an async XLA dispatch, so different micro-batches' stage
+    work overlaps on device; with pp>1 mesh shardings the stages live on
+    different pp slices (the high-throughput fully-fused path is
+    pp_spmd.pipeline_blocks, used by GPTStackedForPretraining).
+    """
 
     def __init__(self, layers, hcg=None, strategy=None):
         super().__init__()
@@ -121,6 +132,9 @@ class PipelineParallel(Layer):
         cfg = strategy.pipeline_configs if strategy is not None else {}
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
         self.micro_batch_size = int(cfg.get("micro_batch_size", 1))
+        # observability for tests: peak number of micro-batches whose
+        # activations were simultaneously live during the last train_batch
+        self.last_peak_inflight = 0
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
@@ -132,20 +146,86 @@ class PipelineParallel(Layer):
         ys = _ops.split(labels, mb, axis=0) if mb > 1 else [labels]
         return list(zip(xs, ys))
 
+    def _run_stage(self, stage_id, x):
+        """Execute stage ``stage_id``'s item segment."""
+        for kind, item, ffn in self._layers.stage_items(stage_id):
+            if kind == "shared":
+                layer = self._layers._shared[item]
+                x = ffn(layer, x) if ffn else layer(x)
+            elif kind == "fn":
+                x = item(x)
+            else:
+                x = ffn(item, x) if ffn else item(x)
+        return x
+
+    def _forward_micro(self, x, y, inv, scaler):
+        """Forward one micro-batch through all stages, detaching at stage
+        boundaries; returns the per-stage (boundary_in, out) records."""
+        from ....autograd.engine import run_backward  # noqa: F401 (doc link)
+
+        S = self._layers.get_num_stages()
+        records = []
+        h = x
+        for s in range(S):
+            if s == 0:
+                h_in = h
+            else:
+                h_in = h.detach()
+                h_in.stop_gradient = False
+            out = self._run_stage(s, h_in)
+            if s == S - 1:
+                loss = self._layers._loss_fn(out, y) * inv
+                records.append((h_in, scaler.scale(loss) if scaler else loss,
+                                loss))
+            else:
+                records.append((h_in, out, None))
+            h = out
+        return records
+
+    def _backward_micro(self, records):
+        """Backward one micro-batch stage-by-stage, chaining the boundary
+        gradient (the p2p'd activation grad of the reference)."""
+        from ....autograd.engine import run_backward
+
+        S = len(records)
+        g = None
+        for s in reversed(range(S)):
+            h_in, out, _ = records[s]
+            if s > 0:
+                cap = {id(h_in): None}
+                run_backward([out], [g] if g is not None else None,
+                             capture=cap)
+                g_raw = cap[id(h_in)]
+                g = Tensor(g_raw, stop_gradient=True) if g_raw is not None else None
+            else:
+                run_backward([out], [g] if g is not None else None)
+            records[s] = None  # release this stage's activations
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         assert self._layers._loss_fn is not None, "PipelineLayer needs loss_fn"
         micro = self._split_micro(data)
+        M = len(micro)
+        S = self._layers.get_num_stages()
+        inv = 1.0 / M
         total = None
-        inv = 1.0 / len(micro)
-        for x, y in micro:
-            out = self._layers(x)
-            loss = self._layers._loss_fn(out, y)
-            if scaler is not None:
-                scaled = scaler.scale(loss * inv)
-                scaled.backward()
-            else:
-                (loss * inv).backward()
-            total = loss if total is None else total + loss
+        inflight = {}
+        self.last_peak_inflight = 0
+
+        # 1F1B: warmup fills S-1 forwards, steady state pairs each new
+        # forward with the oldest pending backward, drain empties the queue
+        # (reference pipeline_parallel.py:229 forward_backward_pipeline)
+        for j in range(M):
+            x, y = micro[j]
+            recs = self._forward_micro(x, y, inv, scaler)
+            total = recs[-1][2] if total is None else total + recs[-1][2]
+            inflight[j] = recs
+            self.last_peak_inflight = max(self.last_peak_inflight, len(inflight))
+            if j >= S - 1:
+                oldest = j - (S - 1)
+                self._backward_micro(inflight.pop(oldest))
+        for j in sorted(inflight):
+            self._backward_micro(inflight.pop(j))
+
         if scaler is not None:
             scaler.step(optimizer)
         else:
@@ -153,7 +233,7 @@ class PipelineParallel(Layer):
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
-        return total * inv
+        return total
 
     def eval_batch(self, data, compute_loss=True):
         micro = self._split_micro(data)

@@ -33,6 +33,7 @@ from typing import Callable, List, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ... import mesh as _mesh
@@ -65,7 +66,8 @@ def scan_blocks(block_fn: Callable, stacked: Sequence, x, *, remat: bool = False
 
 def pipeline_blocks(block_fn: Callable, stacked: Sequence, x_micro, *,
                     layers_per_stage: int, pp_axis: str = "pp",
-                    remat: bool = False, block_takes_index: bool = False):
+                    remat: bool = False, block_takes_index: bool = False,
+                    n_virtual: int = 1):
     """Microbatch-pipelined execution of stacked blocks over the pp axis.
 
     Args:
@@ -77,61 +79,124 @@ def pipeline_blocks(block_fn: Callable, stacked: Sequence, x_micro, *,
       x_micro: [M, mb, ...] microbatched input activations (replicated over
         ``pp_axis``; may be sharded over dp/sp on inner dims).
       layers_per_stage: L // n_stages.
+      n_virtual: virtual pipeline stages per device (reference
+        PipelineParallelWithInterleave, pipeline_parallel.py:625).  Layers
+        are assigned to devices round-robin by chunk (chunk c -> device
+        c % S, Megatron interleave layout) and microbatches make
+        ``n_virtual`` trips around the ring; the fill/drain bubble drops
+        from (S-1)/(M+S-1) to (S-1)/(V*M+S-1).  Requires M >= S so phase
+        v+1's first tick never outruns phase v's drain.
 
     Returns [M, mb, ...] outputs (replicated over the pp axis).
+
+    Memory note (1F1B-class residency): with ``remat=True`` each tick's
+    stage execution saves only its carry ([mb, ...] activation) and
+    recomputes block internals in backward, so per-device residency is
+    O(ticks x microbatch-activation) — the same order 1F1B buys the
+    reference, achieved here by remat instead of schedule gymnastics.
     """
     mesh = _mesh.get_mesh()
     n_stages = mesh.shape[pp_axis]
     n_micro = x_micro.shape[0]
+    V = int(n_virtual)
+    if V > 1:
+        if n_micro < n_stages:
+            raise ValueError(
+                f"interleave needs n_micro ({n_micro}) >= n_stages "
+                f"({n_stages})")
+        if layers_per_stage % V != 0:
+            raise ValueError(
+                f"layers_per_stage ({layers_per_stage}) must be divisible "
+                f"by n_virtual ({V})")
     if not block_takes_index:
         base = block_fn
         block_fn = lambda p, h, idx: base(p, h)  # noqa: E731
     body = jax.checkpoint(block_fn) if remat else block_fn
 
-    def stage_fn(local_params, h, mb_idx):
-        # local_params: [layers_per_stage, ...] slices owned by this stage
+    lpc = layers_per_stage // V  # layers per virtual chunk
+
+    if V > 1:
+        # Megatron interleave layout: chunk c -> device c % S.  Re-order the
+        # stacked leading dim so each device's rows are contiguous:
+        # device d holds chunks d, S+d, 2S+d, ... (V chunks of lpc layers).
+        order = np.concatenate([
+            np.arange((v * n_stages + d) * lpc, (v * n_stages + d + 1) * lpc)
+            for d in range(n_stages) for v in range(V)
+        ])
+        stacked = tuple(a[order] for a in stacked)
+
+    def chunk_scan(local_params, h, mb_idx, v_idx):
+        """Run the local virtual chunk ``v_idx`` (lpc layers)."""
+        if V == 1:
+            chunk = local_params
+        else:
+            chunk = tuple(
+                jax.lax.dynamic_slice_in_dim(p, v_idx * lpc, lpc, axis=0)
+                for p in local_params
+            )
+
         def step(carry, params):
             return body(params, carry, mb_idx), None
 
-        out, _ = jax.lax.scan(step, h, local_params)
+        out, _ = jax.lax.scan(step, h, chunk)
         return out
 
     def spmd(stacked_local, x_local):
         stage = jax.lax.axis_index(pp_axis)
-        is_first = stage == 0
-        is_last = stage == n_stages - 1
+        is_last_dev = stage == n_stages - 1
 
         # zeros are pp-invariant; the scan carry becomes pp-varying (each
         # stage computes different activations), so pcast the initial carry
-        state = jax.lax.pcast(jnp.zeros_like(x_local[0]), (pp_axis,), to="varying")
-        outputs = jax.lax.pcast(jnp.zeros_like(x_local), (pp_axis,), to="varying")
+        varying = lambda z: jax.lax.pcast(z, (pp_axis,), to="varying")  # noqa: E731
+        state = varying(jnp.zeros_like(x_local[0]))
+        outputs = varying(jnp.zeros_like(x_local))
+        # phase-wrap buffer (interleave only): device 0 parks activations
+        # returning from the last device until their next trip starts
+        inbuf = varying(jnp.zeros_like(x_local)) if V > 1 else jnp.zeros(())
+
+        total_ticks = V * n_micro + n_stages - 1
 
         def tick(carry, t):
-            state, outputs = carry
-            mb_idx = t - stage
-            active = (mb_idx >= 0) & (mb_idx < n_micro)
-            safe_idx = jnp.clip(mb_idx, 0, n_micro - 1)
-            inp = jnp.where(is_first, x_local[safe_idx], state)
-            y = stage_fn(stacked_local, inp, safe_idx)
+            state, inbuf, outputs = carry
+            rel = t - stage
+            active = (rel >= 0) & (rel < V * n_micro)
+            v_idx = jnp.clip(rel // n_micro, 0, V - 1)
+            mb_idx = jnp.clip(rel % n_micro, 0, n_micro - 1)
+            # stage 0 feeds from x (trip 0) or the phase-wrap buffer
+            # (later trips); other stages consume the rotated carry
+            if V == 1:
+                entry = x_local[mb_idx]
+            else:
+                entry = jnp.where(v_idx == 0, x_local[mb_idx], inbuf[mb_idx])
+            inp = jnp.where(stage == 0, entry, state)
+            y = chunk_scan(stacked_local, inp, mb_idx, v_idx)
             y = jnp.where(active, y, jnp.zeros_like(y))
+            done = active & is_last_dev & (v_idx == V - 1)
             outputs = jax.lax.dynamic_update_index_in_dim(
-                outputs,
-                jnp.where(active & is_last, y, outputs[safe_idx]),
-                safe_idx, 0,
-            )
-            # rotate activations to the next stage (ICI collective-permute)
+                outputs, jnp.where(done, y, outputs[mb_idx]), mb_idx, 0)
+            # rotate activations to the next device (ICI collective-permute)
             nxt = jax.lax.ppermute(
                 y, pp_axis, [(i, (i + 1) % n_stages) for i in range(n_stages)]
             )
-            return (nxt, outputs), None
+            if V > 1:
+                # park arrivals from the ring's wrap (sender = prev device,
+                # who processed rel' = t - (d-1 mod S) this tick) for the
+                # next trip; only stage 0's buffer is ever read
+                s_rel = t - ((stage - 1) % n_stages)
+                s_active = (s_rel >= 0) & (s_rel < V * n_micro)
+                s_mb = jnp.clip(s_rel % n_micro, 0, n_micro - 1)
+                park = s_active & (stage == 0)
+                inbuf = jax.lax.dynamic_update_index_in_dim(
+                    inbuf, jnp.where(park, nxt, inbuf[s_mb]), s_mb, 0)
+            return (nxt, inbuf, outputs), None
 
-        (_, outputs), _ = jax.lax.scan(
-            tick, (state, outputs), jnp.arange(n_micro + n_stages - 1)
+        (_, _, outputs), _ = jax.lax.scan(
+            tick, (state, inbuf, outputs), jnp.arange(total_ticks)
         )
         # replicate the last stage's outputs across pp so downstream (loss)
         # code sees a normal replicated activation
         outputs = jax.lax.psum(
-            jnp.where(is_last, outputs, jnp.zeros_like(outputs)), pp_axis
+            jnp.where(is_last_dev, outputs, jnp.zeros_like(outputs)), pp_axis
         )
         return outputs
 

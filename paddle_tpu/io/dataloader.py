@@ -3,13 +3,17 @@
 Reference: python/paddle/io/reader.py:218 (DataLoader) and the multiprocess
 worker loop (dataloader/dataloader_iter.py:451, worker.py _worker_loop).
 TPU-native design: collation produces numpy batches; a background
-prefetch thread (or a multiprocessing pool for num_workers>0) keeps a small
-queue full so host→device transfer overlaps XLA's async execution.
+prefetch thread overlaps host work with XLA's async execution, and
+``num_workers>0`` runs REAL worker processes (fork) that fetch + collate
+samples to numpy off the main process — device arrays are only created in
+the parent (jax state does not survive into forked children safely).
 """
 from __future__ import annotations
 
+import multiprocessing as mp
 import queue
 import threading
+import traceback
 from typing import Any, Callable, Optional
 
 import numpy as np
@@ -19,24 +23,66 @@ from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
 
-def default_collate_fn(batch):
+def numpy_collate_fn(batch):
+    """Collate to NUMPY (worker-process safe — no device arrays)."""
     sample = batch[0]
-    if isinstance(sample, (Tensor,)):
-        import jax.numpy as jnp
-
-        return Tensor(jnp.stack([s._value for s in batch]))
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._value) for s in batch])
     if isinstance(sample, np.ndarray):
-        return to_tensor(np.stack(batch))
+        return np.stack(batch)
     if isinstance(sample, (int, np.integer)):
-        return to_tensor(np.asarray(batch, dtype=np.int64))
+        return np.asarray(batch, dtype=np.int64)
     if isinstance(sample, (float, np.floating)):
-        return to_tensor(np.asarray(batch, dtype=np.float32))
+        return np.asarray(batch, dtype=np.float32)
     if isinstance(sample, (list, tuple)):
         transposed = list(zip(*batch))
-        return [default_collate_fn(list(s)) for s in transposed]
+        return [numpy_collate_fn(list(s)) for s in transposed]
     if isinstance(sample, dict):
-        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+        return {k: numpy_collate_fn([d[k] for d in batch]) for k in sample}
     return batch
+
+
+def _to_device_tree(obj):
+    """numpy leaves -> Tensor (parent-process side of the worker pipeline)."""
+    if isinstance(obj, np.ndarray):
+        return to_tensor(obj)
+    if isinstance(obj, list):
+        return [_to_device_tree(o) for o in obj]
+    if isinstance(obj, tuple):
+        return tuple(_to_device_tree(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _to_device_tree(v) for k, v in obj.items()}
+    return obj
+
+
+def default_collate_fn(batch):
+    return _to_device_tree(numpy_collate_fn(batch))
+
+
+class _WorkerError:
+    def __init__(self, exc):
+        self.msg = "".join(traceback.format_exception(exc))
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, init_fn, wid):
+    """Worker process body (reference: io/dataloader/worker.py _worker_loop).
+    Receives (batch_idx, indices); sends (batch_idx, numpy_batch)."""
+    try:
+        if init_fn is not None:
+            init_fn(wid)
+    except BaseException as e:  # noqa: BLE001
+        data_queue.put((-1, _WorkerError(e)))
+        return
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        bidx, indices = item
+        try:
+            batch = collate_fn([dataset[i] for i in indices])
+            data_queue.put((bidx, batch))
+        except BaseException as e:  # noqa: BLE001
+            data_queue.put((bidx, _WorkerError(e)))
 
 
 class DataLoader:
@@ -64,6 +110,8 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
         self.use_buffer_reader = use_buffer_reader
+        self._worker_init_fn = worker_init_fn
+        self._timeout = timeout
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -95,7 +143,88 @@ class DataLoader:
         for indices in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in indices])
 
+    def _mp_batches(self):
+        """Multiprocess pipeline: fork ``num_workers`` processes, round-robin
+        index batches, reorder results (reference dataloader_iter.py:451
+        _DataLoaderIterMultiProcess)."""
+        ctx = mp.get_context("fork")
+        # workers apply the user's collate when given one, else numpy
+        # collate; Tensor conversion always happens in the parent
+        user_collate = (self.collate_fn
+                        if self.collate_fn is not default_collate_fn
+                        else numpy_collate_fn)
+        index_queues = [ctx.Queue() for _ in range(self.num_workers)]
+        data_queue = ctx.Queue()
+        workers = []
+        for wid in range(self.num_workers):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, index_queues[wid], data_queue,
+                      user_collate, self._worker_init_fn, wid),
+                daemon=True,
+            )
+            w.start()
+            workers.append(w)
+        try:
+            all_batches = list(self.batch_sampler)
+            n = len(all_batches)
+            window = self.num_workers * self.prefetch_factor
+            sent = 0
+            for sent in range(min(window, n)):
+                index_queues[sent % self.num_workers].put(
+                    (sent, all_batches[sent]))
+            sent = min(window, n)
+            received = {}
+            next_out = 0
+            timeout = self._timeout or None
+            while next_out < n:
+                import time as _time
+
+                deadline = (_time.monotonic() + timeout) if timeout else None
+                while next_out not in received:
+                    # poll in short slices so a worker that died WITHOUT
+                    # enqueueing an error (OOM-kill, segfault) raises
+                    # instead of hanging the training process forever
+                    try:
+                        bidx, payload = data_queue.get(timeout=5.0)
+                    except queue.Empty:
+                        dead = [w.pid for w in workers if not w.is_alive()]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker(s) {dead} died "
+                                "unexpectedly (killed or crashed without "
+                                "reporting an error)")
+                        if deadline and _time.monotonic() > deadline:
+                            raise RuntimeError(
+                                f"DataLoader timed out after {timeout}s "
+                                "waiting for a worker batch")
+                        continue
+                    if isinstance(payload, _WorkerError):
+                        raise RuntimeError(
+                            f"DataLoader worker failed:\n{payload.msg}")
+                    received[bidx] = payload
+                batch = received.pop(next_out)
+                if sent < n:
+                    index_queues[sent % self.num_workers].put(
+                        (sent, all_batches[sent]))
+                    sent += 1
+                next_out += 1
+                yield _to_device_tree(batch)
+        finally:
+            for iq in index_queues:
+                try:
+                    iq.put(None)
+                except Exception:
+                    pass
+            for w in workers:
+                w.join(timeout=1.0)
+                if w.is_alive():
+                    w.terminate()
+
     def __iter__(self):
+        if self.num_workers > 0 and not self._iterable_mode:
+            yield from self._mp_batches()
+            return
         if not self.use_buffer_reader:
             yield from self._batches()
             return

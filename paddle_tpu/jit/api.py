@@ -85,6 +85,8 @@ class _CompiledEntry:
     __slots__ = (
         "jitted",
         "captured",
+        "mut_caps",
+        "ro_caps",
         "mutated_order",
         "out_spec",
         "n_args",
@@ -95,6 +97,14 @@ class _CompiledEntry:
     def __init__(self):
         self.jitted = None
         self.captured: List[Tensor] = []
+        # captured state split by the scout pass: tensors the function
+        # re-binds (params, moments, RNG state) vs read-only state.  The
+        # mutated ones are DONATED to XLA (jax.jit donate_argnums) so the
+        # update aliases into the same HBM buffers instead of
+        # double-buffering params+moments across the step — the analog of
+        # the reference's inplace op outputs (paddle inplace pass).
+        self.mut_caps: List[Tensor] = []
+        self.ro_caps: List[Tensor] = []
         self.mutated_order: List[Tensor] = []
         self.out_spec = None
         self.n_args = 0
@@ -144,8 +154,9 @@ class StaticFunction:
             return entry._scout_result
 
         raw_args = [t._value for t in arg_tensors]
-        raw_caps = [t._value for t in entry.captured]
-        out_raws, new_states = entry.jitted(raw_args, raw_caps)
+        raw_mut = [t._value for t in entry.mut_caps]
+        raw_ro = [t._value for t in entry.ro_caps]
+        out_raws, new_states = entry.jitted(raw_args, raw_mut, raw_ro)
         for t, v in zip(entry.mutated_order, new_states):
             t._value = v  # direct write; no re-logging
         return _tree_unflatten(entry.out_spec, list(out_raws))
@@ -185,42 +196,55 @@ class StaticFunction:
             ):
                 captured.append(t)
         entry.captured = captured
+        # split: state the scout saw re-bound is donated; read-only is not
+        mut_ids = set(mut_log.keys())
+        entry.mut_caps = [t for t in captured if id(t) in mut_ids]
+        entry.ro_caps = [t for t in captured if id(t) not in mut_ids]
         entry.n_args = len(arg_tensors)
 
         out_tensors: List[Tensor] = []
         entry.out_spec = _tree_flatten(result, out_tensors)
         entry._scout_result = result  # type: ignore[attr-defined]
 
-        # 2. build the pure function over (args, captured)
+        # 2. build the pure function over (args, mut-captured, ro-captured)
         fn = self._fn
-        cap_list = captured
+        mut_list = entry.mut_caps
+        ro_list = entry.ro_caps
         arg_spec = _tree_flatten((args, kwargs), [])
 
-        def pure_fn(raw_args, raw_caps):
+        def pure_fn(raw_args, raw_mut, raw_ro):
             # bind tracers into the live Tensor objects, run, then restore
-            snapshot = [(t, t._value, t.grad) for t in cap_list]
+            cap_pairs = list(zip(mut_list, raw_mut)) + list(zip(ro_list, raw_ro))
+            snapshot = [(t, t._value, t.grad) for t, _ in cap_pairs]
             mut: Dict[int, Tensor] = {}
             prev_m = dispatch._trace_state.mutation_log
             prev_t = _jit_state.tracing
             dispatch._trace_state.mutation_log = mut
             _jit_state.tracing = True
             try:
-                for t, rv in zip(cap_list, raw_caps):
+                for t, rv in cap_pairs:
                     t._value = rv
                 a, kw = _tree_unflatten(arg_spec, list(raw_args))
                 res = fn(*a, **kw)
                 outs: List[Tensor] = []
                 _tree_flatten(res, outs)
                 out_raws = tuple(o._value for o in outs)
-                # stable mutation order: captured order first, then other
-                # pre-existing tensors; call-local tensors die with the call
-                order = [t for t in cap_list if id(t) in mut]
+                # stable mutation order: ALL donated tensors first (their
+                # final values alias the donated input buffers — tensors the
+                # trace didn't touch pass through unchanged), then any other
+                # pre-existing mutated tensors discovered during the trace;
+                # call-local tensors die with the call
+                order = list(mut_list)
                 extra = [
                     t
                     for t in mut.values()
-                    if t._gen < entry.gen_threshold and not any(t is o for o in order)
+                    if t._gen < entry.gen_threshold
+                    and not any(t is o for o in order)
+                    and not any(t is r for r in ro_list)
                 ]
                 order.extend(extra)
+                ro_mutated = [t for t in ro_list if id(t) in mut]
+                order.extend(ro_mutated)
                 entry.mutated_order = order
                 new_states = tuple(t._value for t in order)
                 return out_raws, new_states
@@ -231,7 +255,7 @@ class StaticFunction:
                     t._value = v
                     t.grad = g
 
-        entry.jitted = jax.jit(pure_fn)
+        entry.jitted = jax.jit(pure_fn, donate_argnums=(1,))
         self._cache[key] = entry
         return entry
 

@@ -73,6 +73,7 @@ class GPTConfig:
     use_tensor_parallel: bool = False   # mpu layers over the 'mp' axis
     sequence_parallel: bool = False     # shard activations over 'sp'
     recompute_interval: int = 0         # 0 = off; k = remat every k blocks
+    virtual_pp_degree: int = 1          # interleaved virtual stages per device
     # Tri-state SDPA routing: None = defer to FLAGS_use_pallas_flash_attention
     # (default), True = force the pallas kernel (when shape-eligible),
     # False = force the plain XLA expression.
@@ -302,11 +303,12 @@ class GPTStackedDecoder(Layer):
                     f"num_layers={L} must be divisible by the pp mesh axis "
                     f"size {pp} (uniform stage segmenting)")
         std = cfg.initializer_range
-        # derive the init stream from the global generator so pt.seed()
-        # controls stacked-decoder init like every other layer
-        from ..ops.random import derive_numpy_rng
-
-        rng = derive_numpy_rng()
+        # derive init keys from the global generator so pt.seed() controls
+        # stacked-decoder init like every other layer.  Init runs ON DEVICE
+        # (jax.random.normal) — at 1B+ scale, host-side numpy init would
+        # mean multi-GB host->device transfers, which are both slow and, on
+        # tunneled PJRT backends, a reliability hazard.
+        from ..ops.random import default_generator
 
         def mk(shape, init="normal"):
             if init == "zeros":
@@ -314,7 +316,8 @@ class GPTStackedDecoder(Layer):
             elif init == "ones":
                 raw = jnp.ones(shape, jnp.float32)
             else:
-                raw = jnp.asarray(rng.randn(*shape).astype(np.float32) * std)
+                key = default_generator.split()
+                raw = jax.random.normal(key, list(shape), jnp.float32) * std
             return Parameter(raw, trainable=True)
 
         self.ln1_g = mk([L, h], "ones")
@@ -364,7 +367,29 @@ class GPTStackedDecoder(Layer):
         hid_p = cfg.hidden_dropout
         with_dropout = self.training and (attn_p > 0.0 or hid_p > 0.0)
 
+        # AMP O1 inside the fused block: matmuls/attention run in the amp
+        # dtype (MXU path), LayerNorm/softmax/residual stay fp32 — the same
+        # split the per-op white/black lists give the unfused model
+        # (reference amp_lists.py), applied here as explicit casts because
+        # the whole block is a single dispatched op.
+        from ..amp.auto_cast import _amp_state
+
+        cdt = _amp_state.dtype if (_amp_state.enabled and _amp_state.level == "O1") else None
+
+        use_flash = cfg.use_flash_attention
+        if use_flash is None:
+            from ..core import flags as _flags
+
+            use_flash = bool(_flags.flag("FLAGS_use_pallas_flash_attention"))
+
+        def _on_tpu():
+            try:
+                return jax.devices()[0].platform == "tpu"
+            except Exception:
+                return False
+
         def ln(x, g, b):
+            x = x.astype(jnp.float32)
             mu = x.mean(-1, keepdims=True)
             var = ((x - mu) ** 2).mean(-1, keepdims=True)
             return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
@@ -376,6 +401,25 @@ class GPTStackedDecoder(Layer):
             mask = jax.random.bernoulli(key, keep, x.shape)
             return jnp.where(mask, x / keep, jnp.zeros_like(x))
 
+        def sdpa(q, k, v, key, s):
+            # Pallas flash kernel when shape-eligible (no attention dropout
+            # path inside the kernel); else the XLA expression with fp32
+            # softmax.  Both see amp-dtype q/k/v.
+            if (use_flash and _on_tpu() and not (with_dropout and attn_p > 0.0)
+                    and s % 128 == 0 and s >= 128 and hd % 64 == 0):
+                from ..ops.pallas_kernels.flash_attention import flash_attention_bnsd
+
+                return flash_attention_bnsd(q, k, v, causal=True,
+                                            sm_scale=float(1.0 / np.sqrt(hd)))
+            scores = jnp.einsum("bnqd,bnkd->bnqk", q, k,
+                                preferred_element_type=jnp.float32)
+            scores = scores * float(1.0 / np.sqrt(hd))
+            causal = jnp.tril(jnp.ones((s, s), jnp.bool_))
+            scores = jnp.where(causal, scores, jnp.asarray(-1e9, scores.dtype))
+            att = jax.nn.softmax(scores, axis=-1)
+            att = drop(att, attn_p, key)
+            return jnp.einsum("bnqk,bnkd->bnqd", att.astype(q.dtype), v)
+
         def block(p, h):
             if with_dropout:
                 *p, key = p
@@ -383,20 +427,24 @@ class GPTStackedDecoder(Layer):
             else:
                 k1 = k2 = k3 = None
             (l1g, l1b, qkvw, qkvb, pw, pb, l2g, l2b, f1w, f1b, f2w, f2b) = p
+            if cdt is not None:
+                qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b = (
+                    a.astype(cdt) for a in (qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b)
+                )
             b, s, hidden = h.shape
             x = ln(h, l1g, l1b)
+            if cdt is not None:
+                x = x.astype(cdt)
             qkv = (x @ qkvw + qkvb).reshape(b, s, 3, nh, hd)
-            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            scores = jnp.einsum("bqnd,bknd->bnqk", q, k) * float(1.0 / np.sqrt(hd))
-            causal = jnp.tril(jnp.ones((s, s), jnp.bool_))
-            scores = jnp.where(causal, scores, jnp.asarray(-1e9, scores.dtype))
-            att = jax.nn.softmax(scores, axis=-1)
-            att = drop(att, attn_p, k1)
-            out = jnp.einsum("bnqk,bknd->bqnd", att, v).reshape(b, s, hidden)
-            h = h + drop(out @ pw + pb, hid_p, k2)
+            q, k, v = (jnp.swapaxes(qkv[:, :, i], 1, 2) for i in range(3))  # [B,N,S,D]
+            out = sdpa(q, k, v, k1, s)                      # [B,N,S,D]
+            out = jnp.swapaxes(out, 1, 2).reshape(b, s, hidden)
+            h = h + drop(out @ pw + pb, hid_p, k2).astype(h.dtype)
             y = ln(h, l2g, l2b)
+            if cdt is not None:
+                y = y.astype(cdt)
             y = jax.nn.gelu(y @ f1w + f1b, approximate=True) @ f2w + f2b
-            return h + drop(y, hid_p, k3)
+            return h + drop(y, hid_p, k3).astype(h.dtype)
 
         return block, with_dropout
 
@@ -440,7 +488,8 @@ class GPTStackedDecoder(Layer):
                 xm = h.reshape(n_micro, mb, *h.shape[1:])
                 out = pp_spmd.pipeline_blocks(
                     block_mb or block, stacked, xm, layers_per_stage=lps,
-                    remat=remat, block_takes_index=block_mb is not None)
+                    remat=remat, block_takes_index=block_mb is not None,
+                    n_virtual=cfg.virtual_pp_degree)
                 return out.reshape(b, *h.shape[1:])
         else:
             def raw(h, *stacked):
@@ -463,11 +512,21 @@ class GPTStackedForPretraining(Layer):
         self.decoder = GPTStackedDecoder(cfg)
         self.final_ln = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
 
-    def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None) -> Tensor:
+    def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None,
+                labels: Optional[Tensor] = None) -> Tensor:
+        """Without ``labels``: returns [B, S, V] logits.  With ``labels``:
+        returns the scalar LM loss through the fused linear+cross-entropy
+        head (chunked over tokens, logits never fully materialized — the
+        HBM-friendly path; see F.fused_linear_cross_entropy)."""
         h = self.embeddings(input_ids, position_ids)
         h = self.decoder(h, n_micro=self.n_micro)
         h = self.final_ln(h)
         w = self.embeddings.word_embeddings.weight
+        if labels is not None:
+            from ..amp.auto_cast import _amp_state
+
+            cdt = _amp_state.dtype if _amp_state.enabled else None
+            return F.fused_linear_cross_entropy(h, w, labels, compute_dtype=cdt)
         return ops.matmul(h, w, transpose_y=True)
 
 

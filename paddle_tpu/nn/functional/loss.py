@@ -271,3 +271,66 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction
 def square_error_cost(input, label):  # noqa: A002
     input, label = ensure_tensor(input), ensure_tensor(label)
     return dispatch.apply(lambda a, b: jnp.square(a - b), input, label, op_name="square_error_cost")
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, *, chunk_tokens=2048,
+                               compute_dtype=None, reduction="mean"):
+    """LM-head matmul + softmax cross entropy without materializing the full
+    [N, V] logits for backward.
+
+    Reference analog: phi/kernels/gpu/cross_entropy_kernel.cu (fused
+    softmax+CE) and operators/fused — but redesigned for the TPU memory
+    hierarchy: tokens are processed in chunks under ``jax.checkpoint`` inside
+    a ``lax.scan``, so at any moment only one chunk's logits live in HBM
+    (fwd AND bwd — backward recomputes the chunk's logits, forms the
+    softmax-minus-onehot product locally, and accumulates dW / dhidden).
+
+    hidden: [..., H]; weight: [V, H] (tied LM head); labels: int[...].
+    Returns scalar (mean/sum over tokens) or per-token loss [N].
+    """
+    hidden, weight, labels = (
+        ensure_tensor(hidden), ensure_tensor(weight), ensure_tensor(labels),
+    )
+
+    def fn(h, w, lab):
+        hs = h.shape[-1]
+        h2 = h.reshape(-1, hs)
+        lab1 = lab.reshape(-1).astype(jnp.int32)
+        n = h2.shape[0]
+        c = min(chunk_tokens, n)
+        # pad to a whole number of chunks (padded tokens masked out)
+        pad = (-n) % c
+        if pad:
+            h2 = jnp.concatenate([h2, jnp.zeros((pad, hs), h2.dtype)], 0)
+            lab1 = jnp.concatenate([lab1, jnp.zeros((pad,), lab1.dtype)], 0)
+        n_chunks = (n + pad) // c
+        hc = h2.reshape(n_chunks, c, hs)
+        lc = lab1.reshape(n_chunks, c)
+        cdt = compute_dtype or h.dtype
+        wt = w.astype(cdt)
+
+        @jax.checkpoint
+        def chunk_loss(hx, lx):
+            # fp32 accumulation on the MXU out of low-precision operands
+            logits = jax.lax.dot_general(
+                hx.astype(cdt), wt, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [c, V]
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            picked = jnp.take_along_axis(logits, lx[:, None], axis=-1)[:, 0]
+            return lse - picked  # [c]
+
+        def step(_, xs):
+            hx, lx = xs
+            return None, chunk_loss(hx, lx)
+
+        _, losses = jax.lax.scan(step, None, (hc, lc))
+        losses = losses.reshape(-1)[:n]
+        if reduction == "mean":
+            return jnp.mean(losses)
+        if reduction == "sum":
+            return jnp.sum(losses)
+        return losses
+
+    return dispatch.apply(fn, hidden, weight, labels,
+                          op_name="fused_linear_cross_entropy")

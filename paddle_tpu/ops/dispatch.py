@@ -170,6 +170,7 @@ def apply(raw_fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
         inputs=inputs,
         out_avals=tuple((o.shape, o.dtype) for o in outs_raw),
         name=op_name or getattr(raw_fn, "__name__", "op"),
+        fwd=fwd,
     )
     outs = []
     for i, o in enumerate(outs_raw):

@@ -1,16 +1,36 @@
-"""Flash attention on TPU via Pallas (reference analog:
-paddle/phi/kernels/gpu/flash_attn_kernel.cu dynloading third_party/flashattn).
+"""Flash attention on TPU — an owned Pallas kernel (fwd + bwd).
 
-On TPU the memory-hierarchy-aware attention kernel is a Pallas/Mosaic
-program; jax ships a maintained implementation
-(jax.experimental.pallas.ops.tpu.flash_attention) which we use as the
-kernel body — the wrapper adapts layouts ([B,S,N,D] <-> [B,N,S,D]) and
-falls back to the XLA einsum expression on CPU (pallas interpret mode is
-too slow for tests)."""
+Reference analog: paddle/phi/kernels/gpu/flash_attn_kernel.cu (which
+dynloads third_party/flashattn).  On TPU the memory-hierarchy-aware
+attention kernel is a Pallas/Mosaic program written here from scratch:
+
+- forward: online-softmax accumulation over KV blocks (running max m,
+  running denominator l, f32 accumulator), causal blocks skipped at the
+  grid level with ``pl.when``; saves per-row logsumexp for backward.
+- backward: two kernels — one accumulating dK/dV per KV block over Q
+  blocks, one accumulating dQ per Q block over KV blocks — both
+  recomputing the probability matrix from (q, k, lse) instead of saving
+  the [S, S] attention matrix, which is the whole point of flash
+  attention.  ``delta = rowsum(dO * O)`` is precomputed in XLA.
+
+All index maps use plain int arithmetic (no lax.select), so the kernel
+traces cleanly whether or not the framework's int64 (x64) mode is on —
+the shipped jax kernel does not.
+
+Layouts: ``flash_attention_bnsd`` takes [B, N, S, D] (head-major);
+``flash_attention_bshd`` adapts [B, S, N, D].  CPU falls back to the
+numerically-identical XLA expression (pallas interpret mode is too slow
+for tests).
+"""
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+import numpy as np
 
 
 def _on_tpu() -> bool:
@@ -20,24 +40,330 @@ def _on_tpu() -> bool:
         return False
 
 
-def flash_attention_bshd(q, k, v, *, causal: bool = False):
-    """q/k/v: [B, S, N, D] -> [B, S, N, D]."""
-    scale = float(1.0 / (q.shape[-1] ** 0.5))
+NEG_INF = np.float32(-1e30)
+
+
+# ---------------------------------------------------------------------------
+# forward kernel
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
+                *, scale, causal, block_q, block_kv, n_kv):
+    kv_i = pl.program_id(2)
+    q_i = pl.program_id(1)
+
+    @pl.when(kv_i == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+
+    # causal: a KV block strictly above the diagonal contributes nothing
+    run = True
+    if causal:
+        run = kv_i * block_kv <= q_i * block_q + (block_q - 1)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)            # [block_q, D]
+        k = k_ref[0].astype(jnp.float32)            # [block_kv, D]
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * np.float32(scale)
+        if causal:
+            rows = q_i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            cols = kv_i * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+
+        m_prev = m_sc[:, :1]                        # [block_q, 1]
+        l_prev = l_sc[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)  # [block_q, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                      # [block_q, block_kv]
+        l_cur = jnp.sum(p, axis=-1, keepdims=True)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + l_cur
+        acc_sc[...] = acc_sc[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(kv_i == n_kv - 1)
+    def _finish():
+        l = l_sc[:, :1]
+        l_safe = jnp.where(l == 0.0, np.float32(1.0), l)
+        o_ref[0] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
+        # [block_q, 1] -> [1, block_q] -> sublane-broadcast [8, block_q]
+        # (TPU block shapes need the 2nd-minor dim to be a multiple of 8)
+        lse = jnp.transpose(m_sc[:, :1] + jnp.log(l_safe))
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape).astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_kv):
+    bn, s, d = q.shape
+    n_q = s // block_q
+    n_kv = s // block_kv
+    grid = (bn, n_q, n_kv)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_kv=block_kv, n_kv=n_kv)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, np.int32(0))),
+            pl.BlockSpec((1, block_kv, d), lambda b, qi, ki: (b, ki, np.int32(0))),
+            pl.BlockSpec((1, block_kv, d), lambda b, qi, ki: (b, ki, np.int32(0))),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, np.int32(0))),
+            pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b, np.int32(0), qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bn, 8, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(q, k, v)
+    return out, lse[:, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_sc, dv_sc,
+                    *, scale, causal, block_q, block_kv, n_q):
+    kv_i = pl.program_id(1)
+    q_i = pl.program_id(2)
+
+    @pl.when(q_i == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    run = True
+    if causal:
+        # a Q block strictly above this KV block never attends to it
+        run = q_i * block_q + (block_q - 1) >= kv_i * block_kv
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)             # [block_q, D]
+        k = k_ref[0].astype(jnp.float32)             # [block_kv, D]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)           # [block_q, D]
+        lse = jnp.transpose(lse_ref[0][:1, :])       # [block_q, 1]
+        delta = jnp.transpose(delta_ref[0][:1, :])   # [block_q, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * np.float32(scale)
+        if causal:
+            rows = q_i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            cols = kv_i * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                         # [block_q, block_kv]
+        # dV += P^T dO
+        dv_sc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        # dP = dO V^T ; dS = P * (dP - delta)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # dK += dS^T Q * scale
+        dk_sc[...] += np.float32(scale) * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(q_i == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_sc,
+                   *, scale, causal, block_q, block_kv, n_kv):
+    q_i = pl.program_id(1)
+    kv_i = pl.program_id(2)
+
+    @pl.when(kv_i == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    run = True
+    if causal:
+        run = kv_i * block_kv <= q_i * block_q + (block_q - 1)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = jnp.transpose(lse_ref[0][:1, :])       # [block_q, 1]
+        delta = jnp.transpose(delta_ref[0][:1, :])   # [block_q, 1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * np.float32(scale)
+        if causal:
+            rows = q_i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            cols = kv_i * block_kv + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_sc[...] += np.float32(scale) * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(kv_i == n_kv - 1)
+    def _finish():
+        dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, scale, causal, block_q, block_kv):
+    bn, s, d = q.shape
+    n_q = s // block_q
+    n_kv = s // block_kv
+    # delta_i = rowsum(dO_i * O_i): cheap elementwise+reduce, done in XLA
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    # sublane-broadcast [bn, s] -> [bn, 8, s] for legal TPU block shapes
+    lse = jnp.broadcast_to(lse[:, None, :], (bn, 8, s))
+    delta = jnp.broadcast_to(delta[:, None, :], (bn, 8, s))
+
+    dkv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv, n_q=n_q),
+        grid=(bn, n_kv, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, np.int32(0))),
+            pl.BlockSpec((1, block_kv, d), lambda b, ki, qi: (b, ki, np.int32(0))),
+            pl.BlockSpec((1, block_kv, d), lambda b, ki, qi: (b, ki, np.int32(0))),
+            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, np.int32(0))),
+            pl.BlockSpec((1, 8, block_q), lambda b, ki, qi: (b, np.int32(0), qi)),
+            pl.BlockSpec((1, 8, block_q), lambda b, ki, qi: (b, np.int32(0), qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, d), lambda b, ki, qi: (b, ki, np.int32(0))),
+            pl.BlockSpec((1, block_kv, d), lambda b, ki, qi: (b, ki, np.int32(0))),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bn, s, d), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, d), jnp.float32),
+            pltpu.VMEM((block_kv, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(q, k, v, do, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_kv=block_kv, n_kv=n_kv),
+        grid=(bn, n_q, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, np.int32(0))),
+            pl.BlockSpec((1, block_kv, d), lambda b, qi, ki: (b, ki, np.int32(0))),
+            pl.BlockSpec((1, block_kv, d), lambda b, qi, ki: (b, ki, np.int32(0))),
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, np.int32(0))),
+            pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b, np.int32(0), qi)),
+            pl.BlockSpec((1, 8, block_q), lambda b, qi, ki: (b, np.int32(0), qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, np.int32(0))),
+        out_shape=jax.ShapeDtypeStruct((bn, s, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(q, k, v, do, lse, delta)
+
+    return dq, dkv[0], dkv[1]
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper (head-major [B, N, S, D])
+# ---------------------------------------------------------------------------
+
+def _pick_blocks(s: int):
+    bq = min(512, s)
+    bkv = min(512, s)
+    while s % bq:
+        bq //= 2
+    while s % bkv:
+        bkv //= 2
+    return max(bq, 128) if s % max(bq, 128) == 0 else bq, \
+        max(bkv, 128) if s % max(bkv, 128) == 0 else bkv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_bnsd(q, k, v, causal, scale):
+    out, _ = _flash_bnsd_fwd(q, k, v, causal, scale)
+    return out
+
+
+def _flash_bnsd_fwd(q, k, v, causal, scale):
+    b, n, s, d = q.shape
+    bq, bkv = _pick_blocks(s)
+    fq, fk, fv = (t.reshape(b * n, s, d) for t in (q, k, v))
+    out, lse = _flash_fwd(fq, fk, fv, scale, causal, bq, bkv)
+    return out.reshape(b, n, s, d), (q, k, v, out.reshape(b, n, s, d), lse)
+
+
+def _flash_bnsd_bwd(causal, scale, res, g):
+    q, k, v, out, lse = res
+    b, n, s, d = q.shape
+    bq, bkv = _pick_blocks(s)
+    dq, dk, dv = _flash_bwd(
+        q.reshape(b * n, s, d), k.reshape(b * n, s, d), v.reshape(b * n, s, d),
+        out.reshape(b * n, s, d), lse, g.reshape(b * n, s, d),
+        scale, causal, bq, bkv)
+    return (dq.reshape(b, n, s, d), dk.reshape(b, n, s, d),
+            dv.reshape(b, n, s, d))
+
+
+_flash_bnsd.defvjp(_flash_bnsd_fwd, _flash_bnsd_bwd)
+
+
+def flash_attention_bnsd(q, k, v, *, causal: bool = False, sm_scale=None):
+    """q/k/v: [B, N, S, D] -> [B, N, S, D] (head-major layout)."""
+    scale = float(sm_scale if sm_scale is not None else 1.0 / (q.shape[-1] ** 0.5))
     if _on_tpu():
-        from jax.experimental.pallas.ops.tpu.flash_attention import (
-            flash_attention as _pallas_flash,
-        )
+        return _flash_bnsd(q, k, v, causal, scale)
+    return _xla_reference_bnsd(q, k, v, causal, scale)
 
-        qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))  # [B,N,S,D]
-        out = _pallas_flash(qh, kh, vh, causal=causal, sm_scale=scale)
-        return jnp.swapaxes(out, 1, 2)
 
-    # CPU fallback: numerically identical XLA expression
-    qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
-    s = jnp.einsum("bnqd,bnkd->bnqk", qh, kh) * scale
+def _xla_reference_bnsd(qh, kh, vh, causal, scale):
+    s = jnp.einsum("bnqd,bnkd->bnqk", qh, kh,
+                   preferred_element_type=jnp.float32) * np.float32(scale)
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        s = jnp.where(mask, s, jnp.finfo(s.dtype).min)
+        s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.swapaxes(jnp.einsum("bnqk,bnkd->bnqd", p, vh), 1, 2)
+    return jnp.einsum("bnqk,bnkd->bnqd", p.astype(qh.dtype), vh)
+
+
+def flash_attention_bshd(q, k, v, *, causal: bool = False):
+    """q/k/v: [B, S, N, D] -> [B, S, N, D]."""
+    scale = float(1.0 / (q.shape[-1] ** 0.5))
+    qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))  # [B,N,S,D]
+    if _on_tpu():
+        out = _flash_bnsd(qh, kh, vh, causal, scale)
+    else:
+        out = _xla_reference_bnsd(qh, kh, vh, causal, scale)
+    return jnp.swapaxes(out, 1, 2)

@@ -123,8 +123,13 @@ class Adam(Optimizer):
 
     def _create_accumulators(self, params):
         for p in params:
-            self._add_accumulator("moment1", p)
-            self._add_accumulator("moment2", p)
+            # multi_precision: fp32 moments + fp32 master weights for
+            # low-precision params (reference multi_precision adam);
+            # without it moments live in the PARAM dtype (the reference's
+            # plain adam kernel) — the pure-bf16 low-memory regime.
+            acc_dt = None if self._multi_precision else p._value.dtype
+            self._add_accumulator("moment1", p, dtype=acc_dt)
+            self._add_accumulator("moment2", p, dtype=acc_dt)
         self._aux_state[0] = Tensor(jnp.asarray(1.0, jnp.float32))  # beta1^t
         self._aux_state[1] = Tensor(jnp.asarray(1.0, jnp.float32))  # beta2^t
         # fp32 master weights for low-precision params (reference
@@ -177,8 +182,8 @@ class Adam(Optimizer):
         m1_hat = new_m1 / (1 - b1p)
         m2_hat = new_m2 / (1 - b2p)
         new_p = pv - lr * m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
-        m1._set_value(new_m1)
-        m2._set_value(new_m2)
+        m1._set_value(new_m1.astype(m1._value.dtype))
+        m2._set_value(new_m2.astype(m2._value.dtype))
         if master is not None:
             master._set_value(new_p)
         p._set_value(new_p.astype(p._value.dtype))
@@ -225,8 +230,8 @@ class AdamW(Adam):
         if decay:
             new_p = new_p * (1.0 - lr * self._wd_coeff)
         new_p = new_p - lr * m1_hat / (jnp.sqrt(m2_hat) + self._epsilon)
-        m1._set_value(new_m1)
-        m2._set_value(new_m2)
+        m1._set_value(new_m1.astype(m1._value.dtype))
+        m2._set_value(new_m2.astype(m2._value.dtype))
         if master is not None:
             master._set_value(new_p)
         p._set_value(new_p.astype(p._value.dtype))
@@ -329,6 +334,6 @@ class Lamb(Optimizer):
         w_norm = jnp.linalg.norm(pv)
         u_norm = jnp.linalg.norm(update)
         trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
-        m1._set_value(new_m1)
-        m2._set_value(new_m2)
+        m1._set_value(new_m1.astype(m1._value.dtype))
+        m2._set_value(new_m2.astype(m2._value.dtype))
         p._set_value((pv - lr * trust * update).astype(p._value.dtype))

@@ -1,0 +1,11 @@
+#!/bin/bash
+# CPU test runner: sanitized env (no TPU site-hook), 8 virtual devices.
+export JAX_PLATFORMS=cpu
+export PYTHONPATH=$(python - << 'PY'
+import os
+print(os.pathsep.join([p for p in os.environ.get('PYTHONPATH','').split(os.pathsep) if p and 'axon' not in p]+['/root/repo']))
+PY
+)
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+export JAX_COMPILATION_CACHE_DIR=/tmp/paddle_tpu_jax_cache
+exec python -m pytest "$@"

@@ -1,0 +1,103 @@
+"""Higher-order autograd: create_graph, double grad, jacobian, hessian.
+
+Reference analog: test/legacy_test/test_imperative_double_grad.py and
+python/paddle/autograd/autograd.py Jacobian/Hessian tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import autograd
+
+
+def test_double_grad_scalar():
+    # y = x^3 -> dy/dx = 3x^2 -> d2y/dx2 = 6x
+    x = pt.to_tensor(2.0, stop_gradient=False)
+    y = x * x * x
+    (dx,) = autograd.grad([y], [x], create_graph=True)
+    assert dx.stop_gradient is False
+    np.testing.assert_allclose(float(dx), 12.0, rtol=1e-6)
+    (ddx,) = autograd.grad([dx], [x])
+    np.testing.assert_allclose(float(ddx), 12.0, rtol=1e-6)
+
+
+def test_double_grad_vector():
+    xv = np.array([1.0, 2.0, 3.0], np.float32)
+    x = pt.to_tensor(xv, stop_gradient=False)
+    y = pt.ops.sum(x * x * x)
+    (dx,) = autograd.grad([y], [x], create_graph=True)
+    np.testing.assert_allclose(dx.numpy(), 3 * xv**2, rtol=1e-5)
+    z = pt.ops.sum(dx)
+    (ddx,) = autograd.grad([z], [x])
+    np.testing.assert_allclose(ddx.numpy(), 6 * xv, rtol=1e-5)
+
+
+def test_triple_grad():
+    x = pt.to_tensor(1.5, stop_gradient=False)
+    y = x * x * x * x  # y = x^4
+    (d1,) = autograd.grad([y], [x], create_graph=True)
+    (d2,) = autograd.grad([d1], [x], create_graph=True)
+    (d3,) = autograd.grad([d2], [x])
+    np.testing.assert_allclose(float(d3), 24 * 1.5, rtol=1e-5)  # 24x
+
+
+def test_double_grad_through_matmul():
+    a = np.random.RandomState(0).randn(3, 3).astype(np.float32)
+    x = pt.to_tensor(a, stop_gradient=False)
+    y = pt.ops.sum(pt.ops.matmul(x, x))
+    (dx,) = autograd.grad([y], [x], create_graph=True)
+    # d/dX sum(X@X) = (X@X grad): ones@X^T + X^T@ones
+    ones = np.ones((3, 3), np.float32)
+    expected = ones @ a.T + a.T @ ones
+    np.testing.assert_allclose(dx.numpy(), expected, rtol=1e-5)
+    z = pt.ops.sum(dx * dx)
+    (ddx,) = autograd.grad([z], [x])
+    assert ddx.shape == [3, 3]
+    assert np.isfinite(ddx.numpy()).all()
+
+
+def test_hessian_quadratic():
+    # f(x) = x^T A x  ->  H = A + A^T
+    rng = np.random.RandomState(1)
+    a = rng.randn(4, 4).astype(np.float32)
+    A = pt.to_tensor(a)
+    x = pt.to_tensor(rng.randn(4).astype(np.float32), stop_gradient=False)
+    y = pt.ops.sum(x * pt.ops.matmul(A, x))
+    H = autograd.hessian(y, x)
+    np.testing.assert_allclose(H.numpy(), a + a.T, rtol=1e-4, atol=1e-5)
+
+
+def test_jacobian_linear():
+    rng = np.random.RandomState(2)
+    a = rng.randn(3, 5).astype(np.float32)
+    A = pt.to_tensor(a)
+    x = pt.to_tensor(rng.randn(5).astype(np.float32), stop_gradient=False)
+    y = pt.ops.matmul(A, x)
+    J = autograd.jacobian(y, x)
+    np.testing.assert_allclose(J.numpy(), a, rtol=1e-5, atol=1e-6)
+
+
+def test_vjp_jvp():
+    rng = np.random.RandomState(3)
+    xv = rng.randn(4).astype(np.float32)
+    vv = rng.randn(4).astype(np.float32)
+
+    def f(x):
+        return pt.ops.sum(x * x)
+
+    x = pt.to_tensor(xv, stop_gradient=False)
+    v = pt.to_tensor(np.float32(1.0))
+    _, g = autograd.vjp(f, x, v)
+    np.testing.assert_allclose(g.numpy(), 2 * xv, rtol=1e-5)
+
+    x2 = pt.to_tensor(xv, stop_gradient=False)
+    _, tangent = autograd.jvp(f, x2, pt.to_tensor(vv))
+    np.testing.assert_allclose(float(tangent), float((2 * xv * vv).sum()), rtol=1e-4)
+
+
+def test_grad_no_create_graph_still_raw():
+    x = pt.to_tensor(3.0, stop_gradient=False)
+    y = x * x
+    (dx,) = autograd.grad([y], [x])
+    assert dx.stop_gradient is True
+    np.testing.assert_allclose(float(dx), 6.0, rtol=1e-6)
