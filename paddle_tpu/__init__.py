@@ -158,6 +158,11 @@ from .nn.param_attr import ParamAttr  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import static  # noqa: E402,F401
+from . import sparse  # noqa: E402,F401
+from . import audio  # noqa: E402,F401
+from . import quantization  # noqa: E402,F401
+from . import inference  # noqa: E402,F401
+from . import onnx  # noqa: E402,F401
 from . import device  # noqa: E402,F401
 from . import version  # noqa: E402,F401
 from .version import __version__  # noqa: E402,F401

@@ -1,6 +1,10 @@
 """Numerical debugging (reference: python/paddle/amp/debugging.py:225
 TensorCheckerConfig / check_numerics, nan/inf hooks eager/nan_inf_utils.cc).
-TPU-native: FLAGS_check_nan_inf gates a per-op finite check in dispatch."""
+TPU-native: FLAGS_check_nan_inf gates a per-op finite check in dispatch —
+strict mode (level 0) syncs per op like the reference's abort mode;
+level>0 accumulates a device-side flag with NO host syncs and
+``finite_check_report()`` reads it once (kernel-granularity checking
+without the per-op sync storm)."""
 from __future__ import annotations
 
 from contextlib import contextmanager
@@ -9,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import flags as _flags
+from ..ops.dispatch import finite_check_report  # noqa: F401
 from ..tensor import Tensor
 
 

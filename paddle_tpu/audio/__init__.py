@@ -1,0 +1,6 @@
+"""audio namespace (reference: python/paddle/audio/ — features, functional,
+backends).  Feature extraction (Spectrogram/Mel/MFCC) is the load-bearing
+surface; file IO backends are gated (no soundfile in the image) with
+numpy-wav fallbacks.
+"""
+from . import backends, features, functional  # noqa: F401

@@ -1,0 +1,74 @@
+"""Audio IO (reference: python/paddle/audio/backends/ — wave_backend.py).
+A pure-stdlib WAV backend (the reference's default backend also falls
+back to python `wave` when soundfile is absent)."""
+from __future__ import annotations
+
+import wave as _wave
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["load", "save", "info", "list_available_backends", "get_current_backend", "set_backend"]
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave_backend":
+        raise ValueError("only the stdlib wave_backend ships in this build")
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True) -> Tuple[Tensor, int]:
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n = f.getnframes()
+        ch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(count)
+    dt = {1: np.int8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dt).reshape(-1, ch)
+    if normalize:
+        data = data.astype(np.float32) / float(np.iinfo(dt).max)
+    arr = data.T if channels_first else data
+    return to_tensor(np.ascontiguousarray(arr)), sr
+
+
+def save(filepath: str, src: Tensor, sample_rate: int,
+         channels_first: bool = True, bits_per_sample: int = 16):
+    import numpy as np
+
+    data = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if channels_first:
+        data = data.T
+    if data.dtype.kind == "f":
+        data = (np.clip(data, -1, 1) * (2 ** (bits_per_sample - 1) - 1)).astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1] if data.ndim > 1 else 1)
+        f.setsampwidth(bits_per_sample // 8)
+        f.setframerate(sample_rate)
+        f.writeframes(data.tobytes())
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_frames, num_channels, bits_per_sample):
+        self.sample_rate = sample_rate
+        self.num_frames = num_frames
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+
+
+def info(filepath: str) -> AudioInfo:
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8)

@@ -1,0 +1,123 @@
+"""Audio feature layers (reference: python/paddle/audio/features/layers.py —
+Spectrogram:24, MelSpectrogram:106, LogMelSpectrogram:206, MFCC:309).
+
+Each layer is a pure function of its input built from the framework's
+stft + matmul ops, so feature extraction fuses into the surrounding
+compiled program (the reference runs these as eager op chains).
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..ops import dispatch
+from ..tensor import Tensor
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.fft_window = AF.get_window(window, self.win_length)
+
+    def forward(self, x: Tensor) -> Tensor:
+        from .. import signal
+
+        stft_out = signal.stft(
+            x, self.n_fft, hop_length=self.hop_length,
+            win_length=self.win_length, window=self.fft_window,
+            center=self.center, pad_mode=self.pad_mode)
+        power = self.power
+
+        def raw(c):
+            return jnp.abs(c) ** power
+
+        return dispatch.apply(raw, stft_out, op_name="spectrogram")
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode)
+        self.fbank_matrix = AF.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm)
+
+    def forward(self, x: Tensor) -> Tensor:
+        spect = self._spectrogram(x)  # [..., freq, time]
+        fb = self.fbank_matrix
+
+        def raw(s, f):
+            return jnp.einsum("mf,...ft->...mt", f, s)
+
+        return dispatch.apply(raw, spect, fb, op_name="mel_spectrogram")
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x: Tensor) -> Tensor:
+        mel = self._melspectrogram(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin, top_db)
+        self.dct_matrix = AF.create_dct(n_mfcc=n_mfcc, n_mels=n_mels)
+
+    def forward(self, x: Tensor) -> Tensor:
+        log_mel = self._log_melspectrogram(x)  # [..., n_mels, time]
+        d = self.dct_matrix
+
+        def raw(s, dm):
+            return jnp.einsum("mk,...mt->...kt", dm, s)
+
+        return dispatch.apply(raw, log_mel, d, op_name="mfcc")

@@ -1,0 +1,159 @@
+"""Audio functional ops (reference: python/paddle/audio/functional/
+functional.py — hz_to_mel/mel_to_hz/mel_frequencies/fft_frequencies/
+compute_fbank_matrix/power_to_db/create_dct; window.py get_window).
+
+Pure jnp expressions over Tensors — the whole mel/MFCC front end
+compiles into the model program under jit.to_static.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+from ..ops._factory import ensure_tensor
+
+__all__ = [
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "power_to_db", "create_dct", "get_window",
+]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """HTK or Slaney mel scale (reference functional.py:22)."""
+    scalar = not isinstance(freq, Tensor)
+    f = np.asarray(freq if scalar else freq._value, np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                       mel)
+    return float(mel) if scalar and mel.ndim == 0 else Tensor(jnp.asarray(mel, jnp.float32))
+
+
+def mel_to_hz(mel, htk: bool = False):
+    scalar = not isinstance(mel, Tensor)
+    m = np.asarray(mel if scalar else mel._value, np.float64)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)), hz)
+    return float(hz) if scalar and hz.ndim == 0 else Tensor(jnp.asarray(hz, jnp.float32))
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0, f_max: float = 11025.0,
+                    htk: bool = False, dtype: str = "float32"):
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = np.linspace(lo, hi, n_mels)
+    hz = np.asarray([mel_to_hz(float(m), htk) for m in mels])
+    return Tensor(jnp.asarray(hz, jnp.float32))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2, dtype=jnp.float32))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: Union[str, float] = "slaney",
+                         dtype: str = "float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2] (reference
+    functional.py:186)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fft_f = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    mel_f = np.asarray(mel_frequencies(n_mels + 2, f_min, f_max, htk)._value)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        fb *= enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        fb = fb / np.maximum(np.linalg.norm(fb, ord=norm, axis=-1, keepdims=True), 1e-10)
+    return Tensor(jnp.asarray(fb, jnp.float32))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """10*log10 with clamping (reference functional.py:259)."""
+    x = ensure_tensor(spect)
+    from ..ops import dispatch
+
+    def raw(s):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, s))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    return dispatch.apply(raw, x, op_name="power_to_db")
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (reference functional.py:303)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2.0)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct.T, jnp.float32))
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True, dtype: str = "float32"):
+    """Window functions (reference window.py get_window: hann/hamming/
+    blackman/bartlett/kaiser/gaussian/...)."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    M = win_length + 1 if fftbins else win_length
+    n = np.arange(M)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * n / (M - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * n / (M - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * n / (M - 1))
+             + 0.08 * np.cos(4 * math.pi * n / (M - 1)))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * n / (M - 1) - 1)
+    elif name == "bohman":
+        x = np.abs(2 * n / (M - 1) - 1)
+        w = (1 - x) * np.cos(math.pi * x) + np.sin(math.pi * x) / math.pi
+    elif name == "kaiser":
+        beta = args[0] if args else 12.0
+        w = np.i0(beta * np.sqrt(1 - (2 * n / (M - 1) - 1) ** 2)) / np.i0(beta)
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = np.exp(-0.5 * ((n - (M - 1) / 2) / std) ** 2)
+    elif name == "triang":
+        w = 1.0 - np.abs((n - (M - 1) / 2) / (M / 2 if M % 2 == 0 else (M + 1) / 2))
+    else:
+        raise ValueError(f"unsupported window {name!r}")
+    if fftbins:
+        w = w[:-1]
+    return Tensor(jnp.asarray(w, jnp.float32))

@@ -110,6 +110,7 @@ def run_backward(
     buffers: Dict[int, List] = {}
     # leaf/captured accumulation keyed by id(Tensor)
     leaf_grads: Dict[int, object] = {}
+    hooked_leaves: Dict[int, object] = {}
 
     roots = []
     for t, g in zip(tensors, grad_tensors):
@@ -186,12 +187,20 @@ def run_backward(
             else:
                 leaf_grads[id(t)] = _accumulate(leaf_grads.get(id(t)), g)
                 if t._hooks:
-                    gval = leaf_grads[id(t)]
-                    for hook in t._hooks.values():
-                        new_g = hook(Tensor(gval, stop_gradient=True))
-                        if new_g is not None:
-                            gval = new_g._value if isinstance(new_g, Tensor) else new_g
-                    leaf_grads[id(t)] = gval
+                    hooked_leaves[id(t)] = t
+
+    # fire leaf hooks ONCE on the fully-accumulated grad (firing per
+    # contribution would re-apply non-idempotent hooks for multi-consumer
+    # leaves like tied embeddings)
+    for tid, t in hooked_leaves.items():
+        gval = leaf_grads.get(tid)
+        if gval is None:
+            continue
+        for hook in t._hooks.values():
+            new_g = hook(Tensor(gval, stop_gradient=True))
+            if new_g is not None:
+                gval = new_g._value if isinstance(new_g, Tensor) else new_g
+        leaf_grads[tid] = gval
 
     if capture is not None:
         for tid in list(capture.keys()):
@@ -219,6 +228,7 @@ def _run_backward_create_graph(tensors, grad_tensors, *, capture=None,
 
     buffers: Dict[int, List] = {}
     leaf_grads: Dict[int, object] = {}
+    hooked_leaves: Dict[int, object] = {}
 
     def acc(slot, value):
         return value if slot is None else slot + value  # dispatched add
@@ -333,6 +343,21 @@ def _run_backward_create_graph(tensors, grad_tensors, *, capture=None,
                     leaf_grads[id(t)] = acc(leaf_grads.get(id(t)), g)
             else:
                 leaf_grads[id(t)] = acc(leaf_grads.get(id(t)), g)
+                if t._hooks:
+                    hooked_leaves[id(t)] = t
+
+    # leaf hooks (ZeRO grad reshard, user hooks) fire ONCE on the final
+    # accumulated grad — same multi-consumer-leaf rule as the first-order
+    # path
+    for tid, t in hooked_leaves.items():
+        gval = leaf_grads.get(tid)
+        if gval is None:
+            continue
+        for hook in t._hooks.values():
+            new_g = hook(gval)
+            if new_g is not None:
+                gval = (new_g if isinstance(new_g, Tensor) else Tensor(new_g))
+        leaf_grads[tid] = gval
 
     if capture is not None:
         for tid in list(capture.keys()):

@@ -74,7 +74,8 @@ def jvp(func, xs, v=None):
     finally:
         for x, sg in zip(xs_l, prev_sg):
             x.stop_gradient = sg
-    one = not isinstance(xs, (list, tuple))
+    # tangents mirror the OUTPUT structure (one per y), not the inputs'
+    one = not isinstance(ys, (list, tuple))
     return ys, (jvps[0] if one else jvps)
 
 

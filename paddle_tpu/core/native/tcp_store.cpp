@@ -291,6 +291,15 @@ int tcp_store_get(int fd, const char* key, uint8_t** out, uint32_t* out_len) {
                  out, out_len);
 }
 
+int tcp_store_delete(int fd, const char* key) {
+  uint8_t* out;
+  uint32_t olen;
+  int rc = request(fd, 4, key, static_cast<uint32_t>(strlen(key)), nullptr, 0,
+                   &out, &olen);
+  if (out) ::free(out);
+  return rc;
+}
+
 // Returns 0 on success with *result set (out-param so legitimate negative
 // counter values are not misread as failures), -1 on transport error.
 int tcp_store_add(int fd, const char* key, int64_t delta, int64_t* result) {

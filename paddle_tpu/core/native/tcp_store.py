@@ -29,6 +29,8 @@ def _lib():
     lib.tcp_store_get.argtypes = [ctypes.c_int, ctypes.c_char_p,
                                   ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
                                   ctypes.POINTER(ctypes.c_uint32)]
+    lib.tcp_store_delete.restype = ctypes.c_int
+    lib.tcp_store_delete.argtypes = [ctypes.c_int, ctypes.c_char_p]
     lib.tcp_store_add.restype = ctypes.c_int
     lib.tcp_store_add.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
                                   ctypes.POINTER(ctypes.c_int64)]
@@ -53,6 +55,10 @@ class TCPStore:
         self._server = None
         self._fd = None
         self._local: Optional[dict] = None
+        # the wire protocol is strict request/response on ONE socket —
+        # concurrent callers (elastic heartbeat + watcher threads) must
+        # serialize or responses interleave and both block
+        self._io_lock = threading.Lock()
         self.host, self.port = host, port
         if self._lib is None:
             # pure-python single-process fallback
@@ -81,7 +87,8 @@ class TCPStore:
                 self._local[key] = bytes(value)
             return
         buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value) if value else None
-        rc = self._lib.tcp_store_set(self._fd, key.encode(), buf, len(value))
+        with self._io_lock:
+            rc = self._lib.tcp_store_set(self._fd, key.encode(), buf, len(value))
         if rc != 0:
             raise RuntimeError("TCPStore.set failed")
 
@@ -97,8 +104,9 @@ class TCPStore:
                 time.sleep(0.01)
         out = ctypes.POINTER(ctypes.c_uint8)()
         olen = ctypes.c_uint32()
-        rc = self._lib.tcp_store_get(self._fd, key.encode(),
-                                     ctypes.byref(out), ctypes.byref(olen))
+        with self._io_lock:
+            rc = self._lib.tcp_store_get(self._fd, key.encode(),
+                                         ctypes.byref(out), ctypes.byref(olen))
         if rc != 0:
             raise RuntimeError("TCPStore.get failed")
         data = ctypes.string_at(out, olen.value) if olen.value else b""
@@ -114,17 +122,31 @@ class TCPStore:
                 self._local[key] = cur.to_bytes(8, "little", signed=True)
                 return cur
         result = ctypes.c_int64()
-        rc = self._lib.tcp_store_add(self._fd, key.encode(), delta,
-                                     ctypes.byref(result))
+        with self._io_lock:
+            rc = self._lib.tcp_store_add(self._fd, key.encode(), delta,
+                                         ctypes.byref(result))
         if rc != 0:
             raise RuntimeError("TCPStore.add failed")
         return int(result.value)
+
+    def delete(self, key: str):
+        """Remove a key (server op 4) — used by consumers (e.g. cross-host
+        recv) so long-running jobs don't grow the master store unboundedly."""
+        if self._local is not None:
+            with self._lock:
+                self._local.pop(key, None)
+            return
+        with self._io_lock:
+            rc = self._lib.tcp_store_delete(self._fd, key.encode())
+        if rc != 0:
+            raise RuntimeError("TCPStore.delete failed")
 
     def check(self, key: str) -> bool:
         if self._local is not None:
             with self._lock:
                 return key in self._local
-        return self._lib.tcp_store_check(self._fd, key.encode()) == 1
+        with self._io_lock:
+            return self._lib.tcp_store_check(self._fd, key.encode()) == 1
 
     def wait(self, key: str, timeout: float = 60.0) -> bytes:
         """Block until ``key`` exists (up to ``timeout`` seconds), then return
@@ -141,9 +163,10 @@ class TCPStore:
                 time.sleep(0.01)
         out = ctypes.POINTER(ctypes.c_uint8)()
         olen = ctypes.c_uint32()
-        rc = self._lib.tcp_store_wait(self._fd, key.encode(),
-                                      ctypes.c_int64(int(timeout * 1000)),
-                                      ctypes.byref(out), ctypes.byref(olen))
+        with self._io_lock:
+            rc = self._lib.tcp_store_wait(self._fd, key.encode(),
+                                          ctypes.c_int64(int(timeout * 1000)),
+                                          ctypes.byref(out), ctypes.byref(olen))
         if rc < 0:
             raise RuntimeError("TCPStore.wait failed")
         if rc == 0:

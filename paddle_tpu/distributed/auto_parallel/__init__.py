@@ -1,5 +1,10 @@
 """auto_parallel (reference: python/paddle/distributed/auto_parallel/)."""
 from .api import Partial, Replicate, Shard, dtensor_from_fn, reshard, shard_op, shard_tensor  # noqa: F401
+from .converter import (  # noqa: F401
+    Converter,
+    load_distributed_checkpoint,
+    save_distributed_checkpoint,
+)
 from .engine import Engine  # noqa: F401
 from .process_mesh import ProcessMesh  # noqa: F401
 from .strategy import Strategy  # noqa: F401

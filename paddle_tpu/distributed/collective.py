@@ -14,6 +14,7 @@ from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..tensor import Tensor
 from ..ops import dispatch
@@ -174,10 +175,15 @@ def broadcast(tensor: Tensor, src: int = 0, group=None, sync_op=True):
     ax = _axis(group)
     if not _in_mapped_context(ax):
         return tensor
-    # replicate src's shard to all members of the axis
-    out = dispatch.apply(
-        lambda x: jax.lax.all_gather(x, ax, axis=0)[src], tensor, op_name="broadcast"
-    )
+
+    # replicate src's shard via masked psum — O(1) memory per member,
+    # unlike all_gather+index which materializes all n shards.  jnp.where
+    # (not multiply) so nan/inf in NON-src shards cannot poison the sum
+    def raw(x):
+        sel = jnp.where(jax.lax.axis_index(ax) == src, x, jnp.zeros_like(x))
+        return jax.lax.psum(sel, ax)
+
+    out = dispatch.apply(raw, tensor, op_name="broadcast")
     tensor._set_value(out._value)
     tensor._grad_node = out._grad_node
     return tensor
@@ -215,12 +221,46 @@ def irecv(tensor, src=None, group=None):
     return recv(tensor, src, group)
 
 
+def _cross_host():
+    """True when this job spans multiple controller processes."""
+    from .env import get_store, get_world_size as _ws
+
+    return _ws() > 1 and get_store() is not None
+
+
+def _p2p_pack(value) -> bytes:
+    import io
+
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(value))
+    return buf.getvalue()
+
+
+def _p2p_unpack(blob: bytes):
+    import io
+
+    return np.load(io.BytesIO(blob))
+
+
+_P2P_SEQ: dict = {}
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    """Point-to-point on a mesh axis = collective_permute. In SPMD we express
-    send/recv together via ppermute in the pipeline engine; the standalone
-    send stages the value for the matching recv (same-program pairing)."""
+    """Point-to-point. Single process: stages the value for the matching
+    recv (same-program pairing).  Multi-host: ships the tensor through the
+    job's TCPStore — the control-plane path the reference uses for small
+    p2p (gen_comm_id_helper.cc socket exchange); bulk PP activations go
+    through p2p_push (collective_permute over ICI) instead."""
     ax = _axis(group)
     if not _in_mapped_context(ax):
+        if _cross_host():
+            from .env import get_rank, get_store
+
+            seq = _P2P_SEQ.setdefault(("s", get_rank(), dst), [0])
+            get_store().set(f"p2p/{get_rank()}->{dst}/{seq[0]}",
+                            _p2p_pack(tensor._value))
+            seq[0] += 1
+            return None
         _P2P_STAGE.append(tensor)
         return None
     raise RuntimeError(
@@ -232,6 +272,25 @@ def send(tensor, dst=0, group=None, sync_op=True):
 def recv(tensor, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if not _in_mapped_context(ax):
+        if _cross_host():
+            from .env import get_rank, get_store
+
+            if src is None:
+                raise ValueError("multi-host recv requires an explicit src")
+            seq = _P2P_SEQ.setdefault(("r", src, get_rank()), [0])
+            key = f"p2p/{src}->{get_rank()}/{seq[0]}"
+            # the matching send may be far behind (XLA compiles routinely
+            # exceed a minute) — block like the reference's recv does
+            import os as _os
+
+            timeout = float(_os.environ.get("PADDLE_P2P_TIMEOUT", "3600"))
+            blob = get_store().wait(key, timeout=timeout)
+            get_store().delete(key)  # bound the master store's memory
+            seq[0] += 1
+            import jax.numpy as _jnp
+
+            tensor._set_value(_jnp.asarray(_p2p_unpack(blob)))
+            return None
         if _P2P_STAGE:
             tensor._set_value(_P2P_STAGE.pop(0)._value)
         return None
@@ -252,9 +311,18 @@ def p2p_push(tensor: Tensor, perm, group=None):
     )
 
 
+_BARRIER_SEQ = [0]
+
+
 def barrier(group=None):
     ax = _axis(group)
     if not _in_mapped_context(ax):
+        if _cross_host():
+            from .env import get_store, get_world_size as _ws
+
+            _BARRIER_SEQ[0] += 1
+            get_store().barrier(f"coll_barrier/{_BARRIER_SEQ[0]}", _ws())
+            return
         jax.block_until_ready(jnp.zeros(()))
         return
     jax.lax.psum(jnp.ones(()), ax)

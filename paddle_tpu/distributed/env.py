@@ -47,6 +47,7 @@ class ParallelEnv:
 
 _parallel_env: Optional[ParallelEnv] = None
 _initialized = False
+_store = None  # rank-0-hosted native TCPStore (kept for p2p/barriers)
 
 
 def _env() -> ParallelEnv:
@@ -72,19 +73,28 @@ def is_initialized() -> bool:
     return _initialized
 
 
+def get_store():
+    """The job's rendezvous TCPStore (reference: tcp_store.h:120, created
+    by init_parallel_env).  None on single-process jobs."""
+    return _store
+
+
 def init_parallel_env():
     """Bring up the multi-host runtime (reference parallel.py:913). On a
-    single host this is a no-op beyond recording the env; on pods it calls
+    single host this is a no-op beyond recording the env; on pods it
+    rendezvouses through the native TCPStore and calls
     jax.distributed.initialize using the launcher-provided coordinator."""
-    global _initialized
+    global _initialized, _store
     env = _env()
     if _initialized:
         return env
     coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ENDPOINT")
-    if env.world_size > 1 and coord and not os.environ.get("PADDLE_TPU_NO_JAX_DIST"):
+    if env.world_size > 1 and coord:
         # rendezvous barrier through the native TCPStore (reference
         # tcp_store.h:120): rank 0 hosts; all ranks sync before the XLA
-        # coordinator handshake so slow-starting ranks don't time out
+        # coordinator handshake so slow-starting ranks don't time out.
+        # The store is KEPT (get_store) — cross-host send/recv and
+        # barriers ride it after bring-up.
         try:
             from ..core.native.tcp_store import TCPStore
 
@@ -94,18 +104,20 @@ def init_parallel_env():
             if store._local is None:  # real socket store only — the
                 # in-process fallback cannot synchronize separate ranks
                 store.barrier("init_parallel_env", env.world_size)
+                _store = store
         except Exception:
             pass  # rendezvous is best-effort; jax.distributed retries anyway
-        try:
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=env.world_size,
-                process_id=env.rank,
-            )
-        except Exception as e:  # already initialized or local testing
-            if "already" not in str(e).lower():
-                import warnings
+        if not os.environ.get("PADDLE_TPU_NO_JAX_DIST"):
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=env.world_size,
+                    process_id=env.rank,
+                )
+            except Exception as e:  # already initialized or local testing
+                if "already" not in str(e).lower():
+                    import warnings
 
-                warnings.warn(f"jax.distributed.initialize failed: {e}")
+                    warnings.warn(f"jax.distributed.initialize failed: {e}")
     _initialized = True
     return env

@@ -1,0 +1,132 @@
+"""Elastic training: membership, failure detection, restart.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:124
+(ElasticManager: ranks register under an etcd prefix with TTL leases,
+heartbeat thread :254-268, watch membership host_call_back:240; the
+launcher relaunches workers with a rescaled spec on change, bounded by
+--max_restart).
+
+TPU-native redesign: the KV substrate is the job's native TCPStore (no
+etcd in the image).  Each node heartbeats ``elastic/beat/<rank>`` with a
+monotonic timestamp; the watcher thread scans peers every interval and
+classifies them dead when their beat is older than the TTL.  On
+membership change the manager invokes the restart callback (the
+launcher's relaunch path) — the same contract the reference's
+ElasticManager has with launch/controllers/master.py.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, store, rank: int, nnodes: int,
+                 min_nodes: Optional[int] = None,
+                 max_nodes: Optional[int] = None,
+                 ttl: float = 10.0, interval: float = 2.0,
+                 on_change: Optional[Callable[[List[int]], None]] = None):
+        self._store = store
+        self._rank = rank
+        self._nnodes = nnodes
+        self._min = min_nodes or nnodes
+        self._max = max_nodes or nnodes
+        self._ttl = ttl
+        self._interval = interval
+        self._on_change = on_change
+        self._stop = threading.Event()
+        self._alive: Dict[int, float] = {}
+        self._threads: List[threading.Thread] = []
+        self.enabled = self._min != self._max or True
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        """Register + start the heartbeat and watch threads (reference
+        manager.py heartbeat thread :254)."""
+        self._beat()
+        t1 = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        t2 = threading.Thread(target=self._watch_loop, daemon=True)
+        t1.start()
+        t2.start()
+        self._threads = [t1, t2]
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=self._interval * 2)
+
+    exit = stop
+
+    # -- heartbeat -------------------------------------------------------
+    def _beat(self):
+        self._store.set(f"elastic/beat/{self._rank}",
+                        repr(time.time()).encode())
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self._interval):
+            try:
+                self._beat()
+            except Exception:
+                pass  # transient store outage: next beat retries
+
+    # -- watch -----------------------------------------------------------
+    def alive_nodes(self) -> List[int]:
+        now = time.time()
+        alive = []
+        for r in range(self._max):
+            try:
+                key = f"elastic/beat/{r}"
+                # GET blocks until the key exists (store op 1); probe with
+                # CHECK first so unregistered ranks don't wedge the watcher
+                blob = self._store.get(key) if self._store.check(key) else None
+            except Exception:
+                blob = None
+            if not blob:
+                continue
+            try:
+                ts = float(blob.decode())
+            except ValueError:
+                continue
+            if now - ts <= self._ttl:
+                alive.append(r)
+        return alive
+
+    def _watch_loop(self):
+        prev = set()
+        while not self._stop.wait(self._interval):
+            try:
+                cur = set(self.alive_nodes())
+            except Exception:
+                continue
+            if prev and cur != prev and self._on_change is not None:
+                self._on_change(sorted(cur))
+            prev = cur
+
+    # -- reference-API surface ------------------------------------------
+    def health(self) -> str:
+        n = len(self.alive_nodes())
+        if n >= self._nnodes:
+            return ElasticStatus.COMPLETED
+        if n >= self._min:
+            return ElasticStatus.RESTART  # shrink within [min, max]
+        return ElasticStatus.HOLD  # wait for nodes to come back
+
+    def wait(self, timeout: float = 300.0) -> bool:
+        """Block until at least min nodes are alive (rescaled bring-up)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(self.alive_nodes()) >= self._min:
+                return True
+            time.sleep(self._interval)
+        return False

@@ -1,11 +1,15 @@
 """HybridParallelOptimizer (reference:
 fleet/meta_parallel/dygraph_optimizer/hybrid_parallel_optimizer.py:238 —
-wraps the inner optimizer, fusing grad clip across mp/pp groups).
+wraps the inner optimizer; the reference re-implements global-norm grad
+clipping with hand-fused allreduces across the mp/pp groups because each
+rank only holds parameter SHARDS).
 
-TPU-native: gradients are already globally correct under SPMD (XLA reduces
-over sharded axes), so the wrapper's job reduces to (a) a global-norm clip
-computed over the full parameter set — correct because the controller sees
-global tensors — and (b) API parity (step/clear_grad/minimize)."""
+TPU-native: this wrapper is a pure delegator, and that is sufficient —
+under single-controller SPMD the inner optimizer's ``ClipGradByGlobalNorm``
+already sees GLOBAL tensors (sharded jax.Arrays are logically whole), so
+its norm IS the cross-group global norm; XLA inserts the collectives the
+reference hand-codes.  Verified by
+tests/test_pipeline.py::test_hybrid_optimizer_global_clip."""
 from __future__ import annotations
 
 from ....optimizer.lr import LRScheduler

@@ -217,7 +217,11 @@ class PipelineParallel(Layer):
         for j in range(M):
             x, y = micro[j]
             recs = self._forward_micro(x, y, inv, scaler)
-            total = recs[-1][2] if total is None else total + recs[-1][2]
+            # accumulate the DETACHED loss: chaining live losses would keep
+            # every micro-batch's last-stage graph alive for the whole
+            # batch, defeating the 1F1B residency bound
+            lt = recs[-1][2].detach()
+            total = lt if total is None else total + lt
             inflight[j] = recs
             self.last_peak_inflight = max(self.last_peak_inflight, len(inflight))
             if j >= S - 1:

@@ -21,8 +21,17 @@ def _parse():
     p.add_argument("--nproc_per_node", "--nprocs", type=int, default=1)
     p.add_argument("--master", default="127.0.0.1:23571",
                    help="coordinator host:port (rank0)")
-    p.add_argument("--rank", type=int, default=0, help="this host's index")
+    p.add_argument("--rank", default="0",
+                   help="this host's index, or 'auto' to rendezvous "
+                        "through the master TCPStore (reference "
+                        "launch/controllers/master.py HTTP/etcd rendezvous)")
     p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--max_restart", type=int, default=0,
+                   help="elastic: relaunch a failed local worker up to N "
+                        "times before declaring the pod dead (reference "
+                        "fleet/elastic/manager.py max_restart)")
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help="0=off (fail fast), 1=restart failed workers")
     p.add_argument("--log_dir", default=None)
     p.add_argument("--devices", default=None,
                    help="accepted for reference-API parity (TPU chips are "
@@ -32,17 +41,48 @@ def _parse():
     return p.parse_args()
 
 
+def _rendezvous_node_rank(master: str, nnodes: int) -> int:
+    """Join the job through the master's TCPStore and claim a node index
+    (reference: launch/controllers/master.py — nodes register with the
+    HTTP/etcd master and are assigned ranks; here the KV master is the
+    native TCPStore, hosted by whichever node binds the port first)."""
+    from paddle_tpu.core.native.tcp_store import TCPStore
+
+    host, port = master.split(":")[0], int(master.split(":")[1])
+    store = None
+    try:  # try to host (first node on the master machine wins the bind)
+        store = TCPStore(host=host, port=port + 2, is_master=True,
+                         world_size=nnodes)
+        if store._local is not None:
+            raise RuntimeError("no native store")
+    except Exception:
+        store = TCPStore(host=host, port=port + 2, is_master=False,
+                         world_size=nnodes)
+    rank = store.add("launch/node_join", 1) - 1
+    store.barrier("launch/all_nodes", nnodes, timeout=300.0)
+    # keep the hosting store alive for the job's lifetime
+    global _RDZV_STORE
+    _RDZV_STORE = store
+    return rank
+
+
+_RDZV_STORE = None
+
+
 def launch_main(argv=None):
     args = _parse()
     nproc = args.nproc_per_node
     world = args.nnodes * nproc
-    procs = []
+    if str(args.rank) == "auto":
+        args.rank = _rendezvous_node_rank(args.master, args.nnodes)
+    else:
+        args.rank = int(args.rank)
     log_files = []
     log_dir = args.log_dir
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
 
-    for local_rank in range(nproc):
+    def spawn(local_rank):
         rank = args.rank * nproc + local_rank
         env = dict(os.environ)
         env.update({
@@ -55,29 +95,46 @@ def launch_main(argv=None):
         })
         cmd = [sys.executable, "-u", args.script, *args.script_args]
         if log_dir:
-            lf = open(os.path.join(log_dir, f"workerlog.{rank}"), "wb")
+            lf = open(os.path.join(log_dir, f"workerlog.{rank}"), "ab")
             log_files.append(lf)
-            procs.append(subprocess.Popen(cmd, env=env, stdout=lf, stderr=lf))
-        else:
-            procs.append(subprocess.Popen(cmd, env=env))
+            return subprocess.Popen(cmd, env=env, stdout=lf, stderr=lf)
+        return subprocess.Popen(cmd, env=env)
+
+    procs = {lr: spawn(lr) for lr in range(nproc)}
+    restarts = {lr: 0 for lr in range(nproc)}
 
     exit_code = 0
     try:
         while procs:
-            for i, pr in enumerate(list(procs)):
+            for lr, pr in list(procs.items()):
                 rc = pr.poll()
                 if rc is None:
                     continue
-                procs.remove(pr)
-                if rc != 0:
-                    exit_code = rc
-                    # a failed rank kills the pod (reference container watch)
-                    for other in procs:
+                if rc == 0:
+                    procs.pop(lr)
+                    continue
+                # worker failed: elastic level 1 relaunches it in place
+                # (reference elastic manager restart path) up to
+                # --max_restart times; otherwise fail the pod fast
+                if args.elastic_level >= 1 and restarts[lr] < args.max_restart:
+                    restarts[lr] += 1
+                    sys.stderr.write(
+                        f"launch: worker {lr} rc={rc}; elastic restart "
+                        f"{restarts[lr]}/{args.max_restart}\n")
+                    procs[lr] = spawn(lr)
+                    continue
+                exit_code = rc
+                # a failed rank kills the pod (reference container watch)
+                for other in procs.values():
+                    if other.poll() is None:
                         other.send_signal(signal.SIGTERM)
-                    for other in procs:
+                for other in procs.values():
+                    try:
                         other.wait(timeout=30)
-                    procs = []
-                    break
+                    except Exception:
+                        pass
+                procs = {}
+                break
             time.sleep(0.2)
     finally:
         for lf in log_files:

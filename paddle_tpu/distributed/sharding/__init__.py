@@ -1,12 +1,26 @@
 """group_sharded (ZeRO) API (reference: python/paddle/distributed/sharding/
-group_sharded.py group_sharded_parallel; stages in
-fleet/meta_parallel/sharding/).
+group_sharded.py group_sharded_parallel; stage runtimes in
+fleet/meta_parallel/sharding/group_sharded_stage2.py / _stage3.py).
 
-TPU-native: ZeRO stages are layout choices, not new runtimes —
-  stage 1: optimizer moments sharded over the 'sharding' axis
-  stage 2: + gradients reduce-scattered into the sharded layout
-  stage 3: + parameters stored sharded, all-gathered around use
-XLA inserts the gather/scatter collectives from the NamedShardings.
+TPU-native: ZeRO stages are LAYOUT choices, not new runtimes —
+  stage 1 ('os'):      optimizer moments/master weights sharded over the
+                       sharding axis (lazily too — accumulators created on
+                       the first step inherit the layout via the
+                       optimizer's accumulator hook)
+  stage 2 ('os_g'):    + gradients land reduce-scattered into the sharded
+                       layout: a grad hook constrains every param grad's
+                       sharding, so XLA emits reduce-scatter instead of
+                       all-reduce for the dp/sharding reduction (the exact
+                       collective swap GroupShardedStage2 hand-codes)
+  stage 3 ('p_g_os'):  + parameters stored sharded; XLA all-gathers them
+                       around use and frees the gathered copy after
+                       (GroupShardedStage3's fwd allgather + release)
+XLA's SPMD partitioner inserts the gather/scatter collectives from the
+NamedShardings; under jit.to_static the whole stage-3 gather/compute/
+scatter chain fuses into the train step.
+
+The sharding axis defaults to the mesh's 'sharding' axis and falls back
+to 'dp' (the reference defaults its group to the DP group).
 """
 from __future__ import annotations
 
@@ -21,7 +35,17 @@ from .. import mesh as _mesh
 __all__ = ["group_sharded_parallel", "save_group_sharded_model"]
 
 
-def _shard_spec_for(value, axis="sharding"):
+def _pick_axis():
+    if not _mesh.has_mesh():
+        return None
+    names = _mesh.get_mesh().axis_names
+    for ax in ("sharding", "dp"):
+        if ax in names and _mesh.get_mesh().shape[ax] > 1:
+            return ax
+    return None
+
+
+def _shard_spec_for(value, axis):
     """Shard along the first dim divisible by the axis size; else replicate."""
     n = _mesh.axis_size(axis)
     if n <= 1:
@@ -32,11 +56,26 @@ def _shard_spec_for(value, axis="sharding"):
     return PartitionSpec()
 
 
-def _apply_sharding(t, axis="sharding"):
+def _apply_sharding(t, axis):
     spec = _shard_spec_for(t._value, axis)
     sh = NamedSharding(_mesh.get_mesh(), spec)
     t._set_value(jax.device_put(t._value, sh))
     return t
+
+
+def _grad_reshard_hook(axis):
+    """Tensor grad hook: constrain the incoming grad to the sharded layout
+    (stage 2's reduce-scatter; runs inside the traced backward too)."""
+    from ...ops.sharding_ops import shard_constraint
+    from ...tensor import Tensor
+
+    def hook(g: "Tensor"):
+        spec = _shard_spec_for(g._value, axis)
+        if not len(spec):
+            return g
+        return shard_constraint(g, *spec)
+
+    return hook
 
 
 def group_sharded_parallel(model: Layer, optimizer: Optimizer, level: str,
@@ -47,19 +86,34 @@ def group_sharded_parallel(model: Layer, optimizer: Optimizer, level: str,
     """Reference group_sharded.py group_sharded_parallel(level='os'|'os_g'|'p_g_os')."""
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError(f"level must be os | os_g | p_g_os, got {level}")
-    if not _mesh.has_mesh() or "sharding" not in _mesh.get_mesh().axis_names:
+    axis = _pick_axis()
+    if axis is None:
         return model, optimizer, scaler  # degenerate: no sharding axis
 
-    # stage 1: shard optimizer state
+    # stage 1: shard existing optimizer state AND state created later
+    # (accumulators are lazy — created on the first step)
     for store in optimizer._accumulators.values():
         for t in store.values():
-            _apply_sharding(t)
+            _apply_sharding(t, axis)
     for t in getattr(optimizer, "_master", {}).values():
-        _apply_sharding(t)
+        _apply_sharding(t, axis)
+
+    def _layout_new_accumulator(acc, param):
+        _apply_sharding(acc, axis)
+
+    optimizer._accumulator_layout_hook = _layout_new_accumulator
+
+    if level in ("os_g", "p_g_os"):
+        # stage 2: gradients reduce-scattered into the sharded layout
+        hook = _grad_reshard_hook(axis)
+        for p in model.parameters():
+            if not p.stop_gradient:
+                p.register_hook(hook)
+
     if level == "p_g_os":
         # stage 3: shard parameters too; XLA all-gathers around use
         for p in model.parameters():
-            _apply_sharding(p)
+            _apply_sharding(p, axis)
     return model, optimizer, scaler
 
 

@@ -1,0 +1,211 @@
+"""Inference serving surface.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.h:94
+(AnalysisPredictor: load program -> IR pass pipeline -> NaiveExecutor,
+zero-copy input/output tensors) and python/paddle/inference/wrapper.py
+(Config / Predictor / create_predictor).
+
+TPU-native redesign: the "inference program" is a serialized StableHLO
+executable (jit.save / jax.export).  The Predictor loads it, binds named
+input handles, and runs the compiled program — XLA took the place of the
+Analyzer's 200+ IR passes, and "zero copy" is the natural mode (device
+arrays are handed to the executable without staging).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..tensor import Tensor as _FrameworkTensor
+
+__all__ = [
+    "Config", "Predictor", "Tensor", "create_predictor",
+    "DataType", "PlaceType", "PrecisionType", "get_version",
+    "get_num_bytes_of_data_type", "PredictorPool",
+]
+
+
+class DataType:
+    FLOAT32 = "float32"
+    FLOAT16 = "float16"
+    BFLOAT16 = "bfloat16"
+    INT32 = "int32"
+    INT64 = "int64"
+    INT8 = "int8"
+    UINT8 = "uint8"
+    BOOL = "bool"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "tpu"  # the accelerator in this build is the TPU
+    TPU = "tpu"
+
+
+class PrecisionType:
+    Float32 = "fp32"
+    Bfloat16 = "bf16"
+    Half = "fp16"
+    Int8 = "int8"
+
+
+class Config:
+    """reference wrapper.py Config / analysis_config.h: model path +
+    runtime knobs.  XLA owns the optimization pipeline, so pass toggles
+    are accepted for API parity and recorded into ``summary()``."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # jit.save writes a single <path> prefix; accept either the prefix
+        # or the reference's (prog, params) pair pointing at it
+        self._model_prefix = prog_file
+        self._use_tpu = True
+        self._device_id = 0
+        self._enable_memory_optim = True
+        self._switches: Dict[str, object] = {}
+
+    def set_model(self, prog_file, params_file=None):
+        self._model_prefix = prog_file
+
+    def model_dir(self):
+        return self._model_prefix
+
+    def prog_file(self):
+        return self._model_prefix
+
+    # device selection (reference enable_use_gpu / disable_gpu)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_tpu = True
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def use_gpu(self):
+        return self._use_tpu
+
+    def enable_memory_optim(self, flag=True):
+        self._enable_memory_optim = flag
+
+    def switch_ir_optim(self, flag=True):
+        self._switches["ir_optim"] = flag  # XLA always optimizes
+
+    def switch_use_feed_fetch_ops(self, flag=False):
+        self._switches["feed_fetch"] = flag
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._switches["cpu_threads"] = n
+
+    def summary(self):
+        lines = [f"model: {self._model_prefix}",
+                 f"device: {'tpu' if self._use_tpu else 'cpu'}:{self._device_id}",
+                 "compiler: XLA (StableHLO program from jit.save)"]
+        lines += [f"{k}: {v}" for k, v in self._switches.items()]
+        return "\n".join(lines)
+
+
+class Tensor:
+    """Named IO handle (reference wrapper.py Tensor / zero-copy tensor):
+    copy_from_cpu binds, copy_to_cpu fetches."""
+
+    def __init__(self, name: str, owner: "Predictor"):
+        self._name = name
+        self._owner = owner
+
+    def name(self):
+        return self._name
+
+    def copy_from_cpu(self, data):
+        self._owner._inputs[self._name] = np.asarray(data)
+
+    def share_external_data(self, tensor):
+        v = tensor._value if isinstance(tensor, _FrameworkTensor) else tensor
+        self._owner._inputs[self._name] = v  # zero-copy: device array as-is
+
+    def copy_to_cpu(self):
+        return np.asarray(self._owner._outputs[self._name])
+
+    def shape(self):
+        v = (self._owner._outputs.get(self._name)
+             if self._name in self._owner._outputs
+             else self._owner._inputs.get(self._name))
+        return list(np.asarray(v).shape) if v is not None else None
+
+
+class Predictor:
+    """reference analysis_predictor.h:94 — but execution is one compiled
+    XLA call (ZeroCopyRun -> jitted program)."""
+
+    def __init__(self, config: Config):
+        from ..jit.save_load import load as _load
+
+        self._config = config
+        self._layer = _load(config.prog_file())
+        self._n_inputs = self._layer.n_inputs if hasattr(self._layer, "n_inputs") else None
+        self._input_names = [f"x{i}" for i in range(self._n_inputs or 8)]
+        self._inputs: Dict[str, object] = {}
+        self._outputs: Dict[str, object] = {}
+        self._output_names: List[str] = []
+
+    def get_input_names(self):
+        n = self._n_inputs
+        return self._input_names[:n] if n else list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return Tensor(name, self)
+
+    def get_output_names(self):
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return Tensor(name, self)
+
+    def run(self, inputs: Optional[list] = None):
+        from ..tensor import to_tensor
+
+        if inputs is not None:
+            for i, a in enumerate(inputs):
+                self._inputs[f"x{i}"] = np.asarray(
+                    a._value if isinstance(a, _FrameworkTensor) else a)
+        args = [to_tensor(self._inputs[k])
+                for k in sorted(self._inputs, key=lambda s: int(s[1:]))]
+        out = self._layer(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._output_names = [f"out{i}" for i in range(len(outs))]
+        self._outputs = {n: o._value for n, o in zip(self._output_names, outs)}
+        if inputs is not None:
+            return [_FrameworkTensor(v) for v in self._outputs.values()]
+        return True
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def get_version():
+    from ..version import __version__
+
+    return __version__
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    return int(np.dtype(str(dtype)).itemsize)
+
+
+class PredictorPool:
+    """reference api PredictorPool: N predictors sharing one program."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._predictors = [Predictor(config) for _ in range(size)]
+
+    def retrive(self, idx: int) -> Predictor:  # (sic) reference spelling
+        return self._predictors[idx]
+
+    retrieve = retrive

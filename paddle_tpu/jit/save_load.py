@@ -78,7 +78,9 @@ def save(layer, path, input_spec=None, **configs):
     state = {k: np.asarray(v._value) for k, v in layer.state_dict().items()}
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(
-            {"state": state, "captured": [np.asarray(t._value) for t in captured]},
+            {"state": state,
+             "captured": [np.asarray(t._value) for t in captured],
+             "n_inputs": len(in_avals)},
             f,
             protocol=4,
         )
@@ -92,6 +94,7 @@ class TranslatedLayer:
             blob = pickle.load(f)
         self._captured = tuple(jnp.asarray(a) for a in blob["captured"])
         self._state = blob["state"]
+        self.n_inputs = blob.get("n_inputs")
         with open(path + ".pdmodel", "rb") as f:
             self._exported = jax_export.deserialize(bytearray(f.read()))
 

@@ -405,10 +405,12 @@ class GPTStackedDecoder(Layer):
             # Pallas flash kernel when shape-eligible (no attention dropout
             # path inside the kernel); else the XLA expression with fp32
             # softmax.  Both see amp-dtype q/k/v.
-            if (use_flash and _on_tpu() and not (with_dropout and attn_p > 0.0)
-                    and s % 128 == 0 and s >= 128 and hd % 64 == 0):
-                from ..ops.pallas_kernels.flash_attention import flash_attention_bnsd
+            from ..ops.pallas_kernels.flash_attention import (
+                flash_attention_bnsd, shape_supported,
+            )
 
+            if (use_flash and _on_tpu() and not (with_dropout and attn_p > 0.0)
+                    and shape_supported(s, hd)):
                 return flash_attention_bnsd(q, k, v, causal=True,
                                             sm_scale=float(1.0 / np.sqrt(hd)))
             scores = jnp.einsum("bnqd,bnkd->bnqk", q, k,

@@ -53,11 +53,9 @@ def _flash_eligible(q_shape, dropout_p, mask):
     if mask is not None or dropout_p > 0.0:
         return False
     b, s, h, d = q_shape
-    # The owned Pallas kernel (ops/pallas_kernels/flash_attention.py) needs
-    # seqlen divisible by its 128-multiple blocks and a 64-multiple head
-    # dim (validated on TPU at d=64 and d=128) — same gate as the stacked
-    # GPT block's sdpa routing in models/gpt.py
-    return s >= 128 and s % 128 == 0 and d % 64 == 0
+    from ...ops.pallas_kernels.flash_attention import shape_supported
+
+    return shape_supported(s, d)
 
 
 def scaled_dot_product_attention(

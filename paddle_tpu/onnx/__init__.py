@@ -1,0 +1,30 @@
+"""ONNX export surface (reference: python/paddle/onnx/export.py, which
+delegates to the external paddle2onnx package).
+
+This build has no onnx/paddle2onnx (zero-egress image); the portable
+serialized form of a compiled model is the StableHLO program written by
+``paddle_tpu.jit.save`` (load it anywhere with jax.export, including
+non-TPU backends).  ``export`` therefore writes that artifact and raises
+a clear error only if asked for a literal .onnx protobuf.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """reference onnx/export.py export(layer, path, input_spec).
+
+    Writes the StableHLO inference artifact at ``path`` (pdmodel/pdiparams
+    pair).  A true ONNX protobuf requires the external paddle2onnx/onnx
+    packages, which are not in this image.
+    """
+    if str(path).endswith(".onnx"):
+        raise NotImplementedError(
+            "literal .onnx protobuf export needs the external onnx package "
+            "(not in this zero-egress image); jit.save's StableHLO artifact "
+            "is the portable compiled-model format here")
+    from ..jit.save_load import save as _save
+
+    _save(layer, path, input_spec=input_spec)
+    return path

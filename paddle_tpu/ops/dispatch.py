@@ -50,6 +50,10 @@ class _TraceState(threading.local):
         self.mutation_log = None  # Optional[dict id(Tensor) -> Tensor]
         self.read_log = None  # Optional[dict id(Tensor) -> Tensor] (scout pass)
         self.read_epoch = 0  # only tensors with _gen < read_epoch are "state"
+        # branch functionalization (static.nn.cond/while_loop): logs EVERY
+        # Tensor input an op reads — leaves AND intermediates — so a branch
+        # closure can be rewritten as a pure function of its captures
+        self.branch_log = None  # Optional[dict id(Tensor) -> Tensor]
 
 
 _trace_state = _TraceState()
@@ -64,6 +68,10 @@ def note_read(t):
 
 
 def _log_reads(inputs):
+    blog = _trace_state.branch_log
+    if blog is not None:
+        for t in inputs:
+            blog[id(t)] = t
     log = _trace_state.read_log
     if log is None:
         return
@@ -114,16 +122,58 @@ class enable_grad:
         return False
 
 
+# Deferred nan/inf detection (reference eager/nan_inf_utils.cc checks at
+# kernel granularity WITHOUT a per-op host sync): each checked op ORs an
+# "any non-finite" flag into a device-side accumulator + remembers the
+# first few op names; the host syncs only at `finite_check_report()` (or
+# per-op in strict mode, FLAGS_check_nan_inf_level == 0).
+_finite_state = {"flag": None, "ops": [], "max_ops": 16}
+
+
 def _check_finite(name, raws):
     level = _flags.flag("FLAGS_check_nan_inf_level")
+    bad = None
     for r in raws:
+        if isinstance(r, jax.core.Tracer):
+            # inside a jit.to_static trace: a flag accumulated here would
+            # leak the tracer into module state (UnexpectedTracerError on
+            # the next eager op).  Compiled programs opt into checking
+            # explicitly via amp.debugging.check_numerics on outputs.
+            return
         if hasattr(r, "dtype") and _dtype_mod.is_float_raw(r.dtype):
-            finite = bool(jax.numpy.isfinite(r).all())
-            if not finite:
-                msg = f"nan/inf detected in output of op '{name}'"
-                if level == 0:
-                    raise FloatingPointError(msg)
-                print(f"[paddle_tpu] WARNING: {msg}")
+            b = ~jax.numpy.isfinite(r).all()
+            bad = b if bad is None else (bad | b)
+    if bad is None:
+        return
+    if level == 0:
+        # strict mode: immediate host sync per op (debug cost accepted —
+        # the reference's abort-on-first-nan mode)
+        if bool(bad):
+            raise FloatingPointError(
+                f"nan/inf detected in output of op '{name}'")
+        return
+    # deferred mode: device-side OR, no host sync in the hot loop
+    st = _finite_state
+    st["flag"] = bad if st["flag"] is None else (st["flag"] | bad)
+    if len(st["ops"]) < st["max_ops"]:
+        st["ops"].append(name)
+
+
+def finite_check_report(reset: bool = True):
+    """Sync the deferred nan/inf flag ONCE (reference analog: the
+    check_numerics kernel's accumulated status read).  Returns True when
+    everything seen so far was finite."""
+    st = _finite_state
+    if st["flag"] is None:
+        return True
+    ok = not bool(st["flag"])
+    if not ok:
+        print("[paddle_tpu] WARNING: nan/inf detected; recent checked ops: "
+              + ", ".join(st["ops"]))
+    if reset:
+        st["flag"] = None
+        st["ops"] = []
+    return ok
 
 
 def apply(raw_fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
@@ -152,6 +202,9 @@ def apply(raw_fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
 
     if not needs_grad:
         out = fwd(*raws)
+        if _flags.flag("FLAGS_check_nan_inf"):
+            _check_finite(op_name or getattr(raw_fn, "__name__", "op"),
+                          out if isinstance(out, tuple) else (out,))
         return _wrap_outputs(out, stop_gradient=True)
 
     multi = [None]

@@ -40,6 +40,14 @@ def _on_tpu() -> bool:
         return False
 
 
+def shape_supported(seq_len: int, head_dim: int) -> bool:
+    """The ONE eligibility gate for this kernel (kept here so callers —
+    nn/functional/attention.py and the stacked GPT block — can't drift):
+    seqlen divisible by the 128-multiple blocks, head dim a 64 multiple
+    (validated on TPU at d=64 and d=128)."""
+    return seq_len >= 128 and seq_len % 128 == 0 and head_dim % 64 == 0
+
+
 NEG_INF = np.float32(-1e30)
 
 

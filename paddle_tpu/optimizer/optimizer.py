@@ -52,13 +52,70 @@ class Optimizer:
     def _add_accumulator(self, name, param, fill_value=0.0, dtype=None):
         store = self._accumulators.setdefault(name, {})
         if id(param) not in store:
-            store[id(param)] = Tensor(
-                jnp.full(param._value.shape, fill_value, dtype or jnp.float32)
-            )
+            import jax
+
+            acc_raw = jnp.full(param._value.shape, fill_value, dtype or jnp.float32)
+            # moments inherit the PARAM's MESH layout by default (a
+            # TP-sharded weight gets TP-sharded moments — the memory layout
+            # the reference's distributed optimizers maintain by
+            # construction).  Single-device placements are NOT inherited:
+            # committing moments to one device would poison later mixing
+            # with mesh-wide values.
+            psh = getattr(param._value, "sharding", None)
+            if (psh is not None and isinstance(param._value, jax.Array)
+                    and isinstance(psh, jax.sharding.NamedSharding)):
+                acc_raw = jax.device_put(acc_raw, psh)
+            acc = Tensor(acc_raw)
+            # group_sharded (ZeRO) installs this to lay new optimizer
+            # state out sharded at creation time (accumulators are lazy,
+            # so sharding must hook creation, not just existing state)
+            hook = getattr(self, "_accumulator_layout_hook", None)
+            if hook is not None:
+                hook(acc, param)
+            store[id(param)] = acc
         return store[id(param)]
 
     def _get_accumulator(self, name, param):
         return self._accumulators[name][id(param)]
+
+    # -- layout-preserving param writes -------------------------------------
+    def _record_param_layouts(self):
+        """Remember each param's concrete sharding so updates can't silently
+        change its layout (e.g. ZeRO stage-1 sharded moments would otherwise
+        leak their layout into the param through the update expression)."""
+        import jax
+
+        if getattr(self, "_param_layouts", None) is None:
+            self._param_layouts = {}
+        from ..distributed import mesh as _mesh
+
+        for p in self._parameter_list:
+            v = p._value
+            if id(p) not in self._param_layouts and isinstance(v, jax.Array):
+                sh = v.sharding
+                # a param still on its creation device counts as REPLICATED
+                # once a mesh is active — committing it single-device would
+                # make later mixing with mesh-sharded state illegal
+                if (_mesh.has_mesh()
+                        and isinstance(sh, jax.sharding.SingleDeviceSharding)
+                        and len(_mesh.get_mesh().devices.flat) > 1):
+                    sh = jax.sharding.NamedSharding(
+                        _mesh.get_mesh(), jax.sharding.PartitionSpec())
+                self._param_layouts[id(p)] = sh
+
+    def _write_param(self, p, val):
+        """Rebind a param value, re-constraining to its recorded layout."""
+        import jax
+
+        sh = getattr(self, "_param_layouts", {}).get(id(p))
+        if sh is not None:
+            from ..jit.api import in_tracing
+
+            if in_tracing():
+                val = jax.lax.with_sharding_constraint(val, sh)
+            elif getattr(val, "sharding", None) != sh:
+                val = jax.device_put(val, sh)
+        p._set_value(val)
 
     # -- lr ----------------------------------------------------------------
     def get_lr(self) -> float:
@@ -82,6 +139,7 @@ class Optimizer:
 
     # -- step --------------------------------------------------------------
     def _collect_params_grads(self):
+        self._record_param_layouts()
         pg = []
         for p in self._parameter_list:
             if isinstance(p, Parameter) and not p.trainable:

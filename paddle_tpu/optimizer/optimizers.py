@@ -22,7 +22,7 @@ class SGD(Optimizer):
     def _apply_one(self, p, g):
         lr = self._lr_value()
         g_raw = self._decayed_grad(p, g._value.astype(jnp.float32))
-        p._set_value((p._value.astype(jnp.float32) - lr * g_raw).astype(p._value.dtype))
+        self._write_param(p, (p._value.astype(jnp.float32) - lr * g_raw).astype(p._value.dtype))
 
 
 class Momentum(Optimizer):
@@ -47,7 +47,7 @@ class Momentum(Optimizer):
         else:
             update = new_v
         v._set_value(new_v)
-        p._set_value((p._value.astype(jnp.float32) - lr * update).astype(p._value.dtype))
+        self._write_param(p, (p._value.astype(jnp.float32) - lr * update).astype(p._value.dtype))
 
 
 class Adagrad(Optimizer):
@@ -68,8 +68,9 @@ class Adagrad(Optimizer):
         g_raw = self._decayed_grad(p, g._value.astype(jnp.float32))
         new_m = m._value + g_raw * g_raw
         m._set_value(new_m)
-        p._set_value(
-            (p._value.astype(jnp.float32) - lr * g_raw / (jnp.sqrt(new_m) + self._epsilon)).astype(p._value.dtype)
+        self._write_param(
+            p,
+            (p._value.astype(jnp.float32) - lr * g_raw / (jnp.sqrt(new_m) + self._epsilon)).astype(p._value.dtype),
         )
 
 
@@ -108,7 +109,7 @@ class RMSProp(Optimizer):
         new_mom = self._momentum * mom._value + lr * g_raw / denom
         ms._set_value(new_ms)
         mom._set_value(new_mom)
-        p._set_value((p._value.astype(jnp.float32) - new_mom).astype(p._value.dtype))
+        self._write_param(p, (p._value.astype(jnp.float32) - new_mom).astype(p._value.dtype))
 
 
 class Adam(Optimizer):
@@ -136,9 +137,13 @@ class Adam(Optimizer):
         # multi_precision adam)
         if self._multi_precision:
             self._master: dict = {}
+            hook = getattr(self, "_accumulator_layout_hook", None)
             for p in params:
                 if p._value.dtype in (jnp.bfloat16, jnp.float16):
-                    self._master[id(p)] = Tensor(p._value.astype(jnp.float32))
+                    m = Tensor(p._value.astype(jnp.float32))
+                    if hook is not None:
+                        hook(m, p)  # ZeRO: master weights shard like moments
+                    self._master[id(p)] = m
 
     @dispatch.no_grad()
     def step(self):
@@ -186,7 +191,7 @@ class Adam(Optimizer):
         m2._set_value(new_m2.astype(m2._value.dtype))
         if master is not None:
             master._set_value(new_p)
-        p._set_value(new_p.astype(p._value.dtype))
+        self._write_param(p, new_p.astype(p._value.dtype))
 
 
 class AdamW(Adam):
@@ -234,7 +239,7 @@ class AdamW(Adam):
         m2._set_value(new_m2.astype(m2._value.dtype))
         if master is not None:
             master._set_value(new_p)
-        p._set_value(new_p.astype(p._value.dtype))
+        self._write_param(p, new_p.astype(p._value.dtype))
 
 
 class Adamax(Optimizer):
@@ -273,8 +278,9 @@ class Adamax(Optimizer):
         new_m = self._beta1 * m._value + (1 - self._beta1) * g_raw
         new_u = jnp.maximum(self._beta2 * u._value, jnp.abs(g_raw))
         b1p = self._aux_state[0]._value
-        p._set_value(
-            (p._value.astype(jnp.float32) - lr / (1 - b1p) * new_m / (new_u + self._epsilon)).astype(p._value.dtype)
+        self._write_param(
+            p,
+            (p._value.astype(jnp.float32) - lr / (1 - b1p) * new_m / (new_u + self._epsilon)).astype(p._value.dtype),
         )
         m._set_value(new_m)
         u._set_value(new_u)
@@ -336,4 +342,4 @@ class Lamb(Optimizer):
         trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
         m1._set_value(new_m1.astype(m1._value.dtype))
         m2._set_value(new_m2.astype(m2._value.dtype))
-        p._set_value((pv - lr * trust * update).astype(p._value.dtype))
+        self._write_param(p, (pv - lr * trust * update).astype(p._value.dtype))

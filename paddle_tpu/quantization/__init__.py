@@ -1,0 +1,24 @@
+"""Quantization: QAT (fake-quant in the graph) + PTQ (observer-calibrated).
+
+Reference: python/paddle/quantization/ — QuantConfig (config.py), QAT
+(qat.py), PTQ (ptq.py), observers (observers/abs_max.py), quanters
+(quanters/abs_max.py FakeQuanterWithAbsMaxObserver), wrapper.py.
+
+TPU-native design: fake-quant is a pure jax expression with a
+straight-through estimator (jax.lax.stop_gradient identity trick), so a
+QAT model still compiles into ONE fused XLA program under jit.to_static
+— no per-op observer kernels like the reference's CUDA fake_quant ops.
+int8 simulated quantization only (TPU int8 matmuls arrive via XLA when
+the pattern matches).
+"""
+from .config import QuantConfig  # noqa: F401
+from .observers import AbsmaxObserver, AVGObserver, BaseObserver  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+from .qat import QAT  # noqa: F401
+from .quanters import BaseQuanter, FakeQuanterWithAbsMaxObserver  # noqa: F401
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ",
+    "BaseObserver", "AbsmaxObserver", "AVGObserver",
+    "BaseQuanter", "FakeQuanterWithAbsMaxObserver",
+]

@@ -1,0 +1,65 @@
+"""QuantConfig (reference: python/paddle/quantization/config.py).
+
+Maps layers (by type, by name, or by type-name prefix) to the
+activation/weight quanter-or-observer instances QAT/PTQ should attach.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Type
+
+from ..nn.layer import Layer
+
+
+class _Spec:
+    def __init__(self, activation, weight):
+        self.activation = activation
+        self.weight = weight
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        self._default = _Spec(activation, weight)
+        self._by_type: Dict[type, _Spec] = {}
+        self._by_name: Dict[str, _Spec] = {}
+        self._customized_leaves: List[type] = []
+
+    # reference config.py add_* API
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            if isinstance(l, type):
+                self._by_type[l] = _Spec(activation, weight)
+            elif isinstance(l, Layer):
+                self._by_name[l.full_name() if hasattr(l, "full_name") else id(l)] = _Spec(activation, weight)
+            else:
+                self._by_name[str(l)] = _Spec(activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) else [layer_type]
+        for t in types:
+            self._by_type[t] = _Spec(activation, weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) else [layer_name]
+        for n in names:
+            self._by_name[str(n)] = _Spec(activation, weight)
+
+    def add_qat_layer_mapping(self, source, target):
+        self._by_type[source] = self._by_type.get(source, self._default)
+
+    def add_customized_leaf(self, layer_type):
+        self._customized_leaves.append(layer_type)
+
+    @property
+    def default_qat_layer_mapping(self):
+        return dict(self._by_type)
+
+    def _spec_for(self, name: str, layer: Layer) -> Optional[_Spec]:
+        if name in self._by_name:
+            return self._by_name[name]
+        for t, spec in self._by_type.items():
+            if isinstance(layer, t):
+                return spec
+        if self._default.activation is not None or self._default.weight is not None:
+            return self._default
+        return None

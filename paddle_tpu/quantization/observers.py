@@ -1,0 +1,70 @@
+"""Observers: collect activation/weight statistics during calibration.
+
+Reference: python/paddle/quantization/observers/abs_max.py
+(AbsmaxObserver -> AbsmaxObserverLayer) and base_observer.py.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer
+from ..tensor import Tensor
+
+
+class BaseObserver(Layer):
+    """Observes tensors flowing through and accumulates a quant scale
+    (reference base_observer.py BaseObserver: a Layer whose forward is
+    identity + statistics)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._scale = None
+
+    def forward(self, x: Tensor) -> Tensor:
+        self._observe(x)
+        return x
+
+    def _observe(self, x: Tensor):
+        raise NotImplementedError
+
+    def bit_length(self) -> int:
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+    def scales(self):
+        """The calibrated scale (max abs / qmax)."""
+        if self._scale is None:
+            return None
+        return Tensor(jnp.asarray(self._scale, jnp.float32))
+
+    def zero_points(self):
+        return Tensor(jnp.zeros((), jnp.float32))
+
+    def _instance(self, layer):  # factory-protocol parity
+        return self
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max of |x| (reference observers/abs_max.py)."""
+
+    def _observe(self, x: Tensor):
+        m = float(jnp.max(jnp.abs(x._value)))
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class AVGObserver(BaseObserver):
+    """Average of per-batch max |x| (reference observers/avg.py)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__(quant_bits)
+        self._sum = 0.0
+        self._n = 0
+
+    def _observe(self, x: Tensor):
+        self._sum += float(jnp.max(jnp.abs(x._value)))
+        self._n += 1
+        self._scale = self._sum / self._n

@@ -1,0 +1,110 @@
+"""PTQ: post-training quantization (reference: python/paddle/quantization/
+ptq.py PTQ.quantize — attach observers, run calibration batches, then
+convert observed scales into quant-dequant ops).
+"""
+from __future__ import annotations
+
+from ..nn.layer import Layer
+from .config import QuantConfig
+from .qat import QuantedWrapper
+from .quanters import fake_quant_dequant
+
+
+class _ObservedWrapper(Layer):
+    def __init__(self, inner: Layer, activation=None, weight=None):
+        super().__init__()
+        self._inner = inner
+        self.act_observer = (
+            activation._instance(inner) if activation is not None else None)
+        self.weight_observer = (
+            weight._instance(inner) if weight is not None else None)
+
+    def forward(self, x, *args, **kwargs):
+        if self.act_observer is not None:
+            x = self.act_observer(x)
+        if self.weight_observer is not None and hasattr(self._inner, "weight"):
+            self.weight_observer(self._inner.weight)
+        return self._inner(x, *args, **kwargs)
+
+
+class _FrozenQDQ(Layer):
+    """Post-calibration wrapper: fixed-scale quant-dequant (reference
+    ptq.py convert output — QDQ nodes with calibrated scales)."""
+
+    def __init__(self, inner: Layer, act_scale, w_scale, qmax=127.0):
+        super().__init__()
+        self._inner = inner
+        self._act_scale = act_scale
+        self._w_scale = w_scale
+        self._qmax = qmax
+
+    def forward(self, x, *args, **kwargs):
+        from ..ops import dispatch
+
+        if self._act_scale is not None:
+            s = float(self._act_scale)
+            qmax = self._qmax
+            x = dispatch.apply(
+                lambda xv: fake_quant_dequant(xv, s, qmax), x,
+                op_name="quantize_linear")
+        if self._w_scale is not None and hasattr(self._inner, "weight"):
+            w = self._inner.weight
+            s = float(self._w_scale)
+            qmax = self._qmax
+            raw = w._value
+            from .quanters import fake_quant_dequant as fq
+            import jax.numpy as jnp
+
+            w._value = fq(raw, jnp.asarray(s, raw.dtype), qmax)
+            try:
+                return self._inner(x, *args, **kwargs)
+            finally:
+                w._value = raw
+        return self._inner(x, *args, **kwargs)
+
+
+class PTQ:
+    """reference ptq.py: PTQ(config).quantize(model) -> observed model;
+    run calibration data through it; convert() -> quantized model."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            import copy as _copy
+
+            model = _copy.deepcopy(model)
+        self._wrap(model)
+        return model
+
+    def _wrap(self, layer: Layer, prefix=""):
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            spec = self._config._spec_for(full, sub)
+            if spec is not None and (spec.activation or spec.weight):
+                layer._sub_layers[name] = _ObservedWrapper(
+                    sub, spec.activation, spec.weight)
+                setattr(layer, name, layer._sub_layers[name])
+            else:
+                self._wrap(sub, full)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            import copy as _copy
+
+            model = _copy.deepcopy(model)
+        self._convert(model)
+        return model
+
+    def _convert(self, layer: Layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, _ObservedWrapper):
+                act_s = (float(sub.act_observer.scales())
+                         if sub.act_observer is not None and sub.act_observer.scales() is not None else None)
+                w_s = (float(sub.weight_observer.scales())
+                       if sub.weight_observer is not None and sub.weight_observer.scales() is not None else None)
+                layer._sub_layers[name] = _FrozenQDQ(sub._inner, act_s, w_s)
+                setattr(layer, name, layer._sub_layers[name])
+            else:
+                self._convert(sub)

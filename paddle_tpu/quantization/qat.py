@@ -1,0 +1,84 @@
+"""QAT: quantization-aware training (reference: python/paddle/quantization/
+qat.py QAT.quantize — wraps matched layers so activations/weights pass
+through fake-quant before the original compute; wrapper.py
+ObserveWrapper).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+from ..nn.layer import Layer
+from ..tensor import Tensor
+from .config import QuantConfig
+from .quanters import fake_quant_dequant
+
+
+class QuantedWrapper(Layer):
+    """Wraps a layer: activation fake-quant on input, weight fake-quant on
+    the wrapped layer's weight at call time (reference
+    nn/quant/qat/Linear QuantedLinear behavior, expressed generically)."""
+
+    def __init__(self, inner: Layer, activation=None, weight=None):
+        super().__init__()
+        self._inner = inner
+        self.activation_quanter = (
+            activation._instance(inner) if activation is not None else None)
+        self.weight_quanter = (
+            weight._instance(inner) if weight is not None else None)
+
+    def forward(self, x, *args, **kwargs):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None and hasattr(self._inner, "weight"):
+            w = self._inner.weight
+            qw = self.weight_quanter(w)
+            # temporarily swap the fake-quanted weight in for this call
+            raw = w._value
+            w._value = qw._value
+            try:
+                return self._inner(x, *args, **kwargs)
+            finally:
+                w._value = raw
+        return self._inner(x, *args, **kwargs)
+
+
+class QAT:
+    """reference qat.py QAT(config).quantize(model) -> fake-quanted model."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            import copy as _copy
+
+            model = _copy.deepcopy(model)
+        self._quantize_sublayers(model)
+        return model
+
+    def _quantize_sublayers(self, layer: Layer, prefix=""):
+        for name, sub in list(layer._sub_layers.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            spec = self._config._spec_for(full, sub)
+            if spec is not None and (spec.activation or spec.weight):
+                layer._sub_layers[name] = QuantedWrapper(
+                    sub, spec.activation, spec.weight)
+                setattr(layer, name, layer._sub_layers[name])
+            else:
+                self._quantize_sublayers(sub, full)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Freeze: bake observed scales into plain quant-dequant (reference
+        qat.py convert -> ONNX-style QDQ). Here scales stay attached; the
+        model remains a pure-jax program ready for jit.save."""
+        if not inplace:
+            import copy as _copy
+
+            model = _copy.deepcopy(model)
+        for sub in model.sublayers(include_self=True):
+            if isinstance(sub, QuantedWrapper):
+                for q in (sub.activation_quanter, sub.weight_quanter):
+                    if q is not None:
+                        q.eval()
+        return model

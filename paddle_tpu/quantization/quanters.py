@@ -1,0 +1,83 @@
+"""Quanters: fake-quant layers used during QAT.
+
+Reference: python/paddle/quantization/quanters/abs_max.py
+(FakeQuanterWithAbsMaxObserver -> FakeQuanterWithAbsMaxObserverLayer:
+quant-dequant with a moving-average abs-max scale; straight-through
+gradients).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..ops import dispatch
+from ..tensor import Tensor
+
+
+def fake_quant_dequant(x, scale, qmax):
+    """Simulated int quantization with a straight-through estimator:
+    rounds in the forward pass, identity gradient in the backward —
+    as one pure jax expression (compiles into the surrounding program)."""
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax) * s / qmax
+    # straight-through: forward q, gradient of x
+    return x + jax.lax.stop_gradient(q - x)
+
+
+class BaseQuanter(Layer):
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Moving-average abs-max fake quanter (reference quanters/abs_max.py).
+
+    state: ``_scale`` is a buffer updated with an EMA of batch abs-max
+    during training; eval uses the frozen scale.
+    """
+
+    def __init__(self, moving_rate: float = 0.9, quant_bits: int = 8,
+                 dtype="float32", name=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._quant_bits = quant_bits
+        self._qmax = float(2 ** (quant_bits - 1) - 1)
+        self._scale = Tensor(jnp.asarray(0.0, jnp.float32),
+                             stop_gradient=True)
+
+    def forward(self, x: Tensor) -> Tensor:
+        if self.training:
+            dispatch.note_read(self._scale)
+            rate = self._moving_rate
+
+            def upd(xv, sv):
+                batch_max = jnp.max(jnp.abs(xv)).astype(jnp.float32)
+                # first observation seeds the scale directly; afterwards EMA
+                # (avoids the long warm-up from a tiny init that makes early
+                # QAT steps quantize everything into the clip rails)
+                return jnp.where(sv == 0.0, batch_max,
+                                 rate * sv + (1 - rate) * batch_max)
+
+            new_scale = dispatch.apply(upd, x, self._scale,
+                                       op_name="moving_absmax")
+            self._scale._set_value(jax.lax.stop_gradient(new_scale._value))
+        qmax = self._qmax
+
+        def fq(xv, sv):
+            return fake_quant_dequant(xv, sv.astype(xv.dtype), qmax)
+
+        return dispatch.apply(fq, x, self._scale, op_name="fake_quant")
+
+    def scales(self):
+        return self._scale
+
+    def zero_points(self):
+        return Tensor(jnp.zeros((), jnp.float32))
+
+    def _instance(self, layer):  # QuanterFactory protocol
+        return FakeQuanterWithAbsMaxObserver(self._moving_rate,
+                                             self._quant_bits)

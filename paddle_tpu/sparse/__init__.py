@@ -1,0 +1,289 @@
+"""Sparse tensors + ops (reference: python/paddle/sparse/ — creation.py
+sparse_coo_tensor/sparse_csr_tensor, unary.py, binary.py matmul/add,
+nn/ sparse layers; kernels paddle/phi/kernels/sparse/).
+
+TPU-native design: SparseCooTensor/SparseCsrTensor wrap
+``jax.experimental.sparse`` BCOO/BCSR arrays — batched-COO is the
+XLA-lowered sparse format (gather/scatter/segment-sum programs the TPU
+executes well), replacing the reference's handwritten CUDA sparse
+kernels.  Values support autograd through the framework dispatch like
+any dense op.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.dtype import to_jax_dtype
+from ..ops import dispatch
+from ..ops._factory import ensure_tensor
+from ..tensor import Tensor
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor",
+    "sparse_coo_tensor", "sparse_csr_tensor",
+    "is_same_shape", "matmul", "masked_matmul", "addmm", "add", "subtract",
+    "multiply", "divide", "transpose", "sum",
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "abs", "pow", "cast", "neg", "expm1",
+    "deg2rad", "rad2deg", "coalesce", "isnan", "nn",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor backed by a BCOO array (reference
+    phi/core/sparse_coo_tensor.h)."""
+
+    format = "coo"
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._m = bcoo
+
+    # -- paddle Tensor-protocol surface ---------------------------------
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    @property
+    def dtype(self):
+        return self._m.dtype
+
+    @property
+    def nnz(self):
+        return int(self._m.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._m.indices.T)  # [ndim, nnz] like the reference
+
+    def values(self) -> Tensor:
+        return Tensor(self._m.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._m.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._m.sum_duplicates()))
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._m.sum_duplicates())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor backed by a BCSR array (reference
+    phi/core/sparse_csr_tensor.h)."""
+
+    format = "csr"
+
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._m = bcsr
+
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    @property
+    def dtype(self):
+        return self._m.dtype
+
+    @property
+    def nnz(self):
+        return int(self._m.nse)
+
+    def crows(self) -> Tensor:
+        return Tensor(self._m.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._m.indices)
+
+    def values(self) -> Tensor:
+        return Tensor(self._m.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._m.todense())
+
+    def to_sparse_coo(self, sparse_dim=None) -> SparseCooTensor:
+        return SparseCooTensor(self._m.to_bcoo())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def _raw(x):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return x._m
+    return ensure_tensor(x)._value
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCooTensor:
+    """reference sparse/creation.py sparse_coo_tensor: indices [ndim, nnz]."""
+    idx = np.asarray(ensure_tensor(indices)._value if isinstance(indices, Tensor)
+                     else indices)
+    vals = ensure_tensor(values)._value
+    if dtype is not None:
+        vals = vals.astype(to_jax_dtype(dtype))
+    idx_t = jnp.asarray(idx.T if idx.ndim == 2 else idx, jnp.int32)
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in np.asarray(idx).max(axis=1))
+    m = jsparse.BCOO((vals, idx_t), shape=tuple(shape))
+    return SparseCooTensor(m)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True) -> SparseCsrTensor:
+    """reference sparse/creation.py sparse_csr_tensor."""
+    vals = ensure_tensor(values)._value
+    if dtype is not None:
+        vals = vals.astype(to_jax_dtype(dtype))
+    indptr = jnp.asarray(np.asarray(ensure_tensor(crows)._value), jnp.int32)
+    cidx = jnp.asarray(np.asarray(ensure_tensor(cols)._value), jnp.int32)
+    m = jsparse.BCSR((vals, cidx, indptr), shape=tuple(shape))
+    return SparseCsrTensor(m)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(_shape(x)) == list(_shape(y))
+
+
+def _shape(x):
+    return x.shape if hasattr(x, "shape") else np.asarray(x).shape
+
+
+# -- binary ----------------------------------------------------------------
+
+def matmul(x, y, name=None):
+    """sparse @ dense (and sparse @ sparse -> dense) — reference
+    sparse/binary.py matmul -> phi/kernels/sparse/matmul_kernel."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        y = y.to_dense()
+    assert isinstance(x, SparseCooTensor)
+    yv = ensure_tensor(y)
+
+    m = x._m
+
+    def raw(data, yraw):
+        mm = jsparse.BCOO((data, m.indices), shape=m.shape)
+        return mm @ yraw
+
+    out = dispatch.apply(raw, Tensor(m.data), yv, op_name="sparse_matmul")
+    return out
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense evaluated only at mask's nonzeros (reference
+    binary.py masked_matmul: SDDMM)."""
+    xv, yv = ensure_tensor(x), ensure_tensor(y)
+    assert isinstance(mask, (SparseCooTensor, SparseCsrTensor))
+    coo = mask if isinstance(mask, SparseCooTensor) else mask.to_sparse_coo()
+    idx = coo._m.indices  # [nnz, 2]
+
+    def raw(a, b):
+        rows = idx[:, 0]
+        cols = idx[:, 1]
+        vals = jnp.einsum("nk,nk->n", a[rows, :], b[:, cols].T)
+        return vals
+
+    vals = dispatch.apply(raw, xv, yv, op_name="masked_matmul")
+    return SparseCooTensor(jsparse.BCOO((vals._value, idx), shape=coo._m.shape))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return ensure_tensor(input) * beta + matmul(x, y) * alpha
+
+
+def _ewise(op_name, fn):
+    def op(x, y, name=None):
+        if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+            out = fn(x._m.todense(), y._m.todense())
+            return SparseCooTensor(jsparse.BCOO.fromdense(out))
+        raise TypeError(f"sparse.{op_name} expects two sparse COO tensors")
+
+    op.__name__ = op_name
+    return op
+
+
+add = _ewise("add", lambda a, b: a + b)
+subtract = _ewise("subtract", lambda a, b: a - b)
+multiply = _ewise("multiply", lambda a, b: a * b)
+divide = _ewise("divide", lambda a, b: jnp.where(b != 0, a / b, jnp.zeros_like(a)))
+
+
+def transpose(x, perm, name=None):
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x._m.transpose(tuple(perm)))
+    raise TypeError("sparse.transpose expects a sparse COO tensor")
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A002
+    d = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
+    from .. import ops as _ops
+
+    return _ops.sum(d, axis=axis, keepdim=keepdim)
+
+
+# -- unary (values-only maps that preserve sparsity F(0)=0) ---------------
+
+def _unary(name, jfn):
+    def op(x, name_=None):
+        if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+            m = x._m
+            data = jfn(m.data)
+            if isinstance(x, SparseCooTensor):
+                return SparseCooTensor(jsparse.BCOO((data, m.indices), shape=m.shape))
+            return SparseCsrTensor(jsparse.BCSR((data, m.indices, m.indptr), shape=m.shape))
+        raise TypeError(f"sparse.{name} expects a sparse tensor")
+
+    op.__name__ = name
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)  # noqa: A001
+neg = _unary("neg", jnp.negative)
+expm1 = _unary("expm1", jnp.expm1)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+isnan = _unary("isnan", jnp.isnan)
+
+
+def pow(x, factor, name=None):  # noqa: A001
+    return _unary("pow", lambda d: jnp.power(d, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    if not isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        raise TypeError("sparse.cast expects a sparse tensor")
+    m = x._m
+    data = m.data if value_dtype is None else m.data.astype(to_jax_dtype(value_dtype))
+    if isinstance(x, SparseCooTensor):
+        idx = m.indices if index_dtype is None else m.indices.astype(to_jax_dtype(index_dtype))
+        return SparseCooTensor(jsparse.BCOO((data, idx), shape=m.shape))
+    return SparseCsrTensor(jsparse.BCSR((data, m.indices, m.indptr), shape=m.shape))
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+from . import nn  # noqa: E402,F401

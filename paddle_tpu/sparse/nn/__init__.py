@@ -1,0 +1,33 @@
+"""sparse.nn: activations over sparse tensors (reference:
+python/paddle/sparse/nn/ — ReLU/LeakyReLU/Softmax layers + functional).
+Submanifold sparse conv is out of the TPU v1 scope (reference
+kernels/sparse/gpu/conv_kernel.cu) — dense conv covers TPU workloads."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...nn.layer import Layer as _Layer
+from . import functional  # noqa: F401
+
+
+class ReLU(_Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class LeakyReLU(_Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self._slope)
+
+
+class Softmax(_Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self._axis)

@@ -1,0 +1,44 @@
+"""sparse.nn.functional (reference: python/paddle/sparse/nn/functional/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import SparseCooTensor, SparseCsrTensor, _unary
+from ... import sparse as _sparse
+
+relu = _unary("relu", lambda d: jnp.maximum(d, 0))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _unary("leaky_relu",
+                  lambda d: jnp.where(d >= 0, d, d * negative_slope))(x)
+
+
+def relu6(x, name=None):
+    return _unary("relu6", lambda d: jnp.clip(d, 0, 6))(x)
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over the sparse pattern (reference
+    sparse/nn/functional/activation.py softmax: only stored values
+    participate)."""
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_sparse_coo()
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse softmax expects a sparse tensor")
+    m = x._m.sum_duplicates()
+    idx = m.indices  # [nnz, ndim]
+    rows = idx[:, 0]
+    data = m.data
+    # segment softmax over rows
+    import jax
+
+    n_rows = m.shape[0]
+    row_max = jax.ops.segment_max(data, rows, num_segments=n_rows)
+    e = jnp.exp(data - row_max[rows])
+    denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+    out = e / denom[rows]
+    from jax.experimental import sparse as jsparse
+
+    return SparseCooTensor(jsparse.BCOO((out, idx), shape=m.shape))

@@ -6,7 +6,9 @@ the jitted XLA program produced by ``jit.to_static``; this namespace keeps
 the user-facing entry points (InputSpec, save/load inference models) without
 a separate graph IR.
 """
+from . import nn  # noqa: F401
 from .input_spec import InputSpec  # noqa: F401
+from .nn import Assert, cond, while_loop  # noqa: F401
 from ..jit.save_load import load as load_inference_model_impl  # noqa: F401
 
 

@@ -1,0 +1,14 @@
+"""static.nn: graph-building helpers (reference: python/paddle/static/nn/).
+
+The control-flow surface (cond / while_loop) is the load-bearing part for
+dy2static parity — data-dependent branching inside compiled programs.
+The layer builders (fc / embedding / conv2d / batch_norm — reference
+static/nn/common.py) are thin functional forms over the nn ops: in this
+architecture there is no separate static graph, so "building an op into
+a program" IS calling the op under jit.to_static tracing.
+"""
+from .common import batch_norm, conv2d, embedding, fc  # noqa: F401
+from .control_flow import Assert, cond, while_loop  # noqa: F401
+
+__all__ = ["cond", "while_loop", "Assert", "fc", "embedding", "conv2d",
+           "batch_norm"]

@@ -1,0 +1,98 @@
+"""Static layer builders (reference: python/paddle/static/nn/common.py —
+fc:28, conv2d, embedding, batch_norm: ops appended to the Program with
+auto-created parameters).
+
+TPU-native: parameters are created eagerly on first call and cached on
+the function (keyed by name), then the op dispatches like any imperative
+call — under jit.to_static the parameter is captured state and the math
+compiles into the program, which is exactly what the reference's
+append-to-Program achieves.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ... import ops
+from ...nn import functional as F
+from ...nn.initializer import Constant, XavierUniform
+from ...tensor import Parameter, Tensor
+
+_param_cache: dict = {}
+
+
+def _get_param(key, shape, initializer, dtype="float32"):
+    from ...core.dtype import to_jax_dtype
+
+    if key not in _param_cache:
+        _param_cache[key] = Parameter(
+            initializer(shape, to_jax_dtype(dtype)), trainable=True)
+    return _param_cache[key]
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """reference static/nn/common.py fc: flatten trailing dims, x @ W + b."""
+    in_feat = int(np.prod(x.shape[num_flatten_dims:]))
+    flat = ops.reshape(x, list(x.shape[:num_flatten_dims]) + [in_feat])
+    key = ("fc", name or f"auto_{id(fc)}_{in_feat}_{size}")
+    w = _get_param(key + ("w",), [in_feat, size], XavierUniform())
+    out = ops.matmul(flat, w)
+    if bias_attr is not False:
+        b = _get_param(key + ("b",), [size], Constant(0.0))
+        out = out + b
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,  # noqa: A002
+              param_attr=None, weight_attr=None, dtype="float32", name=None):
+    """reference static/nn/common.py embedding (lookup table)."""
+    key = ("embedding", name or f"auto_emb_{size[0]}_{size[1]}")
+    from ...nn.initializer import Normal
+
+    w = _get_param(key, list(size), Normal(0.0, 0.02), dtype)
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    """reference static/nn/common.py conv2d."""
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    fs = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size, filter_size)
+    key = ("conv2d", name or f"auto_conv_{in_ch}_{num_filters}_{fs}")
+    from ...nn.initializer import KaimingUniform
+
+    w = _get_param(key + ("w",), [num_filters, in_ch // groups, *fs],
+                   KaimingUniform())
+    b = None
+    if bias_attr is not False:
+        b = _get_param(key + ("b",), [num_filters], Constant(0.0))
+    out = F.conv2d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,  # noqa: A002
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, name=None):
+    """reference static/nn/common.py batch_norm (stats as captured state)."""
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    key = ("bn", name or f"auto_bn_{ch}")
+    g = _get_param(key + ("g",), [ch], Constant(1.0))
+    b = _get_param(key + ("b",), [ch], Constant(0.0))
+    mean = _get_param(key + ("m",), [ch], Constant(0.0))
+    var = _get_param(key + ("v",), [ch], Constant(1.0))
+    mean.stop_gradient = True
+    var.stop_gradient = True
+    out = F.batch_norm(input, mean, var, g, b, training=not is_test,
+                       momentum=momentum, epsilon=epsilon,
+                       data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out

@@ -1,0 +1,234 @@
+"""Data-dependent control flow for compiled programs.
+
+Reference: python/paddle/static/nn/control_flow.py (cond:1126,
+While/while_loop:1321) — there, branches become conditional_block /
+while ops in the ProgramDesc, executed by InterpreterCore
+(operators/controlflow/conditional_block_op.cc, while_op.cc).
+
+TPU-native redesign: branches lower to ``lax.cond`` / ``lax.while_loop``
+inside the SAME jitted program as the surrounding code.  A branch is an
+ordinary Python closure over Tensors; we functionalize it by running it
+once under a capture scope that records every Tensor it reads (leaves
+AND intermediates), then rebuild it as a pure jax function of those
+captures.  The cond op is dispatched through ``ops.dispatch.apply``, so
+gradients flow through both branches (``jax.vjp`` of ``lax.cond``
+produces the select-of-branch-vjps program).
+
+Eager mode (predicate is a concrete value) short-circuits to plain
+Python — the dygraph semantics of the reference's cond API.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops import dispatch
+from ...ops._factory import ensure_tensor
+from ...tensor import Tensor
+
+__all__ = ["cond", "while_loop", "Assert"]
+
+
+def _is_traced(value) -> bool:
+    return isinstance(value, jax.core.Tracer)
+
+
+def _flatten_out(obj, acc: List[Tensor]):
+    if isinstance(obj, Tensor):
+        acc.append(obj)
+        return ("t", len(acc) - 1)
+    if isinstance(obj, (list, tuple)):
+        return ("seq", type(obj).__name__,
+                [_flatten_out(o, acc) for o in obj])
+    if obj is None:
+        return ("none",)
+    raise TypeError(
+        f"cond/while_loop branches must return Tensors or (nested) "
+        f"lists/tuples of Tensors, got {type(obj).__name__}")
+
+
+def _unflatten_out(spec, vals):
+    kind = spec[0]
+    if kind == "t":
+        return vals[spec[1]]
+    if kind == "seq":
+        seq = [_unflatten_out(s, vals) for s in spec[2]]
+        return tuple(seq) if spec[1] == "tuple" else seq
+    return None
+
+
+def _run_captured(fn: Callable, args=()):
+    """Run ``fn`` once recording every Tensor it reads; returns
+    (result, captured_tensors).  Mutations inside a branch are rejected —
+    a conditional body must communicate through its return value."""
+    blog = {}
+    mut = {}
+    prev_b = dispatch._trace_state.branch_log
+    prev_m = dispatch._trace_state.mutation_log
+    dispatch._trace_state.branch_log = blog
+    dispatch._trace_state.mutation_log = mut
+    try:
+        result = fn(*args)
+    finally:
+        dispatch._trace_state.branch_log = prev_b
+        dispatch._trace_state.mutation_log = prev_m
+    if mut:
+        raise RuntimeError(
+            "cond/while_loop branch mutated framework state "
+            "(parameter update, RNG advance, buffer write): conditional "
+            "bodies must be pure — return new values instead")
+    arg_ids = {id(a) for a in args if isinstance(a, Tensor)}
+    captured = [t for tid, t in blog.items() if tid not in arg_ids]
+    return result, captured
+
+
+def _pure_branch(fn: Callable, captured: Sequence[Tensor], n_args: int,
+                 out_len: int):
+    """Rebuild ``fn`` as pure(args_raws, cap_raws) -> tuple of raws."""
+
+    def pure(arg_raws, cap_raws):
+        snapshot = [(t, t._value) for t in captured]
+        try:
+            for t, rv in zip(captured, cap_raws):
+                t._value = rv
+            with dispatch.no_grad():
+                res = fn(*[Tensor(r, stop_gradient=True) for r in arg_raws])
+            outs: List[Tensor] = []
+            _flatten_out(res, outs)
+            if len(outs) != out_len:
+                raise ValueError(
+                    "cond branches must return the same number of tensors")
+            return tuple(o._value for o in outs)
+        finally:
+            for t, v in snapshot:
+                t._value = v
+
+    return pure
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, name=None,
+         return_names=None):
+    """Reference static/nn/control_flow.py cond: run ``true_fn`` when the
+    boolean scalar ``pred`` is True, else ``false_fn``; both branches must
+    return matching structures.
+
+    Eagerly (concrete pred) only the taken branch runs.  Under
+    ``jit.to_static`` tracing this lowers to ``lax.cond`` — both branches
+    are traced, one executes on device — and it is differentiable.
+    """
+    pred_t = ensure_tensor(pred)
+    if not _is_traced(pred_t._value):
+        taken = true_fn if bool(np.asarray(pred_t._value)) else false_fn
+        return taken()
+
+    t_res, t_caps = _run_captured(true_fn)
+    f_res, f_caps = _run_captured(false_fn)
+    t_outs: List[Tensor] = []
+    t_spec = _flatten_out(t_res, t_outs)
+    f_outs: List[Tensor] = []
+    _flatten_out(f_res, f_outs)
+    if len(t_outs) != len(f_outs):
+        raise ValueError(
+            f"cond branches returned different numbers of tensors "
+            f"({len(t_outs)} vs {len(f_outs)})")
+    for a, b in zip(t_outs, f_outs):
+        if tuple(a._value.shape) != tuple(b._value.shape):
+            raise ValueError(
+                f"cond branch outputs must match in shape, got "
+                f"{tuple(a._value.shape)} vs {tuple(b._value.shape)}")
+
+    n_t = len(t_caps)
+    pure_t = _pure_branch(true_fn, t_caps, 0, len(t_outs))
+    pure_f = _pure_branch(false_fn, f_caps, 0, len(f_outs))
+
+    def raw(pred_raw, *cap_raws):
+        tc = cap_raws[:n_t]
+        fc = cap_raws[n_t:]
+        # promote branch outputs to common dtypes (both traced anyway)
+        return jax.lax.cond(
+            jnp.reshape(pred_raw, ()).astype(bool),
+            lambda ops_: pure_t((), ops_[0]),
+            lambda ops_: pure_f((), ops_[1]),
+            (tc, fc),
+        )
+
+    out = dispatch.apply(raw, pred_t, *t_caps, *f_caps, op_name="cond")
+    if not isinstance(out, tuple):
+        out = (out,)
+    return _unflatten_out(t_spec, list(out))
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test=False, name=None):
+    """Reference control_flow.py while_loop: iterate ``body_fn`` while
+    ``cond_fn(*loop_vars)`` holds.
+
+    Eagerly this is a Python loop.  Under tracing it lowers to
+    ``lax.while_loop``; XLA's while is forward-only, so differentiating
+    through a traced while_loop is rejected with guidance to use a
+    bounded ``lax.scan``-style loop (matching XLA semantics rather than
+    the reference's while_grad op).
+    """
+    loop_vars = [ensure_tensor(v) for v in loop_vars]
+    traced = any(_is_traced(v._value) for v in loop_vars)
+    if not traced:
+        vals = list(loop_vars)
+        while bool(np.asarray(ensure_tensor(cond_fn(*vals))._value)):
+            out = body_fn(*vals)
+            if not isinstance(out, (list, tuple)):
+                out = [out]
+            vals = [ensure_tensor(v) for v in out]
+        return vals
+
+    if dispatch.is_grad_enabled() and any(
+            not v.stop_gradient for v in loop_vars):
+        raise NotImplementedError(
+            "while_loop over traced values is not reverse-differentiable "
+            "(XLA while has no transpose). Run it under no_grad, or "
+            "restructure as a bounded loop (e.g. lax.scan via "
+            "paddle_tpu ops) for training")
+
+    _, c_caps = _run_captured(cond_fn, tuple(loop_vars))
+    body_res, b_caps = _run_captured(body_fn, tuple(loop_vars))
+    if not isinstance(body_res, (list, tuple)):
+        body_res = [body_res]
+    if len(body_res) != len(loop_vars):
+        raise ValueError(
+            f"while_loop body must return as many values as loop_vars "
+            f"({len(body_res)} vs {len(loop_vars)})")
+
+    n_loop = len(loop_vars)
+    pure_c = _pure_branch(cond_fn, c_caps, n_loop, 1)
+    pure_b = _pure_branch(body_fn, b_caps, n_loop, n_loop)
+
+    def raw(*all_raws):
+        lv = all_raws[:n_loop]
+        cc = all_raws[n_loop:n_loop + len(c_caps)]
+        bc = all_raws[n_loop + len(c_caps):]
+
+        def cond_w(carry):
+            (r,) = pure_c(carry, cc)
+            return jnp.reshape(r, ()).astype(bool)
+
+        def body_w(carry):
+            return pure_b(carry, bc)
+
+        return jax.lax.while_loop(cond_w, body_w, tuple(lv))
+
+    outs = dispatch.apply_nondiff(raw, *loop_vars, *c_caps, *b_caps)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def Assert(cond_value, data=None, summarize=20, name=None):
+    """Reference control_flow.py Assert: eager check; traced values use
+    jax's checkify-free best effort (no-op under trace, matching XLA's
+    lack of host asserts in compiled programs)."""
+    t = ensure_tensor(cond_value)
+    if _is_traced(t._value):
+        return
+    if not bool(np.asarray(t._value).all()):
+        items = [np.asarray(ensure_tensor(d)._value) for d in (data or [])]
+        raise AssertionError(f"Assert failed; data={items[:summarize]}")

@@ -237,6 +237,21 @@ class Tensor:
         )
 
     def __bool__(self):
+        import jax as _jax
+
+        if isinstance(self._value, _jax.core.Tracer):
+            # trace-unstable branching: `if tensor:` / `while tensor:` on a
+            # value only known at run time cannot compile (reference
+            # dy2static rewrites these into cond/while ops via AST
+            # transforms — program_translator.py)
+            raise RuntimeError(
+                "data-dependent Python control flow on a traced Tensor: "
+                "`if`/`while` on a runtime value cannot be compiled by "
+                "jit.to_static. Use paddle_tpu.static.nn.cond(pred, "
+                "true_fn, false_fn) or paddle_tpu.static.nn.while_loop "
+                "instead (they lower to lax.cond / lax.while_loop inside "
+                "the compiled program)."
+            )
         return bool(self.numpy())
 
     def __int__(self):

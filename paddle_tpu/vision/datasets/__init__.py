@@ -1,5 +1,11 @@
-"""vision.datasets (reference: python/paddle/vision/datasets/) — synthetic
-fallbacks since this environment has no dataset downloads."""
+"""vision.datasets (reference: python/paddle/vision/datasets/).
+
+IMPORTANT: in this zero-egress build every dataset class is a SYNTHETIC
+STAND-IN (random images/labels via FakeData) — "MNIST"/"Cifar10" here
+exercise the data pipeline and model plumbing, they do NOT contain the
+real corpora.  A "model trains on MNIST" result with these classes means
+"the training loop runs end-to-end", not a real-accuracy claim.  Point
+``paddle_tpu.io.Dataset`` subclasses at real files for actual data."""
 from __future__ import annotations
 
 import numpy as np

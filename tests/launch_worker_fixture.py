@@ -1,0 +1,49 @@
+"""Worker script for the multi-process launch test (reference analog:
+test/collective/fleet worker scripts run by TestMultipleGpus
+start_local_trainers).  Each rank: rendezvous via the native TCPStore,
+build a local 4-virtual-device CPU mesh, run a tiny SPMD reduction, then
+exchange a tensor cross-rank through the store-backed send/recv."""
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import mesh as M
+
+
+def main():
+    env = dist.init_parallel_env()
+    rank, world = dist.get_rank(), dist.get_world_size()
+    assert world == 2, world
+
+    # local 4-device CPU mesh SPMD sanity (per-host compute)
+    import jax
+
+    assert len(jax.devices()) >= 4, jax.devices()
+    M.set_mesh(M.build_mesh({"dp": 4}, jax.devices()[:4]))
+    x = pt.to_tensor(np.arange(8, dtype=np.float32))
+    total = float(pt.ops.sum(x * (rank + 1)))
+    assert total == 28.0 * (rank + 1), total
+
+    # cross-host p2p through the job's TCPStore
+    from paddle_tpu.distributed.collective import recv, send
+
+    if rank == 0:
+        send(pt.to_tensor(np.full((4,), 41.0, np.float32)), dst=1)
+        out = pt.to_tensor(np.zeros((2,), np.float32))
+        recv(out, src=1)
+        assert np.allclose(out.numpy(), 7.0), out.numpy()
+    else:
+        got = pt.to_tensor(np.zeros((4,), np.float32))
+        recv(got, src=0)
+        assert np.allclose(got.numpy(), 41.0), got.numpy()
+        send(pt.to_tensor(np.full((2,), 7.0, np.float32)), dst=0)
+
+    dist.barrier()
+    print(f"WORKER_OK rank={rank}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Elastic manager + launcher restart (reference:
+fleet/elastic/manager.py:124 heartbeat/TTL membership; launcher
+max_restart relaunch)."""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.native.tcp_store import TCPStore
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, ElasticStatus
+
+
+def test_membership_and_failure_detection():
+    store = TCPStore(host="127.0.0.1", port=0, is_master=True, world_size=2)
+    changes = []
+    m0 = ElasticManager(store, rank=0, nnodes=2, ttl=1.0, interval=0.2,
+                        on_change=lambda alive: changes.append(alive))
+    m1 = ElasticManager(store, rank=1, nnodes=2, ttl=1.0, interval=0.2)
+    m0.start()
+    m1.start()
+    time.sleep(0.6)
+    assert sorted(m0.alive_nodes()) == [0, 1]
+    assert m0.health() == ElasticStatus.COMPLETED
+    # node 1 dies (heartbeat stops); TTL expires -> membership change fires
+    m1.stop()
+    deadline = time.time() + 5
+    while time.time() < deadline and 1 in m0.alive_nodes():
+        time.sleep(0.2)
+    assert m0.alive_nodes() == [0]
+    assert m0.health() in (ElasticStatus.RESTART, ElasticStatus.HOLD)
+    assert any(alive == [0] for alive in changes)
+    m0.stop()
+
+
+def test_launcher_elastic_restart(tmp_path):
+    """A worker that crashes once is relaunched and the job succeeds."""
+    script = tmp_path / "flaky.py"
+    marker = tmp_path / "crashed_once"
+    script.write_text(
+        "import os, sys\n"
+        f"m = {str(repr(str(marker)))}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close()\n"
+        "    sys.exit(3)\n"
+        "print('RECOVERED_OK')\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+         if p and "axon" not in p] + ["/root/repo"])
+    log_dir = str(tmp_path / "logs")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", "--elastic_level", "1",
+         "--max_restart", "2", "--log_dir", log_dir, str(script)],
+        cwd="/root/repo", env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    logs = "".join(
+        open(os.path.join(log_dir, f)).read() for f in os.listdir(log_dir))
+    assert "RECOVERED_OK" in logs
+    assert "elastic restart 1/2" in proc.stderr
+
+
+def test_launcher_fail_fast_without_elastic(tmp_path):
+    script = tmp_path / "dies.py"
+    script.write_text("import sys; sys.exit(5)\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+         if p and "axon" not in p] + ["/root/repo"])
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "1", str(script)],
+        cwd="/root/repo", env=env, capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 5

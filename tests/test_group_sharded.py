@@ -1,0 +1,125 @@
+"""group_sharded (ZeRO) stages: the sharding SPECS of params / grads /
+optimizer state must actually differ between os / os_g / p_g_os.
+
+Reference: python/paddle/distributed/sharding/group_sharded.py +
+fleet/meta_parallel/sharding/group_sharded_stage{2,3}.py (stage 2 =
+grad reduce-scatter into shards, stage 3 = param sharding with
+allgather-around-use).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import mesh as M
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+
+@pytest.fixture
+def sharding_mesh():
+    prev = M._global_mesh
+    mesh = M.build_mesh({"dp": 2, "sharding": 4})
+    M.set_mesh(mesh)
+    yield mesh
+    M._global_mesh = prev
+
+
+def _build():
+    pt.seed(3)
+    model = pt.nn.Sequential(
+        pt.nn.Linear(16, 32), pt.nn.GELU(), pt.nn.Linear(32, 16))
+    opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    return model, opt
+
+
+def _step(model, opt):
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(8, 16).astype(np.float32))
+    y = pt.to_tensor(rng.randn(8, 16).astype(np.float32))
+    loss = pt.ops.mean((model(x) - y) ** 2)
+    loss.backward()
+    opt.step()
+    return loss
+
+
+def _is_sharded_over(arr, axis):
+    spec = getattr(arr.sharding, "spec", None)
+    return spec is not None and axis in tuple(spec)
+
+
+def _moment_arrays(opt):
+    return [t._value for store in opt._accumulators.values()
+            for t in store.values()]
+
+
+def test_stage1_os_shards_lazy_moments(sharding_mesh):
+    model, opt = _build()
+    group_sharded_parallel(model, opt, "os")
+    _step(model, opt)  # accumulators created lazily HERE
+    moments = _moment_arrays(opt)
+    assert moments, "no accumulators created"
+    assert any(_is_sharded_over(m, "sharding") for m in moments)
+    # stage 1 does NOT shard params or grads
+    for p in model.parameters():
+        assert not _is_sharded_over(p._value, "sharding")
+        if p.grad is not None:
+            assert not _is_sharded_over(p.grad._value, "sharding")
+
+
+def test_stage2_os_g_reduce_scatters_grads(sharding_mesh):
+    model, opt = _build()
+    group_sharded_parallel(model, opt, "os_g")
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(8, 16).astype(np.float32))
+    y = pt.to_tensor(rng.randn(8, 16).astype(np.float32))
+    loss = pt.ops.mean((model(x) - y) ** 2)
+    loss.backward()
+    sharded_grads = [p for p in model.parameters()
+                     if p.grad is not None
+                     and _is_sharded_over(p.grad._value, "sharding")]
+    assert sharded_grads, "stage 2 must lay grads out over the sharding axis"
+    # params still replicated at stage 2
+    for p in model.parameters():
+        assert not _is_sharded_over(p._value, "sharding")
+    opt.step()
+    assert any(_is_sharded_over(m, "sharding") for m in _moment_arrays(opt))
+
+
+def test_stage3_p_g_os_shards_params(sharding_mesh):
+    model, opt = _build()
+    group_sharded_parallel(model, opt, "p_g_os")
+    sharded_params = [p for p in model.parameters()
+                      if _is_sharded_over(p._value, "sharding")]
+    assert sharded_params, "stage 3 must shard parameters"
+    loss0 = float(_step(model, opt))
+    # params stay sharded after the update
+    assert any(_is_sharded_over(p._value, "sharding")
+               for p in model.parameters())
+    assert np.isfinite(loss0)
+
+
+def test_stages_match_numerically(sharding_mesh):
+    """All three stages are layout choices — the math must be identical."""
+    losses = {}
+    for level in ("os", "os_g", "p_g_os"):
+        model, opt = _build()
+        group_sharded_parallel(model, opt, level)
+        for _ in range(3):
+            loss = _step(model, opt)
+            opt.clear_grad()
+        losses[level] = float(loss)
+    assert np.allclose(losses["os"], losses["os_g"], rtol=1e-5)
+    assert np.allclose(losses["os"], losses["p_g_os"], rtol=1e-5)
+
+
+def test_fallback_to_dp_axis():
+    """Without a 'sharding' mesh axis the API uses 'dp' (reference default
+    group = DP group)."""
+    prev = M._global_mesh
+    try:
+        M.set_mesh(M.build_mesh({"dp": 8}))
+        model, opt = _build()
+        group_sharded_parallel(model, opt, "p_g_os")
+        assert any(_is_sharded_over(p._value, "dp")
+                   for p in model.parameters())
+    finally:
+        M._global_mesh = prev

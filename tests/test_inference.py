@@ -1,0 +1,63 @@
+"""Inference Predictor surface (reference: analysis_predictor.h:94 +
+python/paddle/inference/wrapper.py): save a model with jit.save, serve it
+with Config/create_predictor, zero-copy handles."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import inference
+
+
+def test_predictor_end_to_end(tmp_path):
+    pt.seed(4)
+    model = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.GELU(),
+                             pt.nn.Linear(16, 4))
+    model.eval()
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    ref = model(pt.to_tensor(x)).numpy()
+
+    prefix = str(tmp_path / "served" / "model")
+    pt.jit.save(model, prefix,
+                input_spec=[pt.static.InputSpec([2, 8], "float32")])
+
+    config = inference.Config(prefix)
+    config.enable_memory_optim()
+    config.switch_ir_optim(True)
+    assert "XLA" in config.summary()
+    predictor = inference.create_predictor(config)
+
+    names = predictor.get_input_names()
+    h = predictor.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    predictor.run()
+    out_names = predictor.get_output_names()
+    out = predictor.get_output_handle(out_names[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_run_list_api(tmp_path):
+    pt.seed(4)
+    model = pt.nn.Linear(4, 2)
+    model.eval()
+    x = np.ones((3, 4), np.float32)
+    ref = model(pt.to_tensor(x)).numpy()
+    prefix = str(tmp_path / "m2")
+    pt.jit.save(model, prefix,
+                input_spec=[pt.static.InputSpec([3, 4], "float32")])
+    predictor = inference.create_predictor(inference.Config(prefix))
+    outs = predictor.run([pt.to_tensor(x)])
+    np.testing.assert_allclose(outs[0].numpy(), ref, rtol=1e-5)
+    assert predictor.get_input_names() == ["x0"]
+
+
+def test_predictor_pool(tmp_path):
+    pt.seed(4)
+    model = pt.nn.Linear(4, 2)
+    model.eval()
+    prefix = str(tmp_path / "m3")
+    pt.jit.save(model, prefix,
+                input_spec=[pt.static.InputSpec([1, 4], "float32")])
+    pool = inference.PredictorPool(inference.Config(prefix), 2)
+    for i in range(2):
+        p = pool.retrive(i)
+        out = p.run([pt.to_tensor(np.ones((1, 4), np.float32))])
+        assert out[0].shape == [1, 2]

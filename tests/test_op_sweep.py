@@ -1,0 +1,288 @@
+"""Op parity sweep: >=100 ops through the OpTest harness — numpy
+reference in eager AND to_static modes, plus analytic-vs-numeric
+check_grad for the differentiable ones.
+
+Reference: test/legacy_test/eager_op_test.py (OpTest.check_output:2143
+across execution modes, check_grad:2323 numeric central differences) and
+the per-op test files under test/legacy_test/.
+"""
+import numpy as np
+import pytest
+from scipy import special as sps
+
+import paddle_tpu as pt
+from paddle_tpu import ops
+
+from op_test import check_grad, check_output
+
+rng = np.random.RandomState(0)
+
+
+def _pos(*shape):
+    return (rng.rand(*shape) + 0.5).astype(np.float32)
+
+
+def _f32(*shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+def _unit(*shape):
+    return (rng.rand(*shape) * 1.6 - 0.8).astype(np.float32)
+
+
+def _i64(lo, hi, shape):
+    return rng.randint(lo, hi, shape).astype(np.int64)
+
+
+# (name, op_fn, numpy_fn, inputs, kwargs, grad?)  — grad=True also runs the
+# numeric gradient check on float64 copies of the same inputs
+UNARY = [
+    ("abs", ops.abs, np.abs, [_f32(2, 3)], {}, True),
+    ("acos", ops.acos, np.arccos, [_unit(2, 3)], {}, True),
+    ("acosh", ops.acosh, np.arccosh, [_pos(2, 3) + 1.0], {}, True),
+    ("asin", ops.asin, np.arcsin, [_unit(2, 3)], {}, True),
+    ("asinh", ops.asinh, np.arcsinh, [_f32(2, 3)], {}, True),
+    ("atan", ops.atan, np.arctan, [_f32(2, 3)], {}, True),
+    ("atanh", ops.atanh, np.arctanh, [_unit(2, 3) * 0.9], {}, True),
+    ("ceil", ops.ceil, np.ceil, [_f32(2, 3)], {}, False),
+    ("cos", ops.cos, np.cos, [_f32(2, 3)], {}, True),
+    ("cosh", ops.cosh, np.cosh, [_f32(2, 3)], {}, True),
+    ("deg2rad", ops.deg2rad, np.deg2rad, [_f32(2, 3) * 90], {}, True),
+    ("digamma", ops.digamma, sps.digamma, [_pos(2, 3) + 1], {}, True),
+    ("erf", ops.erf, sps.erf, [_f32(2, 3)], {}, True),
+    ("erfinv", ops.erfinv, sps.erfinv, [_unit(2, 3) * 0.9], {}, True),
+    ("exp", ops.exp, np.exp, [_f32(2, 3)], {}, True),
+    ("expm1", ops.expm1, np.expm1, [_f32(2, 3)], {}, True),
+    ("floor", ops.floor, np.floor, [_f32(2, 3)], {}, False),
+    ("frac", ops.frac, lambda x: x - np.trunc(x), [_f32(2, 3) * 3], {}, True),
+    ("i0", ops.i0, sps.i0, [_pos(2, 3)], {}, True),
+    ("i0e", ops.i0e, sps.i0e, [_pos(2, 3)], {}, False),
+    ("i1", ops.i1, sps.i1, [_pos(2, 3)], {}, False),
+    ("i1e", ops.i1e, sps.i1e, [_pos(2, 3)], {}, False),
+    ("lgamma", ops.lgamma, sps.gammaln, [_pos(2, 3) + 1], {}, True),
+    ("log", ops.log, np.log, [_pos(2, 3)], {}, True),
+    ("log10", ops.log10, np.log10, [_pos(2, 3)], {}, True),
+    ("log1p", ops.log1p, np.log1p, [_pos(2, 3)], {}, True),
+    ("log2", ops.log2, np.log2, [_pos(2, 3)], {}, True),
+    ("logit", ops.logit, sps.logit, [(rng.rand(2, 3) * 0.8 + 0.1).astype(np.float32)], {}, True),
+    ("neg", ops.neg, np.negative, [_f32(2, 3)], {}, True),
+    ("rad2deg", ops.rad2deg, np.rad2deg, [_f32(2, 3)], {}, True),
+    ("reciprocal", ops.reciprocal, np.reciprocal, [_pos(2, 3)], {}, True),
+    ("round", ops.round, np.round, [_f32(2, 3) * 3], {}, False),
+    ("rsqrt", ops.rsqrt, lambda x: 1 / np.sqrt(x), [_pos(2, 3)], {}, True),
+    ("sigmoid", ops.sigmoid, sps.expit, [_f32(2, 3)], {}, True),
+    ("sign", ops.sign, np.sign, [_f32(2, 3)], {}, False),
+    ("sin", ops.sin, np.sin, [_f32(2, 3)], {}, True),
+    ("sinh", ops.sinh, np.sinh, [_f32(2, 3)], {}, True),
+    ("sqrt", ops.sqrt, np.sqrt, [_pos(2, 3)], {}, True),
+    ("square", ops.square, np.square, [_f32(2, 3)], {}, True),
+    ("tan", ops.tan, np.tan, [_unit(2, 3)], {}, True),
+    ("tanh", ops.tanh, np.tanh, [_f32(2, 3)], {}, True),
+    ("trunc", ops.trunc, np.trunc, [_f32(2, 3) * 3], {}, False),
+]
+
+BINARY = [
+    ("add", ops.add, np.add, [_f32(2, 3), _f32(2, 3)], {}, True),
+    ("atan2", ops.atan2, np.arctan2, [_f32(2, 3), _pos(2, 3)], {}, True),
+    ("copysign", ops.copysign, np.copysign, [_f32(2, 3), _f32(2, 3)], {}, False),
+    ("divide", ops.divide, np.divide, [_f32(2, 3), _pos(2, 3)], {}, True),
+    ("floor_divide", ops.floor_divide, np.floor_divide, [_pos(2, 3) * 5, _pos(2, 3)], {}, False),
+    ("fmax", ops.fmax, np.fmax, [_f32(2, 3), _f32(2, 3)], {}, False),
+    ("fmin", ops.fmin, np.fmin, [_f32(2, 3), _f32(2, 3)], {}, False),
+    ("heaviside", ops.heaviside, np.heaviside, [_f32(2, 3), _pos(2, 3)], {}, False),
+    ("hypot", ops.hypot, np.hypot, [_f32(2, 3), _f32(2, 3)], {}, True),
+    ("logaddexp", ops.logaddexp, np.logaddexp, [_f32(2, 3), _f32(2, 3)], {}, True),
+    ("maximum", ops.maximum, np.maximum, [_f32(2, 3), _f32(2, 3)], {}, True),
+    ("minimum", ops.minimum, np.minimum, [_f32(2, 3), _f32(2, 3)], {}, True),
+    ("mod", ops.mod, np.mod, [_pos(2, 3) * 5, _pos(2, 3)], {}, False),
+    ("multiply", ops.multiply, np.multiply, [_f32(2, 3), _f32(2, 3)], {}, True),
+    ("nextafter", ops.nextafter, np.nextafter, [_f32(2, 3), _f32(2, 3)], {}, False),
+    ("pow", ops.pow, np.power, [_pos(2, 3), _f32(2, 3)], {}, True),
+    ("subtract", ops.subtract, np.subtract, [_f32(2, 3), _f32(2, 3)], {}, True),
+    ("lerp", ops.lerp, lambda x, y, w: x + w * (y - x),
+     [_f32(2, 3), _f32(2, 3), _pos(2, 3)], {}, True),
+    ("ldexp", ops.ldexp, np.ldexp, [_f32(2, 3), _i64(-3, 3, (2, 3))], {}, False),
+    ("gcd", ops.gcd, np.gcd, [_i64(1, 50, (2, 3)), _i64(1, 50, (2, 3))], {}, False),
+    ("lcm", ops.lcm, np.lcm, [_i64(1, 12, (2, 3)), _i64(1, 12, (2, 3))], {}, False),
+]
+
+REDUCE = [
+    ("sum", ops.sum, np.sum, [_f32(3, 4)], {}, True),
+    ("sum_axis", lambda x: ops.sum(x, axis=1), lambda x: np.sum(x, axis=1), [_f32(3, 4)], {}, True),
+    ("mean", ops.mean, np.mean, [_f32(3, 4)], {}, True),
+    ("prod", ops.prod, np.prod, [_pos(2, 3)], {}, True),
+    ("max", ops.max, np.max, [_f32(3, 4)], {}, False),
+    ("min", ops.min, np.min, [_f32(3, 4)], {}, False),
+    ("amax", ops.amax, np.amax, [_f32(3, 4)], {}, False),
+    ("amin", ops.amin, np.amin, [_f32(3, 4)], {}, False),
+    ("std", lambda x: ops.std(x, unbiased=False),
+     lambda x: np.std(x), [_f32(3, 4)], {}, True),
+    ("var", lambda x: ops.var(x, unbiased=False),
+     lambda x: np.var(x), [_f32(3, 4)], {}, True),
+    ("logsumexp", ops.logsumexp, lambda x: sps.logsumexp(x), [_f32(3, 4)], {}, True),
+    ("median", ops.median, np.median, [_f32(3, 5)], {}, False),
+    ("nanmean", ops.nanmean, np.nanmean, [_f32(3, 4)], {}, False),
+    ("nansum", ops.nansum, np.nansum, [_f32(3, 4)], {}, False),
+    ("count_nonzero", ops.count_nonzero, np.count_nonzero, [_f32(3, 4)], {}, False),
+    ("cumsum", ops.cumsum, lambda x: np.cumsum(x), [_f32(3, 4)], {}, True),
+    ("cumprod", lambda x: ops.cumprod(x, dim=1),
+     lambda x: np.cumprod(x, axis=1), [_pos(3, 4)], {}, True),
+    ("cummax", lambda x: ops.cummax(x, axis=1)[0],
+     lambda x: np.maximum.accumulate(x, axis=1), [_f32(3, 4)], {}, False),
+    ("cummin", lambda x: ops.cummin(x, axis=1)[0],
+     lambda x: np.minimum.accumulate(x, axis=1), [_f32(3, 4)], {}, False),
+    ("logcumsumexp", lambda x: ops.logcumsumexp(x, axis=1),
+     lambda x: np.log(np.cumsum(np.exp(x), axis=1)), [_f32(3, 4)], {}, True),
+    ("trace", ops.trace, np.trace, [_f32(4, 4)], {}, True),
+    ("norm_fro", lambda x: ops.norm(x), lambda x: np.linalg.norm(x), [_f32(3, 4)], {}, True),
+    ("dist", ops.dist, lambda x, y: np.linalg.norm((x - y).ravel()),
+     [_f32(3, 4), _f32(3, 4)], {}, True),
+]
+
+LINALG = [
+    ("matmul", ops.matmul, np.matmul, [_f32(3, 4), _f32(4, 5)], {}, True),
+    ("matmul_tx", lambda a, b: ops.matmul(a, b, transpose_x=True),
+     lambda a, b: a.T @ b, [_f32(4, 3), _f32(4, 5)], {}, True),
+    ("bmm", ops.bmm, np.matmul, [_f32(2, 3, 4), _f32(2, 4, 5)], {}, True),
+    ("mm", ops.mm, np.matmul, [_f32(3, 4), _f32(4, 5)], {}, True),
+    ("mv", ops.mv, np.matmul, [_f32(3, 4), _f32(4)], {}, True),
+    ("dot", ops.dot, np.dot, [_f32(5), _f32(5)], {}, True),
+    ("inner", ops.inner, np.inner, [_f32(3, 4), _f32(5, 4)], {}, True),
+    ("outer", ops.outer, np.outer, [_f32(3), _f32(4)], {}, True),
+    ("kron", ops.kron, np.kron, [_f32(2, 2), _f32(3, 3)], {}, True),
+    ("cross", ops.cross, lambda a, b: np.cross(a, b), [_f32(4, 3), _f32(4, 3)], {}, True),
+    ("einsum_ij", lambda a, b: ops.einsum("ij,jk->ik", a, b),
+     lambda a, b: a @ b, [_f32(3, 4), _f32(4, 5)], {}, True),
+    ("det", ops.det, np.linalg.det, [_f32(3, 3) + 3 * np.eye(3, dtype=np.float32)], {}, True),
+    ("slogdet", lambda x: ops.slogdet(x)[1],
+     lambda x: np.linalg.slogdet(x)[1],
+     [_f32(3, 3) + 3 * np.eye(3, dtype=np.float32)], {}, True),
+    ("inverse", ops.inverse, np.linalg.inv,
+     [_f32(3, 3) + 3 * np.eye(3, dtype=np.float32)], {}, True),
+    ("matrix_power", lambda x: ops.matrix_power(x, 3),
+     lambda x: np.linalg.matrix_power(x, 3), [_f32(3, 3) * 0.5], {}, True),
+    ("cholesky", ops.cholesky,
+     np.linalg.cholesky, [np.eye(3, dtype=np.float32) * 2], {}, False),
+    ("solve", ops.solve, np.linalg.solve,
+     [_f32(3, 3) + 3 * np.eye(3, dtype=np.float32), _f32(3, 2)], {}, True),
+    ("matrix_transpose", ops.matrix_transpose, lambda x: np.swapaxes(x, -1, -2),
+     [_f32(2, 3, 4)], {}, True),
+    ("multi_dot", lambda a, b, c: ops.multi_dot([a, b, c]),
+     lambda a, b, c: a @ b @ c, [_f32(2, 3), _f32(3, 4), _f32(4, 2)], {}, True),
+    ("addmm", ops.addmm, lambda i, a, b: i + a @ b,
+     [_f32(3, 5), _f32(3, 4), _f32(4, 5)], {}, True),
+]
+
+MANIP = [
+    ("reshape", lambda x: ops.reshape(x, [4, 3]), lambda x: x.reshape(4, 3), [_f32(3, 4)], {}, True),
+    ("transpose", lambda x: ops.transpose(x, [1, 0]), lambda x: x.T, [_f32(3, 4)], {}, True),
+    ("squeeze", lambda x: ops.squeeze(x, 1), lambda x: x.squeeze(1), [_f32(3, 1, 4)], {}, True),
+    ("unsqueeze", lambda x: ops.unsqueeze(x, 1), lambda x: x[:, None], [_f32(3, 4)], {}, True),
+    ("flatten", ops.flatten, lambda x: x.reshape(-1), [_f32(3, 4)], {}, True),
+    ("flip", lambda x: ops.flip(x, axis=1), lambda x: np.flip(x, 1), [_f32(3, 4)], {}, True),
+    ("roll", lambda x: ops.roll(x, 2, axis=1), lambda x: np.roll(x, 2, 1), [_f32(3, 4)], {}, True),
+    ("rot90", ops.rot90, np.rot90, [_f32(3, 4)], {}, False),
+    ("tile", lambda x: ops.tile(x, [2, 3]), lambda x: np.tile(x, (2, 3)), [_f32(2, 3)], {}, True),
+    ("broadcast_to", lambda x: ops.broadcast_to(x, [3, 4]),
+     lambda x: np.broadcast_to(x, (3, 4)), [_f32(1, 4)], {}, True),
+    ("concat", lambda a, b: ops.concat([a, b], axis=1),
+     lambda a, b: np.concatenate([a, b], 1), [_f32(2, 3), _f32(2, 4)], {}, True),
+    ("stack", lambda a, b: ops.stack([a, b]), lambda a, b: np.stack([a, b]),
+     [_f32(2, 3), _f32(2, 3)], {}, True),
+    ("split", lambda x: ops.split(x, 2, axis=1)[0],
+     lambda x: np.split(x, 2, 1)[0], [_f32(2, 4)], {}, True),
+    ("chunk", lambda x: ops.chunk(x, 2, axis=1)[1],
+     lambda x: np.split(x, 2, 1)[1], [_f32(2, 4)], {}, True),
+    ("tril", ops.tril, np.tril, [_f32(4, 4)], {}, True),
+    ("triu", ops.triu, np.triu, [_f32(4, 4)], {}, True),
+    ("diag", ops.diag, np.diag, [_f32(4)], {}, True),
+    ("diagonal", ops.diagonal, lambda x: np.diagonal(x, 0, 0, 1), [_f32(3, 3)], {}, True),
+    ("moveaxis", lambda x: ops.moveaxis(x, 0, 1), lambda x: np.moveaxis(x, 0, 1), [_f32(3, 4)], {}, True),
+    ("swapaxes", lambda x: ops.swapaxes(x, 0, 1), lambda x: np.swapaxes(x, 0, 1), [_f32(3, 4)], {}, True),
+    ("repeat_interleave", lambda x: ops.repeat_interleave(x, 2, axis=0),
+     lambda x: np.repeat(x, 2, 0), [_f32(2, 3)], {}, True),
+    ("gather", lambda x, i: ops.gather(x, i), lambda x, i: x[i],
+     [_f32(5, 3), _i64(0, 5, (4,))], {}, False),
+    ("index_select", lambda x, i: ops.index_select(x, i, axis=0),
+     lambda x, i: x[i], [_f32(5, 3), _i64(0, 5, (3,))], {}, False),
+    ("take_along_axis", lambda x, i: ops.take_along_axis(x, i, axis=1),
+     lambda x, i: np.take_along_axis(x, i, 1),
+     [_f32(3, 5), _i64(0, 5, (3, 2))], {}, False),
+    ("masked_fill", lambda x: ops.masked_fill(x, pt.to_tensor(np.asarray([[True, False, True]])), 0.5),
+     lambda x: np.where(np.asarray([[True, False, True]]), 0.5, x), [_f32(2, 3)], {}, False),
+    ("where", lambda c, x, y: ops.where(c, x, y), np.where,
+     [rng.rand(2, 3) > 0.5, _f32(2, 3), _f32(2, 3)], {}, False),
+    ("unbind", lambda x: ops.unbind(x, axis=0)[0], lambda x: x[0], [_f32(3, 4)], {}, True),
+    ("unstack", lambda x: ops.unstack(x, axis=0)[1], lambda x: x[1], [_f32(3, 4)], {}, True),
+    ("expand", lambda x: ops.expand(x, [3, 4]), lambda x: np.broadcast_to(x, (3, 4)), [_f32(1, 4)], {}, True),
+    ("crop", lambda x: ops.crop(x, shape=[2, 2], offsets=[1, 1]),
+     lambda x: x[1:3, 1:3], [_f32(4, 4)], {}, True),
+    ("clip", lambda x: ops.clip(x, -0.5, 0.5), lambda x: np.clip(x, -0.5, 0.5), [_f32(3, 4)], {}, True),
+    ("flatten2", lambda x: ops.flatten(x, start_axis=1, stop_axis=2),
+     lambda x: x.reshape(2, 12), [_f32(2, 3, 4)], {}, True),
+]
+
+SEARCH_LOGIC = [
+    ("argmax", lambda x: ops.argmax(x, axis=1), lambda x: np.argmax(x, 1), [_f32(3, 4)], {}, False),
+    ("argmin", lambda x: ops.argmin(x, axis=1), lambda x: np.argmin(x, 1), [_f32(3, 4)], {}, False),
+    ("argsort", lambda x: ops.argsort(x, axis=1), lambda x: np.argsort(x, 1, kind="stable"), [_f32(3, 4)], {}, False),
+    ("sort", lambda x: ops.sort(x, axis=1), lambda x: np.sort(x, 1), [_f32(3, 4)], {}, True),
+    ("topk_vals", lambda x: ops.topk(x, 2, axis=1)[0],
+     lambda x: -np.sort(-x, 1)[:, :2], [_f32(3, 5)], {}, False),
+    ("kthvalue", lambda x: ops.kthvalue(x, 2, axis=1)[0],
+     lambda x: np.sort(x, 1)[:, 1], [_f32(3, 5)], {}, False),
+    ("searchsorted", lambda s, v: ops.searchsorted(s, v),
+     lambda s, v: np.searchsorted(s, v).astype(np.int64),
+     [np.sort(_f32(8)), _f32(4)], {}, False),
+    ("bucketize", lambda x, s: ops.bucketize(x, s),
+     lambda x, s: np.digitize(x, s, right=False).astype(np.int64),
+     [_f32(4), np.sort(_f32(5))], {}, False),
+    ("nonzero", lambda x: ops.nonzero(x),
+     lambda x: np.stack(np.nonzero(x), 1).astype(np.int64),
+     [(rng.rand(3, 3) > 0.5).astype(np.float32)], {}, False),
+    ("equal", ops.equal, np.equal, [_i64(0, 3, (2, 3)), _i64(0, 3, (2, 3))], {}, False),
+    ("not_equal", ops.not_equal, np.not_equal, [_i64(0, 3, (2, 3)), _i64(0, 3, (2, 3))], {}, False),
+    ("greater_than", ops.greater_than, np.greater, [_f32(2, 3), _f32(2, 3)], {}, False),
+    ("less_equal", ops.less_equal, np.less_equal, [_f32(2, 3), _f32(2, 3)], {}, False),
+    ("logical_and", ops.logical_and, np.logical_and,
+     [rng.rand(2, 3) > 0.5, rng.rand(2, 3) > 0.5], {}, False),
+    ("logical_not", ops.logical_not, np.logical_not, [rng.rand(2, 3) > 0.5], {}, False),
+    ("logical_xor", ops.logical_xor, np.logical_xor,
+     [rng.rand(2, 3) > 0.5, rng.rand(2, 3) > 0.5], {}, False),
+    ("bitwise_and", ops.bitwise_and, np.bitwise_and,
+     [_i64(0, 8, (2, 3)), _i64(0, 8, (2, 3))], {}, False),
+    ("bitwise_xor", ops.bitwise_xor, np.bitwise_xor,
+     [_i64(0, 8, (2, 3)), _i64(0, 8, (2, 3))], {}, False),
+    ("isfinite", ops.isfinite, np.isfinite, [_f32(2, 3)], {}, False),
+    ("isnan", ops.isnan, np.isnan, [_f32(2, 3)], {}, False),
+    ("allclose", lambda a, b: ops.allclose(a, b), np.allclose,
+     [_f32(2, 3), _f32(2, 3)], {}, False),
+    ("isclose", ops.isclose, np.isclose, [_f32(2, 3), _f32(2, 3)], {}, False),
+]
+
+ALL_CASES = UNARY + BINARY + REDUCE + LINALG + MANIP + SEARCH_LOGIC
+_IDS = [c[0] for c in ALL_CASES]
+assert len(ALL_CASES) >= 100, len(ALL_CASES)
+assert len(set(_IDS)) == len(_IDS), "duplicate case ids"
+
+
+# data-dependent output shapes cannot compile (XLA static shapes); these
+# run eager-only, like the reference's dygraph-only op tests
+EAGER_ONLY = {"nonzero"}
+
+
+@pytest.mark.parametrize("case", ALL_CASES, ids=_IDS)
+def test_op_output(case):
+    name, op_fn, np_fn, inputs, kwargs, _ = case
+    modes = ("eager",) if name in EAGER_ONLY else ("eager", "static")
+    check_output(op_fn, np_fn, inputs, rtol=2e-4, atol=2e-5, modes=modes,
+                 **kwargs)
+
+
+GRAD_CASES = [c for c in ALL_CASES if c[5]]
+
+
+@pytest.mark.parametrize("case", GRAD_CASES, ids=[c[0] for c in GRAD_CASES])
+def test_op_grad(case):
+    name, op_fn, np_fn, inputs, kwargs, _ = case
+    check_grad(op_fn, inputs, rtol=5e-3, atol=5e-4, **kwargs)

@@ -1,0 +1,123 @@
+"""Sparse API + quantization families (reference: python/paddle/sparse/,
+python/paddle/quantization/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import sparse as psp
+from paddle_tpu.quantization import (
+    AbsmaxObserver, FakeQuanterWithAbsMaxObserver, PTQ, QAT, QuantConfig,
+)
+
+
+class TestSparse:
+    def _coo(self):
+        idx = np.array([[0, 0, 1, 2], [0, 2, 1, 0]])
+        vals = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        return psp.sparse_coo_tensor(idx, vals, shape=[3, 3])
+
+    def test_coo_roundtrip(self):
+        s = self._coo()
+        d = s.to_dense().numpy()
+        ref = np.zeros((3, 3), np.float32)
+        ref[0, 0], ref[0, 2], ref[1, 1], ref[2, 0] = 1, 2, 3, 4
+        np.testing.assert_allclose(d, ref)
+        assert s.nnz == 4
+        assert s.indices().shape == [2, 4]
+
+    def test_csr_roundtrip(self):
+        s = psp.sparse_csr_tensor([0, 2, 3, 4], [0, 2, 1, 0],
+                                  [1.0, 2.0, 3.0, 4.0], [3, 3])
+        d = s.to_dense().numpy()
+        assert d[0, 0] == 1 and d[0, 2] == 2 and d[1, 1] == 3 and d[2, 0] == 4
+        coo = s.to_sparse_coo()
+        np.testing.assert_allclose(coo.to_dense().numpy(), d)
+
+    def test_matmul_dense(self):
+        s = self._coo()
+        y = pt.to_tensor(np.random.RandomState(0).randn(3, 2).astype(np.float32))
+        out = psp.matmul(s, y)
+        np.testing.assert_allclose(out.numpy(), s.to_dense().numpy() @ y.numpy(),
+                                   rtol=1e-6)
+
+    def test_matmul_grad(self):
+        s = self._coo()
+        y = pt.to_tensor(np.ones((3, 2), np.float32), stop_gradient=False)
+        out = pt.ops.sum(psp.matmul(s, y))
+        out.backward()
+        np.testing.assert_allclose(y.grad.numpy(),
+                                   s.to_dense().numpy().T @ np.ones((3, 2)),
+                                   rtol=1e-6)
+
+    def test_unary_preserves_pattern(self):
+        s = self._coo()
+        out = psp.square(s)
+        assert out.nnz == 4
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   s.to_dense().numpy() ** 2)
+
+    def test_sparse_relu_softmax(self):
+        idx = np.array([[0, 0, 1], [0, 1, 1]])
+        s = psp.sparse_coo_tensor(idx, np.array([-1.0, 2.0, 3.0], np.float32),
+                                  shape=[2, 2])
+        r = psp.nn.functional.relu(s)
+        assert float(r.values().numpy()[0]) == 0.0
+        sm = psp.nn.functional.softmax(s)
+        vals = sm.to_dense().numpy()
+        np.testing.assert_allclose(vals[0, 0] + vals[0, 1], 1.0, rtol=1e-6)
+
+    def test_masked_matmul(self):
+        rngl = np.random.RandomState(1)
+        a = pt.to_tensor(rngl.randn(3, 4).astype(np.float32))
+        b = pt.to_tensor(rngl.randn(4, 3).astype(np.float32))
+        mask = self._coo()
+        out = psp.masked_matmul(a, b, mask)
+        dense = a.numpy() @ b.numpy()
+        got = out.to_dense().numpy()
+        assert got[0, 1] == 0  # not in pattern
+        np.testing.assert_allclose(got[0, 0], dense[0, 0], rtol=1e-5)
+        np.testing.assert_allclose(got[2, 0], dense[2, 0], rtol=1e-5)
+
+
+class TestQuantization:
+    def _model(self):
+        pt.seed(9)
+        return pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                                pt.nn.Linear(16, 4))
+
+    def test_qat_quantize_and_train(self):
+        q_config = QuantConfig(activation=None, weight=None)
+        q_config.add_type_config(
+            pt.nn.Linear,
+            activation=FakeQuanterWithAbsMaxObserver(quant_bits=8),
+            weight=FakeQuanterWithAbsMaxObserver(quant_bits=8),
+        )
+        qat = QAT(q_config)
+        model = qat.quantize(self._model())
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+        x = pt.to_tensor(np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        losses = []
+        for _ in range(5):
+            loss = pt.ops.mean(model(x) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]  # straight-through grads train
+
+    def test_ptq_calibrate_convert(self):
+        q_config = QuantConfig(activation=None, weight=None)
+        q_config.add_type_config(pt.nn.Linear,
+                                 activation=AbsmaxObserver(quant_bits=8),
+                                 weight=AbsmaxObserver(quant_bits=8))
+        ptq = PTQ(q_config)
+        base = self._model()
+        observed = ptq.quantize(base)
+        x = pt.to_tensor(np.random.RandomState(1).randn(16, 8).astype(np.float32))
+        ref = observed(x).numpy()  # calibration pass (identity math)
+        converted = ptq.convert(observed)
+        out = converted(x).numpy()
+        # int8 QDQ should stay close to the fp32 reference
+        np.testing.assert_allclose(out, ref, rtol=0.2, atol=0.2)
+        assert not np.allclose(out, ref)  # but actually quantized

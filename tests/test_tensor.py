@@ -174,3 +174,42 @@ class TestDataLoaderWorkers:
         vals = np.concatenate([b.numpy().reshape(-1) for b in dl])
         assert set(vals.tolist()) <= {0.0, 1.0}
         assert len(vals) == 4
+
+
+class TestNanInfChecking:
+    """FLAGS_check_nan_inf (reference eager/nan_inf_utils.cc): strict mode
+    aborts per op; deferred mode accumulates device-side and reports on a
+    single sync (no per-op host round trips)."""
+
+    def test_strict_mode_raises(self):
+        import numpy as np
+        import pytest
+        import paddle_tpu as pt
+
+        pt.set_flags({"FLAGS_check_nan_inf": True,
+                      "FLAGS_check_nan_inf_level": 0})
+        try:
+            x = pt.to_tensor(np.array([1.0, 0.0], np.float32))
+            with pytest.raises(FloatingPointError):
+                _ = pt.ops.log(x * 0.0 - 1.0)  # log(-1) = nan
+        finally:
+            pt.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_deferred_mode_reports_once(self):
+        import numpy as np
+        import paddle_tpu as pt
+        from paddle_tpu.amp.debugging import finite_check_report
+
+        pt.set_flags({"FLAGS_check_nan_inf": True,
+                      "FLAGS_check_nan_inf_level": 1})
+        try:
+            x = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+            _ = pt.ops.log(x)       # fine
+            assert finite_check_report() is True
+            _ = pt.ops.log(-x)      # nan, but NO exception mid-loop
+            _ = pt.ops.sqrt(x)
+            assert finite_check_report() is False
+            # state reset after report
+            assert finite_check_report() is True
+        finally:
+            pt.set_flags({"FLAGS_check_nan_inf": False})
