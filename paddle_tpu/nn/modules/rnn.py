@@ -1,0 +1,478 @@
+"""Recurrent layers: SimpleRNN/LSTM/GRU cells + RNN/BiRNN wrappers.
+
+Reference API: python/paddle/nn/layer/rnn.py (RNNCellBase:112, SimpleRNNCell
+:251, LSTMCell:394, GRUCell:557, RNN:700, BiRNN:797, SimpleRNN:1035,
+LSTM:1157, GRU:1291).  The reference runs a per-timestep python loop in
+dygraph and a `_rnn_static_graph` while_loop in static mode; on TPU the whole
+time dimension is one ``lax.scan`` dispatched as a single op, so eager
+autograd captures ONE VJP for the layer and ``jit.to_static`` compiles the
+recurrence into a single fused XLA while loop (no per-step dispatch).
+
+Weight layout matches the reference cells: ``weight_ih [G*H, I]``,
+``weight_hh [G*H, H]``, biases ``[G*H]`` with gate order i,f,g,o (LSTM —
+reference rnn.py:490 chunks) and r,z,c (GRU — reference rnn.py:648).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ... import ops
+from ...ops import dispatch
+from ...tensor import Tensor
+from .. import functional as F
+from .. import initializer as I
+from ..layer import Layer
+
+__all__ = [
+    "RNNCellBase",
+    "SimpleRNNCell",
+    "LSTMCell",
+    "GRUCell",
+    "RNN",
+    "BiRNN",
+    "SimpleRNN",
+    "LSTM",
+    "GRU",
+]
+
+
+def _ensure_tuple(states):
+    return states if isinstance(states, (tuple, list)) else (states,)
+
+
+class RNNCellBase(Layer):
+    """Base: get_initial_states builds zero states shaped by state_shape
+    (reference rnn.py:112)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape if shape is not None else self.state_shape
+        # state_shape may be one shape or a tuple of shapes (LSTM: (h, c))
+        if isinstance(shape[0], (tuple, list)):
+            return tuple(
+                ops.full([batch] + list(s), init_value,
+                         dtype=dtype or "float32")
+                for s in shape
+            )
+        return ops.full([batch] + list(shape), init_value, dtype=dtype or "float32")
+
+
+def _uniform_init(hidden_size):
+    std = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-std, std)
+
+
+class _GateCell(RNNCellBase):
+    """Shared parameter scaffold for the three cells."""
+
+    def __init__(self, input_size, hidden_size, n_gates,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        if hidden_size <= 0:
+            raise ValueError("hidden_size must be positive, got "
+                             f"{hidden_size}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        init = _uniform_init(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [n_gates * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [n_gates * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        if bias_ih_attr is not False:
+            self.bias_ih = self.create_parameter(
+                [n_gates * hidden_size], attr=bias_ih_attr, is_bias=True,
+                default_initializer=init)
+        else:
+            self.bias_ih = None
+        if bias_hh_attr is not False:
+            self.bias_hh = self.create_parameter(
+                [n_gates * hidden_size], attr=bias_hh_attr, is_bias=True,
+                default_initializer=init)
+        else:
+            self.bias_hh = None
+
+    def _cell_params(self):
+        ps = [self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            ps.append(self.bias_ih)
+        if self.bias_hh is not None:
+            ps.append(self.bias_hh)
+        return ps
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+def _gates(x, h, w_ih, w_hh, b_ih, b_hh):
+    g = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        g = g + b_ih
+    if b_hh is not None:
+        g = g + b_hh
+    return g
+
+
+def _simple_step(act):
+    def step(x, state, w_ih, w_hh, b_ih, b_hh):
+        h = act(_gates(x, state[0], w_ih, w_hh, b_ih, b_hh))
+        return h, (h,)
+    return step
+
+
+def _lstm_step(x, state, w_ih, w_hh, b_ih, b_hh):
+    h, c = state
+    g = _gates(x, h, w_ih, w_hh, b_ih, b_hh)
+    i, f, gg, o = jnp.split(g, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    c_new = f * c + i * jnp.tanh(gg)
+    h_new = o * jnp.tanh(c_new)
+    return h_new, (h_new, c_new)
+
+
+def _gru_step(x, state, w_ih, w_hh, b_ih, b_hh):
+    h = state[0]
+    gx = x @ w_ih.T
+    gh = h @ w_hh.T
+    if b_ih is not None:
+        gx = gx + b_ih
+    if b_hh is not None:
+        gh = gh + b_hh
+    rx, zx, cx = jnp.split(gx, 3, axis=-1)
+    rh, zh, ch = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(rx + rh)
+    z = jax.nn.sigmoid(zx + zh)
+    c = jnp.tanh(cx + r * ch)
+    h_new = z * h + (1.0 - z) * c
+    return h_new, (h_new,)
+
+
+class SimpleRNNCell(_GateCell):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh) (reference rnn.py:251)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, 1,
+                         weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)
+        if activation not in ("tanh", "relu"):
+            raise ValueError("SimpleRNNCell activation must be tanh or relu")
+        self.activation = activation
+        self._act = jnp.tanh if activation == "tanh" else jax.nn.relu
+        self._step = _simple_step(self._act)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        states = _ensure_tuple(states)
+        raws = [inputs] + list(states) + self._cell_params()
+
+        def fn(x, *rest):
+            n_state = len(states)
+            st = rest[:n_state]
+            w = list(rest[n_state:])
+            while len(w) < 4:
+                w.append(None)
+            out, new = self._step(x, st, *w[:4])
+            return (out,) + tuple(new)
+
+        outs = dispatch.apply(fn, *raws, op_name="rnn_cell")
+        return outs[0], outs[1] if len(outs) == 2 else tuple(outs[1:])
+
+
+class LSTMCell(_GateCell):
+    """Gate order i,f,g,o (reference rnn.py:394,490)."""
+
+    def __init__(self, input_size, hidden_size,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, 4,
+                         weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)
+        self._step = _lstm_step
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        raws = [inputs, h, c] + self._cell_params()
+
+        def fn(x, h, c, *w):
+            w = list(w)
+            while len(w) < 4:
+                w.append(None)
+            out, (h2, c2) = _lstm_step(x, (h, c), *w[:4])
+            return out, h2, c2
+
+        out, h2, c2 = dispatch.apply(fn, *raws, op_name="lstm_cell")
+        return out, (h2, c2)
+
+
+class GRUCell(_GateCell):
+    """Gate order r,z,c; h' = z*h + (1-z)*c (reference rnn.py:557,648)."""
+
+    def __init__(self, input_size, hidden_size,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, 3,
+                         weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)
+        self._step = _gru_step
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        states = _ensure_tuple(states)
+        raws = [inputs, states[0]] + self._cell_params()
+
+        def fn(x, h, *w):
+            w = list(w)
+            while len(w) < 4:
+                w.append(None)
+            out, (h2,) = _gru_step(x, (h,), *w[:4])
+            return out, h2
+
+        out, h2 = dispatch.apply(fn, *raws, op_name="gru_cell")
+        return out, h2
+
+
+def _scan_layer(step, n_state, inputs, init_states, params, *,
+                is_reverse=False, sequence_length=None, time_major=False):
+    """Run one recurrent layer over the whole sequence as a single dispatched
+    op built on ``lax.scan`` (TPU-idiomatic replacement for the reference's
+    per-timestep python loop, rnn.py:700 RNN.forward).
+
+    inputs: Tensor [B, T, I] (or [T, B, I] when time_major).
+    init_states: tuple of Tensors [B, H].
+    params: list of weight Tensors (w_ih, w_hh, [b_ih, b_hh]).
+    sequence_length: optional int Tensor [B]; steps past the end keep the
+    previous state and emit zeros (reference masking semantics).
+    Returns (outputs, final_states tuple).
+    """
+    raws = [inputs] + list(init_states) + list(params)
+    if sequence_length is not None:
+        raws.append(sequence_length)
+
+    def fn(x, *rest):
+        if sequence_length is not None:
+            seq_len = rest[-1]
+            rest = rest[:-1]
+        else:
+            seq_len = None
+        st = tuple(rest[:n_state])
+        w = list(rest[n_state:])
+        while len(w) < 4:
+            w.append(None)
+        w = w[:4]
+
+        xs = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, I]
+        T = xs.shape[0]
+        if is_reverse:
+            xs = jnp.flip(xs, axis=0)
+
+        def body(carry, xt):
+            st, t = carry
+            out, new = step(xt, st, *w)
+            if seq_len is not None:
+                # position in the ORIGINAL sequence
+                pos = (T - 1 - t) if is_reverse else t
+                valid = (pos < seq_len)[:, None]
+                new = tuple(jnp.where(valid, n, s) for n, s in zip(new, st))
+                out = jnp.where(valid, out, jnp.zeros_like(out))
+            return (new, t + 1), out
+
+        (final, _), outs = lax.scan(body, (st, jnp.int32(0)), xs)
+        if is_reverse:
+            outs = jnp.flip(outs, axis=0)
+        if not time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return (outs,) + tuple(final)
+
+    res = dispatch.apply(fn, *raws, op_name="rnn_scan")
+    return res[0], tuple(res[1:])
+
+
+class RNN(Layer):
+    """Wrap a cell to scan over the time axis (reference rnn.py:700)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        if initial_states is None:
+            batch_idx = 1 if self.time_major else 0
+            initial_states = self.cell.get_initial_states(
+                inputs, batch_dim_idx=batch_idx)
+        states = _ensure_tuple(initial_states)
+        outs, final = _scan_layer(
+            self.cell._step, len(states), inputs, states,
+            self.cell._cell_params(),
+            is_reverse=self.is_reverse,
+            sequence_length=sequence_length,
+            time_major=self.time_major,
+        )
+        if len(final) == 1:
+            return outs, final[0]
+        return outs, final
+
+
+class BiRNN(Layer):
+    """Forward + backward cells; outputs concatenated (reference rnn.py:797)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+        self._fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self._bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        if initial_states is None:
+            st_fw = st_bw = None
+        else:
+            st_fw, st_bw = initial_states
+        out_fw, fin_fw = self._fw(inputs, st_fw, sequence_length)
+        out_bw, fin_bw = self._bw(inputs, st_bw, sequence_length)
+        outputs = ops.concat([out_fw, out_bw], axis=-1)
+        return outputs, (fin_fw, fin_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) recurrence
+    (reference rnn.py:914 RNNBase)."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction in ("forward",):
+            self.num_directions = 1
+        elif direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        else:
+            raise ValueError(f"unknown direction '{direction}'")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.direction = direction
+        attrs = dict(weight_ih_attr=weight_ih_attr,
+                     weight_hh_attr=weight_hh_attr,
+                     bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+
+        def make_cell(in_size):
+            if mode == "LSTM":
+                return LSTMCell(in_size, hidden_size, **attrs)
+            if mode == "GRU":
+                return GRUCell(in_size, hidden_size, **attrs)
+            return SimpleRNNCell(in_size, hidden_size, activation=activation,
+                                 **attrs)
+
+        self._cells = []
+        for layer in range(num_layers):
+            in_size = input_size if layer == 0 \
+                else hidden_size * self.num_directions
+            for d in range(self.num_directions):
+                cell = make_cell(in_size)
+                name = f"cell_{layer}" if self.num_directions == 1 \
+                    else f"cell_{layer}_{'fw' if d == 0 else 'bw'}"
+                self.add_sublayer(name, cell)
+                self._cells.append(cell)
+        self.state_components = 2 if mode == "LSTM" else 1
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        batch_idx = 1 if self.time_major else 0
+        batch = inputs.shape[batch_idx]
+        n_total = self.num_layers * self.num_directions
+        if initial_states is None:
+            zero = lambda: ops.zeros([n_total, batch, self.hidden_size],
+                                     dtype="float32")
+            if self.state_components == 2:
+                initial_states = (zero(), zero())
+            else:
+                initial_states = zero()
+        states = _ensure_tuple(initial_states)
+
+        finals = [[] for _ in range(self.state_components)]
+        x = inputs
+        for layer in range(self.num_layers):
+            outs_dir = []
+            for d in range(self.num_directions):
+                idx = layer * self.num_directions + d
+                cell = self._cells[idx]
+                init = tuple(s[idx] for s in states)
+                outs, fin = _scan_layer(
+                    cell._step, self.state_components, x, init,
+                    cell._cell_params(),
+                    is_reverse=(d == 1),
+                    sequence_length=sequence_length,
+                    time_major=self.time_major,
+                )
+                outs_dir.append(outs)
+                for k in range(self.state_components):
+                    finals[k].append(fin[k])
+            x = outs_dir[0] if len(outs_dir) == 1 \
+                else ops.concat(outs_dir, axis=-1)
+            if self.dropout > 0.0 and layer < self.num_layers - 1:
+                x = F.dropout(x, p=self.dropout, training=self.training)
+
+        final_states = tuple(ops.stack(f, axis=0) for f in finals)
+        if self.state_components == 1:
+            return x, final_states[0]
+        return x, final_states
+
+
+class SimpleRNN(_RNNBase):
+    """Reference rnn.py:1035."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation, **kwargs)
+
+
+class LSTM(_RNNBase):
+    """Reference rnn.py:1157."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)
+
+
+class GRU(_RNNBase):
+    """Reference rnn.py:1291."""
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kwargs)

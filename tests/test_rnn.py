@@ -1,0 +1,168 @@
+"""Recurrent layers: cells vs numpy loops, multi-layer/bidirectional scan,
+sequence-length masking, gradients, jit parity.
+
+Reference test analog: /root/reference/test/rnn/test_rnn_nets.py (numpy
+reference cells in /root/reference/test/rnn/rnn_numpy.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_lstm_step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    g = x @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    H = h.shape[-1]
+    i, f, gg, o = (g[..., :H], g[..., H:2 * H], g[..., 2 * H:3 * H],
+                   g[..., 3 * H:])
+    i, f, o = _sigmoid(i), _sigmoid(f), _sigmoid(o)
+    c2 = f * c + i * np.tanh(gg)
+    h2 = o * np.tanh(c2)
+    return h2, c2
+
+
+def _np_gru_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    H = h.shape[-1]
+    gx = x @ w_ih.T + b_ih
+    gh = h @ w_hh.T + b_hh
+    r = _sigmoid(gx[..., :H] + gh[..., :H])
+    z = _sigmoid(gx[..., H:2 * H] + gh[..., H:2 * H])
+    c = np.tanh(gx[..., 2 * H:] + r * gh[..., 2 * H:])
+    return z * h + (1.0 - z) * c
+
+
+def test_lstm_cell_matches_numpy():
+    pt.seed(0)
+    cell = nn.LSTMCell(4, 6)
+    x = pt.to_tensor(np.random.RandomState(0).randn(3, 4).astype("float32"))
+    out, (h, c) = cell(x)
+    w_ih = np.asarray(cell.weight_ih.numpy())
+    w_hh = np.asarray(cell.weight_hh.numpy())
+    b_ih = np.asarray(cell.bias_ih.numpy())
+    b_hh = np.asarray(cell.bias_hh.numpy())
+    h_ref, c_ref = _np_lstm_step(x.numpy(), np.zeros((3, 6), "float32"),
+                                 np.zeros((3, 6), "float32"),
+                                 w_ih, w_hh, b_ih, b_hh)
+    np.testing.assert_allclose(h.numpy(), h_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(c.numpy(), c_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(out.numpy(), h_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_gru_cell_matches_numpy():
+    pt.seed(1)
+    cell = nn.GRUCell(5, 3)
+    x = pt.to_tensor(np.random.RandomState(1).randn(2, 5).astype("float32"))
+    h0 = pt.to_tensor(np.random.RandomState(2).randn(2, 3).astype("float32"))
+    out, h = cell(x, h0)
+    ref = _np_gru_step(x.numpy(), h0.numpy(),
+                       np.asarray(cell.weight_ih.numpy()),
+                       np.asarray(cell.weight_hh.numpy()),
+                       np.asarray(cell.bias_ih.numpy()),
+                       np.asarray(cell.bias_hh.numpy()))
+    np.testing.assert_allclose(h.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_layer_matches_manual_cell_loop():
+    pt.seed(2)
+    B, T, I, H = 2, 5, 4, 6
+    lstm = nn.LSTM(I, H, num_layers=1)
+    x_np = np.random.RandomState(3).randn(B, T, I).astype("float32")
+    out, (h, c) = lstm(pt.to_tensor(x_np))
+    assert tuple(out.shape) == (B, T, H)
+    assert tuple(h.shape) == (1, B, H) and tuple(c.shape) == (1, B, H)
+
+    cell = lstm._cells[0]
+    hh = np.zeros((B, H), "float32")
+    cc = np.zeros((B, H), "float32")
+    w = [np.asarray(p.numpy()) for p in
+         (cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh)]
+    outs = []
+    for t in range(T):
+        hh, cc = _np_lstm_step(x_np[:, t], hh, cc, *w)
+        outs.append(hh)
+    np.testing.assert_allclose(out.numpy(), np.stack(outs, 1),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(h.numpy()[0], hh, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(c.numpy()[0], cc, rtol=1e-4, atol=1e-4)
+
+
+def test_bidirectional_multilayer_shapes():
+    pt.seed(3)
+    gru = nn.GRU(4, 5, num_layers=2, direction="bidirect")
+    x = pt.to_tensor(np.random.RandomState(4).randn(3, 7, 4).astype("float32"))
+    out, h = gru(x)
+    assert tuple(out.shape) == (3, 7, 10)
+    assert tuple(h.shape) == (4, 3, 5)  # num_layers * num_directions
+
+
+def test_sequence_length_masking():
+    pt.seed(4)
+    rnn = nn.SimpleRNN(3, 4)
+    B, T = 2, 6
+    x_np = np.random.RandomState(5).randn(B, T, 3).astype("float32")
+    seq = pt.to_tensor(np.array([4, 6], "int64"))
+    out, h = rnn(pt.to_tensor(x_np), sequence_length=seq)
+    out_np = out.numpy()
+    # steps past the end emit zeros
+    np.testing.assert_allclose(out_np[0, 4:], 0.0)
+    # final state for row 0 equals output at its last valid step
+    np.testing.assert_allclose(h.numpy()[0, 0], out_np[0, 3],
+                               rtol=1e-5, atol=1e-5)
+    # full-length row matches the unmasked run
+    out_full, _ = rnn(pt.to_tensor(x_np))
+    np.testing.assert_allclose(out_np[1], out_full.numpy()[1],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_reverse_rnn_wrapper():
+    pt.seed(5)
+    cell = nn.SimpleRNNCell(3, 4)
+    wrapper = nn.RNN(cell, is_reverse=True)
+    x_np = np.random.RandomState(6).randn(2, 5, 3).astype("float32")
+    out, h = wrapper(pt.to_tensor(x_np))
+    # reversed scan: final state corresponds to t=0 output
+    np.testing.assert_allclose(h.numpy(), out.numpy()[:, 0],
+                               rtol=1e-5, atol=1e-5)
+
+    birnn = nn.BiRNN(nn.SimpleRNNCell(3, 4), nn.SimpleRNNCell(3, 4))
+    out2, (hf, hb) = birnn(pt.to_tensor(x_np))
+    assert tuple(out2.shape) == (2, 5, 8)
+
+
+def test_lstm_gradients_flow():
+    pt.seed(6)
+    lstm = nn.LSTM(4, 4, num_layers=2, direction="bidirect")
+    x = pt.to_tensor(np.random.RandomState(7).randn(2, 5, 4).astype("float32"))
+    out, _ = lstm(x)
+    loss = out.sum()
+    loss.backward()
+    for p in lstm.parameters():
+        assert p.grad is not None, p.name
+        assert np.isfinite(p.grad.numpy()).all()
+
+
+def test_lstm_jit_parity():
+    pt.seed(7)
+    lstm = nn.LSTM(4, 6)
+    lstm.eval()
+    x = pt.to_tensor(np.random.RandomState(8).randn(2, 5, 4).astype("float32"))
+    with pt.no_grad():
+        eager, _ = lstm(x)
+
+    @pt.jit.to_static
+    def run(x):
+        with pt.no_grad():
+            out, _ = lstm(x)
+        return out
+
+    compiled = run(x)
+    compiled2 = run(x)
+    np.testing.assert_allclose(eager.numpy(), compiled.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(compiled.numpy(), compiled2.numpy(),
+                               rtol=1e-6, atol=1e-6)
