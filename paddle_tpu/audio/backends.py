@@ -36,10 +36,29 @@ def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
         f.setpos(frame_offset)
         count = n - frame_offset if num_frames < 0 else num_frames
         raw = f.readframes(count)
-    dt = {1: np.int8, 2: np.int16, 4: np.int32}[width]
-    data = np.frombuffer(raw, dtype=dt).reshape(-1, ch)
+    if width == 1:
+        # WAV 8-bit PCM is UNSIGNED, centered at 128
+        data = np.frombuffer(raw, dtype=np.uint8).astype(np.int16) - 128
+        denom = 128.0
+    elif width == 2:
+        data = np.frombuffer(raw, dtype=np.int16)
+        denom = float(np.iinfo(np.int16).max)
+    elif width == 3:
+        # 24-bit: widen each 3-byte little-endian frame to int32
+        b = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 3)
+        data = (b[:, 0].astype(np.int32)
+                | (b[:, 1].astype(np.int32) << 8)
+                | (b[:, 2].astype(np.int32) << 16))
+        data = (data << 8) >> 8  # sign-extend from 24 bits
+        denom = float(2 ** 23 - 1)
+    elif width == 4:
+        data = np.frombuffer(raw, dtype=np.int32)
+        denom = float(np.iinfo(np.int32).max)
+    else:
+        raise ValueError(f"unsupported WAV sample width: {width} bytes")
+    data = data.reshape(-1, ch)
     if normalize:
-        data = data.astype(np.float32) / float(np.iinfo(dt).max)
+        data = data.astype(np.float32) / denom
     arr = data.T if channels_first else data
     return to_tensor(np.ascontiguousarray(arr)), sr
 
@@ -48,11 +67,25 @@ def save(filepath: str, src: Tensor, sample_rate: int,
          channels_first: bool = True, bits_per_sample: int = 16):
     import numpy as np
 
+    if bits_per_sample not in (8, 16, 32):
+        raise ValueError(f"bits_per_sample must be 8, 16 or 32, got "
+                         f"{bits_per_sample}")
     data = np.asarray(src._value if isinstance(src, Tensor) else src)
     if channels_first:
         data = data.T
     if data.dtype.kind == "f":
-        data = (np.clip(data, -1, 1) * (2 ** (bits_per_sample - 1) - 1)).astype(np.int16)
+        qmax = 2 ** (bits_per_sample - 1) - 1
+        # scale in float64: float32 can't represent 2^31-1 exactly, so a
+        # full-scale sample would round past INT32_MAX and wrap on cast
+        scaled = np.round(np.clip(data.astype(np.float64), -1, 1) * qmax)
+        scaled = np.clip(scaled, -qmax - 1, qmax)
+        if bits_per_sample == 8:
+            # WAV 8-bit PCM is unsigned, centered at 128
+            data = (scaled + 128).astype(np.uint8)
+        elif bits_per_sample == 16:
+            data = scaled.astype(np.int16)
+        else:
+            data = scaled.astype(np.int32)
     with _wave.open(filepath, "wb") as f:
         f.setnchannels(data.shape[1] if data.ndim > 1 else 1)
         f.setsampwidth(bits_per_sample // 8)

@@ -161,21 +161,30 @@ class TCPStore:
                     raise TimeoutError(f"TCPStore.wait: key {key!r} not set "
                                        f"within {timeout}s")
                 time.sleep(0.01)
-        out = ctypes.POINTER(ctypes.c_uint8)()
-        olen = ctypes.c_uint32()
-        with self._io_lock:
-            rc = self._lib.tcp_store_wait(self._fd, key.encode(),
-                                          ctypes.c_int64(int(timeout * 1000)),
-                                          ctypes.byref(out), ctypes.byref(olen))
-        if rc < 0:
-            raise RuntimeError("TCPStore.wait failed")
-        if rc == 0:
-            raise TimeoutError(f"TCPStore.wait: key {key!r} not set within "
-                               f"{timeout}s")
-        data = ctypes.string_at(out, olen.value) if olen.value else b""
-        if olen.value:
-            self._lib.tcp_store_free(out)
-        return data
+        # A single long server-side wait would hold _io_lock for the whole
+        # blocking period (up to an hour for p2p), starving every other
+        # thread on this store — e.g. the elastic heartbeat, whose missed
+        # beats would look like a dead node.  Poll with SHORT server-side
+        # waits instead, releasing the lock between polls.
+        deadline = time.time() + timeout
+        while True:
+            slice_ms = int(min(0.2, max(0.0, deadline - time.time())) * 1000)
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            olen = ctypes.c_uint32()
+            with self._io_lock:
+                rc = self._lib.tcp_store_wait(self._fd, key.encode(),
+                                              ctypes.c_int64(slice_ms),
+                                              ctypes.byref(out), ctypes.byref(olen))
+            if rc < 0:
+                raise RuntimeError("TCPStore.wait failed")
+            if rc > 0:
+                data = ctypes.string_at(out, olen.value) if olen.value else b""
+                if olen.value:
+                    self._lib.tcp_store_free(out)
+                return data
+            if time.time() >= deadline:
+                raise TimeoutError(f"TCPStore.wait: key {key!r} not set within "
+                                   f"{timeout}s")
 
     def barrier(self, name: str, world_size: int, timeout: float = 60.0):
         """Counter barrier: every rank adds 1 then waits for world_size."""

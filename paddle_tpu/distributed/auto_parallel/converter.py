@@ -123,20 +123,38 @@ class Converter:
         local shards (last writer wins) would otherwise yield silently
         corrupted weights."""
         info = self._meta["tensors"][name]
-        out = np.empty(info["global_shape"], dtype=np.dtype(info["dtype"]))
-        covered = np.zeros(info["global_shape"], dtype=bool)
+        gshape = tuple(info["global_shape"])
+        out = np.empty(gshape, dtype=np.dtype(info["dtype"]))
+        # Coverage is verified ARITHMETICALLY from the slice bounds (volume
+        # sum + pairwise-disjointness ⇒ exact tiling) — an elementwise bool
+        # mask would transiently cost ~1 byte/element on top of the merged
+        # fp32 copy, right when host RAM is tightest.
+        boxes = []
         for e in info["shards"]:
             idx = _json_to_index(e["index"])
             out[idx] = self._arrays[e["id"]]
-            covered[idx] = True
-        if not covered.all():
-            missing = covered.size - int(covered.sum())
+            full = idx + tuple(slice(None) for _ in range(len(gshape) - len(idx)))
+            bounds = []
+            for d, sl in enumerate(full):
+                start, stop, step = sl.indices(gshape[d])
+                if step != 1:
+                    raise ValueError(f"non-unit-stride shard slice for '{name}'")
+                bounds.append((start, stop))
+            boxes.append(bounds)
+        total = sum(
+            int(np.prod([max(0, b - a) for a, b in box], dtype=np.int64))
+            for box in boxes)
+        volume = int(np.prod(gshape, dtype=np.int64))
+        overlap = any(
+            all(a1 < b2 and a2 < b1 for (a1, b1), (a2, b2) in zip(x, y))
+            for i, x in enumerate(boxes) for y in boxes[i + 1:])
+        if total != volume or overlap:
             raise ValueError(
-                f"checkpoint shard set for '{name}' does not cover the "
-                f"global shape {info['global_shape']} ({missing} elements "
-                "missing) — on multi-host jobs every process must save to "
-                "its OWN directory, or rank 0 must save fully-addressable "
-                "arrays")
+                f"checkpoint shard set for '{name}' does not tile the "
+                f"global shape {info['global_shape']} (shard volume {total} "
+                f"vs {volume}, overlap={overlap}) — on multi-host jobs every "
+                "process must save to its OWN directory, or rank 0 must save "
+                "fully-addressable arrays")
         return out
 
     def convert(self, target_specs: Optional[Dict[str, tuple]] = None):

@@ -7,12 +7,14 @@ launcher relaunches workers with a rescaled spec on change, bounded by
 --max_restart).
 
 TPU-native redesign: the KV substrate is the job's native TCPStore (no
-etcd in the image).  Each node heartbeats ``elastic/beat/<rank>`` with a
-monotonic timestamp; the watcher thread scans peers every interval and
-classifies them dead when their beat is older than the TTL.  On
-membership change the manager invokes the restart callback (the
-launcher's relaunch path) — the same contract the reference's
-ElasticManager has with launch/controllers/master.py.
+etcd in the image).  Each node heartbeats by INCREMENTING a store-side
+counter ``elastic/beat/<rank>`` — liveness is "the counter moved within
+the last TTL seconds of the WATCHER's clock", so detection never
+compares wall clocks across hosts (cross-host clock skew > TTL would
+otherwise mark healthy nodes dead).  On membership change the manager
+invokes the restart callback (the launcher's relaunch path) — the same
+contract the reference's ElasticManager has with
+launch/controllers/master.py.
 """
 from __future__ import annotations
 
@@ -46,9 +48,10 @@ class ElasticManager:
         self._interval = interval
         self._on_change = on_change
         self._stop = threading.Event()
-        self._alive: Dict[int, float] = {}
+        # rank -> (last counter value seen, local monotonic time it changed)
+        self._seen: Dict[int, tuple] = {}
         self._threads: List[threading.Thread] = []
-        self.enabled = self._min != self._max or True
+        self.enabled = True
 
     # -- lifecycle -------------------------------------------------------
     def start(self):
@@ -70,8 +73,7 @@ class ElasticManager:
 
     # -- heartbeat -------------------------------------------------------
     def _beat(self):
-        self._store.set(f"elastic/beat/{self._rank}",
-                        repr(time.time()).encode())
+        self._store.add(f"elastic/beat/{self._rank}", 1)
 
     def _heartbeat_loop(self):
         while not self._stop.wait(self._interval):
@@ -82,23 +84,22 @@ class ElasticManager:
 
     # -- watch -----------------------------------------------------------
     def alive_nodes(self) -> List[int]:
-        now = time.time()
+        now = time.monotonic()
         alive = []
         for r in range(self._max):
+            key = f"elastic/beat/{r}"
             try:
-                key = f"elastic/beat/{r}"
-                # GET blocks until the key exists (store op 1); probe with
-                # CHECK first so unregistered ranks don't wedge the watcher
-                blob = self._store.get(key) if self._store.check(key) else None
+                if not self._store.check(key):
+                    continue
+                # add(key, 0) reads the counter without bumping it
+                ctr = self._store.add(key, 0)
             except Exception:
-                blob = None
-            if not blob:
                 continue
-            try:
-                ts = float(blob.decode())
-            except ValueError:
-                continue
-            if now - ts <= self._ttl:
+            last = self._seen.get(r)
+            if last is None or last[0] != ctr:
+                self._seen[r] = (ctr, now)
+                alive.append(r)
+            elif now - last[1] <= self._ttl:
                 alive.append(r)
         return alive
 

@@ -142,15 +142,20 @@ class Predictor:
 
         self._config = config
         self._layer = _load(config.prog_file())
-        self._n_inputs = self._layer.n_inputs if hasattr(self._layer, "n_inputs") else None
-        self._input_names = [f"x{i}" for i in range(self._n_inputs or 8)]
+        self._n_inputs = getattr(self._layer, "n_inputs", None)
+        if self._n_inputs is None:
+            raise RuntimeError(
+                "cannot determine the model's input arity from "
+                f"'{config.prog_file()}': the artifact predates jit.save's "
+                "n_inputs field and the exported program did not expose its "
+                "calling convention; re-save the model with jit.save")
+        self._input_names = [f"x{i}" for i in range(self._n_inputs)]
         self._inputs: Dict[str, object] = {}
         self._outputs: Dict[str, object] = {}
         self._output_names: List[str] = []
 
     def get_input_names(self):
-        n = self._n_inputs
-        return self._input_names[:n] if n else list(self._input_names)
+        return list(self._input_names)
 
     def get_input_handle(self, name: str) -> Tensor:
         return Tensor(name, self)

@@ -97,6 +97,15 @@ class TranslatedLayer:
         self.n_inputs = blob.get("n_inputs")
         with open(path + ".pdmodel", "rb") as f:
             self._exported = jax_export.deserialize(bytearray(f.read()))
+        if self.n_inputs is None:
+            # artifact predates the n_inputs field: recover the input arity
+            # from the exported calling convention (flattened avals =
+            # example inputs ++ captured state)
+            try:
+                self.n_inputs = (len(self._exported.in_avals)
+                                 - len(self._captured))
+            except Exception:
+                pass
 
     def __call__(self, *inputs):
         raws = tuple(

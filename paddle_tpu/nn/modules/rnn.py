@@ -100,15 +100,29 @@ class _GateCell(RNNCellBase):
             self.bias_hh = None
 
     def _cell_params(self):
-        ps = [self.weight_ih, self.weight_hh]
-        if self.bias_ih is not None:
-            ps.append(self.bias_ih)
-        if self.bias_hh is not None:
-            ps.append(self.bias_hh)
-        return ps
+        """Weights in FIXED slot order (w_ih, w_hh, b_ih, b_hh); a disabled
+        bias occupies its slot as None so b_hh can never shift into the
+        b_ih position when bias_ih_attr=False."""
+        return [self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh]
 
     def extra_repr(self):
         return f"{self.input_size}, {self.hidden_size}"
+
+
+def _pack_params(params):
+    """Split the fixed 4-slot param list into (present tensors, unpack fn):
+    only real tensors are dispatched; ``unpack`` reassembles the 4 slots
+    (None where a bias is disabled) from the raw values inside the op."""
+    present = [p for p in params if p is not None]
+    slots = [i for i, p in enumerate(params) if p is not None]
+
+    def unpack(raws):
+        w = [None] * 4
+        for s, r in zip(slots, raws):
+            w[s] = r
+        return w
+
+    return present, unpack
 
 
 def _gates(x, h, w_ih, w_hh, b_ih, b_hh):
@@ -177,15 +191,13 @@ class SimpleRNNCell(_GateCell):
         if states is None:
             states = self.get_initial_states(inputs)
         states = _ensure_tuple(states)
-        raws = [inputs] + list(states) + self._cell_params()
+        params, unpack = _pack_params(self._cell_params())
+        raws = [inputs] + list(states) + params
 
         def fn(x, *rest):
             n_state = len(states)
             st = rest[:n_state]
-            w = list(rest[n_state:])
-            while len(w) < 4:
-                w.append(None)
-            out, new = self._step(x, st, *w[:4])
+            out, new = self._step(x, st, *unpack(rest[n_state:]))
             return (out,) + tuple(new)
 
         outs = dispatch.apply(fn, *raws, op_name="rnn_cell")
@@ -211,13 +223,11 @@ class LSTMCell(_GateCell):
         if states is None:
             states = self.get_initial_states(inputs)
         h, c = states
-        raws = [inputs, h, c] + self._cell_params()
+        params, unpack = _pack_params(self._cell_params())
+        raws = [inputs, h, c] + params
 
         def fn(x, h, c, *w):
-            w = list(w)
-            while len(w) < 4:
-                w.append(None)
-            out, (h2, c2) = _lstm_step(x, (h, c), *w[:4])
+            out, (h2, c2) = _lstm_step(x, (h, c), *unpack(w))
             return out, h2, c2
 
         out, h2, c2 = dispatch.apply(fn, *raws, op_name="lstm_cell")
@@ -243,13 +253,11 @@ class GRUCell(_GateCell):
         if states is None:
             states = self.get_initial_states(inputs)
         states = _ensure_tuple(states)
-        raws = [inputs, states[0]] + self._cell_params()
+        params, unpack = _pack_params(self._cell_params())
+        raws = [inputs, states[0]] + params
 
         def fn(x, h, *w):
-            w = list(w)
-            while len(w) < 4:
-                w.append(None)
-            out, (h2,) = _gru_step(x, (h,), *w[:4])
+            out, (h2,) = _gru_step(x, (h,), *unpack(w))
             return out, h2
 
         out, h2 = dispatch.apply(fn, *raws, op_name="gru_cell")
@@ -269,7 +277,8 @@ def _scan_layer(step, n_state, inputs, init_states, params, *,
     previous state and emit zeros (reference masking semantics).
     Returns (outputs, final_states tuple).
     """
-    raws = [inputs] + list(init_states) + list(params)
+    params, unpack = _pack_params(list(params))
+    raws = [inputs] + list(init_states) + params
     if sequence_length is not None:
         raws.append(sequence_length)
 
@@ -280,10 +289,7 @@ def _scan_layer(step, n_state, inputs, init_states, params, *,
         else:
             seq_len = None
         st = tuple(rest[:n_state])
-        w = list(rest[n_state:])
-        while len(w) < 4:
-            w.append(None)
-        w = w[:4]
+        w = unpack(rest[n_state:])
 
         xs = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, I]
         T = xs.shape[0]

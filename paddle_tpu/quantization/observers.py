@@ -44,8 +44,12 @@ class BaseObserver(Layer):
     def zero_points(self):
         return Tensor(jnp.zeros((), jnp.float32))
 
-    def _instance(self, layer):  # factory-protocol parity
-        return self
+    def _instance(self, layer):
+        """Factory protocol: a QuantConfig entry is a TEMPLATE — every
+        matched layer gets its own observer so per-layer calibration
+        statistics never cross-contaminate (reference
+        quantization/factory.py ObserverFactory._get_class)."""
+        return type(self)(quant_bits=self._quant_bits)
 
 
 class AbsmaxObserver(BaseObserver):

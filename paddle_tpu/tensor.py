@@ -77,6 +77,19 @@ class Tensor:
         if log is not None:
             log[id(self)] = self
 
+    def set_value(self, value):
+        """Public in-place assignment (reference Tensor.set_value):
+        accepts Tensor / ndarray / scalar, preserving this tensor's dtype."""
+        import numpy as _np
+
+        raw = value._value if isinstance(value, Tensor) else value
+        raw = jnp.asarray(_np.asarray(raw), dtype=self._value.dtype)
+        if tuple(raw.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {list(raw.shape)} vs "
+                f"{self.shape}")
+        self._set_value(raw)
+
     # -- metadata ----------------------------------------------------------
     @property
     def shape(self):

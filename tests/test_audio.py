@@ -70,3 +70,18 @@ def test_wav_roundtrip(tmp_path):
     np.testing.assert_allclose(loaded.numpy()[0], sig, atol=2e-4)
     meta = backends.info(path)
     assert meta.sample_rate == sr and meta.num_channels == 1
+
+
+def test_wav_roundtrip_8_and_32_bit(tmp_path):
+    sr = 8000
+    sig = _sine(sr)
+    for bits, atol in ((8, 2e-2), (32, 1e-6)):
+        path = str(tmp_path / f"t{bits}.wav")
+        backends.save(path, pt.to_tensor(sig[None, :]), sr,
+                      bits_per_sample=bits)
+        meta = backends.info(path)
+        assert meta.bits_per_sample == bits
+        assert meta.num_frames == len(sig)  # frame count honors sampwidth
+        loaded, sr2 = backends.load(path)
+        assert sr2 == sr
+        np.testing.assert_allclose(loaded.numpy()[0], sig, atol=atol)

@@ -121,3 +121,28 @@ class TestQuantization:
         # int8 QDQ should stay close to the fp32 reference
         np.testing.assert_allclose(out, ref, rtol=0.2, atol=0.2)
         assert not np.allclose(out, ref)  # but actually quantized
+
+
+def test_ptq_observers_are_per_layer():
+    """A QuantConfig observer entry is a template: each matched layer must
+    calibrate with its OWN observer instance, not share global statistics."""
+    import paddle_tpu as pt
+
+    q_config = QuantConfig(activation=None, weight=None)
+    q_config.add_type_config(pt.nn.Linear,
+                             activation=AbsmaxObserver(quant_bits=8),
+                             weight=AbsmaxObserver(quant_bits=8))
+    ptq = PTQ(q_config)
+    model = pt.nn.Sequential(pt.nn.Linear(4, 4), pt.nn.Linear(4, 4))
+    # make layer 0's weights 100x larger than layer 1's
+    model[0].weight.set_value(pt.to_tensor(
+        100.0 * np.ones((4, 4), np.float32)))
+    model[1].weight.set_value(pt.to_tensor(np.ones((4, 4), np.float32)))
+    observed = ptq.quantize(model)
+    x = pt.to_tensor(np.random.RandomState(0).randn(8, 4).astype(np.float32))
+    observed(x)
+    wobs = [w for _, w in observed.named_sublayers()
+            if isinstance(w, AbsmaxObserver)]
+    scales = sorted(float(o.scales().numpy()) for o in wobs if o.scales() is not None)
+    assert scales[0] < scales[-1] / 10, (
+        f"observers shared statistics across layers: {scales}")
