@@ -25,7 +25,11 @@ globally. This is why eager-mode overhead does not bound performance
 from __future__ import annotations
 
 import functools
+import gc
+import os
+import sys
 import threading
+import weakref
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -33,6 +37,19 @@ import numpy as np
 
 from ..tensor import Tensor
 from ..ops import dispatch
+
+
+class AbstractScoutUnsupported(RuntimeError):
+    """Raised when the zero-compute capture pass cannot represent the traced
+    function (data-dependent python control flow, host reads of tensor
+    values, lazily-created state with data-dependent init).  jit.to_static
+    falls back to the eager warmup+scout protocol — unless ``poisoned`` is
+    set, meaning restore could not scrub a leaked tracer out of persistent
+    state and an eager re-run would crash on it."""
+
+    def __init__(self, msg, poisoned: bool = False):
+        super().__init__(msg)
+        self.poisoned = poisoned
 
 
 class _JitState(threading.local):
@@ -91,11 +108,16 @@ class _CompiledEntry:
         "out_spec",
         "n_args",
         "gen_threshold",
+        "stale_ordinals",
         "_scout_result",
     )
 
     def __init__(self):
         self.jitted = None
+        # creation ordinals (within fn's run) of per-call "result attribute"
+        # tensors — created fresh each call with trace-dependent values
+        # (e.g. layer.aux_loss) — functionalized as extra program outputs
+        self.stale_ordinals: List[tuple] = []
         self.captured: List[Tensor] = []
         # captured state split by the scout pass: tensors the function
         # re-binds (params, moments, RNG state) vs read-only state.  The
@@ -117,7 +139,12 @@ class StaticFunction:
     (reference program_translator.py:305)."""
 
     def __init__(self, fn, input_spec=None, build_strategy=None, backend=None):
-        self._fn = fn
+        # AST dy2static pass (reference program_translator.py:305 applies
+        # DygraphToStaticAst before tracing): native if/while over traced
+        # Tensors become runtime-dispatched cond/while_loop sites
+        from .dy2static import convert_to_static
+
+        self._fn = convert_to_static(fn)
         self._cache: Dict[Any, _CompiledEntry] = {}
         functools.update_wrapper(self, fn)
 
@@ -143,16 +170,54 @@ class StaticFunction:
 
         entry = self._cache.get(key)
         if entry is None:
-            # warmup call: run eagerly so lazily-created state (optimizer
-            # moments, BN stats, caches) comes into existence before capture
-            entry = _CompiledEntry()
-            self._cache[key] = entry
-            return self._fn(*args, **kwargs)
+            if os.environ.get("PADDLE_TPU_EAGER_SCOUT"):
+                # forced legacy protocol: eager warmup, then eager scout
+                entry = _CompiledEntry()
+                self._cache[key] = entry
+                return self._fn(*args, **kwargs)
+            # default: ABSTRACT scout — capture reads/mutations under
+            # jax.eval_shape (zero FLOPs, zero intermediate HBM), compile,
+            # and run the compiled program.  No eager step of the model is
+            # ever executed, so peak residency never exceeds the compiled
+            # step's (critical for models near the HBM limit; round-3
+            # postmortem: two eager 1.3B steps OOMed a v5e before the
+            # donated compiled path existed).
+            try:
+                return self._abstract_compile_and_run(
+                    key, args, kwargs, arg_tensors)
+            except AbstractScoutUnsupported as e:
+                from .dy2static import Dy2StaticUnsupported
+
+                if isinstance(e.__cause__, Dy2StaticUnsupported):
+                    # a tensor-dependent control-flow site that cannot be
+                    # functionalized will fail at compile regardless of the
+                    # scout protocol — surface the precise error now
+                    raise e.__cause__ from None
+                if e.poisoned:
+                    # a tracer is stuck in persistent state the restore
+                    # could not scrub; an eager re-run would crash on it
+                    raise RuntimeError(
+                        "jit.to_static abstract scout failed and left "
+                        f"unrecoverable state ({e}); run the whole program "
+                        "with PADDLE_TPU_EAGER_SCOUT=1") from e
+                # NOTE: the scout already executed the function's python
+                # body once (tensor effects restored, python-level effects
+                # like counters are not) — the eager fallback re-runs it.
+                sys.stderr.write(
+                    f"[paddle_tpu.jit] abstract scout unavailable for "
+                    f"{getattr(self._fn, '__name__', '?')} ({e}); falling "
+                    "back to eager warmup+scout\n")
+                entry = _CompiledEntry()
+                self._cache[key] = entry
+                return self._fn(*args, **kwargs)
         if entry.jitted is None:
             entry = self._scout_and_compile(key, args, kwargs, arg_tensors)
             # scout call already produced results eagerly
             return entry._scout_result
+        return self._run_compiled(entry, arg_tensors)
 
+    @staticmethod
+    def _run_compiled(entry, arg_tensors):
         raw_args = [t._value for t in arg_tensors]
         raw_mut = [t._value for t in entry.mut_caps]
         raw_ro = [t._value for t in entry.ro_caps]
@@ -162,6 +227,169 @@ class StaticFunction:
         return _tree_unflatten(entry.out_spec, list(out_raws))
 
     # -- compilation -------------------------------------------------------
+    def _abstract_compile_and_run(self, key, args, kwargs, arg_tensors):
+        """Zero-compute capture: trace the function under ``jax.eval_shape``
+        (every op abstract — no FLOPs, no intermediate HBM), discover the
+        captured/mutated state exactly like the eager scout, restore all
+        python-visible effects, then compile and RUN the jitted program.
+
+        This replaces the legacy eager warmup+scout protocol (two full eager
+        steps before the donated compiled path exists) — on a model near the
+        HBM limit the eager steps' activation residency (no remat applies in
+        eager mode) is what OOMs, not the compiled step."""
+        from .. import tensor as _tensor_mod
+
+        entry = _CompiledEntry()
+        _tensor_mod._GENERATION[0] += 1
+        threshold = _tensor_mod._GENERATION[0]
+        entry.gen_threshold = threshold
+
+        read_log: Dict[int, Tensor] = {}
+        mut_log: Dict[int, Tensor] = {}
+        creation_log: Dict[int, tuple] = {}
+        orig_vals: Dict[int, Any] = {}
+        orig_grads: Dict[int, tuple] = {}
+        out_state: Dict[str, Any] = {}
+        ts = dispatch._trace_state
+        arg_snap = [(t, t._value, t.grad) for t in arg_tensors]
+
+        def scout(raw_args):
+            prev = (ts.read_log, ts.read_epoch, ts.mutation_log)
+            st = _tensor_mod._SCOUT_STATE
+            prev_scout = (st.creation_log, st.orig_values, st.orig_grads)
+            ts.read_log, ts.read_epoch, ts.mutation_log = (
+                read_log, threshold, mut_log)
+            st.creation_log, st.orig_values, st.orig_grads = (
+                creation_log, orig_vals, orig_grads)
+            try:
+                for t, rv in zip(arg_tensors, raw_args):
+                    t._value = rv
+                res = self._fn(*args, **kwargs)
+                outs: List[Tensor] = []
+                out_state["out_spec"] = _tree_flatten(res, outs)
+                return tuple(o._value for o in outs)
+            finally:
+                ts.read_log, ts.read_epoch, ts.mutation_log = prev
+                st.creation_log, st.orig_values, st.orig_grads = prev_scout
+
+        structs = tuple(
+            jax.ShapeDtypeStruct(tuple(t._value.shape), t._value.dtype)
+            for t in arg_tensors)
+        try:
+            jax.eval_shape(scout, structs)
+        except Exception as e:
+            # Restore-only (no persistence detection): the in-flight
+            # exception's traceback frames pin scout-created tensors alive,
+            # so an aliveness check here would misclassify temporaries as
+            # persistent state.  Genuine bugs re-raise cleanly on the eager
+            # fallback call.  Known limitation: lazily-created persistent
+            # state with a trace-dependent init cannot be scrubbed here and
+            # would surface as an UnexpectedTracerError in the fallback.
+            self._restore_after_scout(arg_snap, read_log, mut_log,
+                                      creation_log, orig_vals, orig_grads,
+                                      threshold, check_persistent=False)
+            raise AbstractScoutUnsupported(f"{type(e).__name__}: {e}") from e
+
+        persistents, mut_pre, stale = self._restore_after_scout(
+            arg_snap, read_log, mut_log, creation_log, orig_vals, orig_grads,
+            threshold)
+        entry.stale_ordinals = stale
+
+        arg_ids = {id(t) for t in arg_tensors}
+        captured = [t for tid, t in read_log.items() if tid not in arg_ids]
+        created_ids = {id(t) for t in persistents}
+        # pre-existing mutated tensors must be carried even if never read
+        for tid, t in mut_pre.items():
+            if tid not in arg_ids and not any(t is c for c in captured):
+                captured.append(t)
+        captured.extend(persistents)
+        entry.captured = captured
+        mut_ids = set(mut_pre.keys()) | created_ids
+        entry.mut_caps = [t for t in captured if id(t) in mut_ids]
+        entry.ro_caps = [t for t in captured if id(t) not in mut_ids]
+        entry.n_args = len(arg_tensors)
+        entry.out_spec = out_state["out_spec"]
+
+        self._install_jitted(entry, args, kwargs)
+        self._cache[key] = entry
+        return self._run_compiled(entry, arg_tensors)
+
+    @staticmethod
+    def _restore_after_scout(arg_snap, read_log, mut_log, creation_log,
+                             orig_vals, orig_grads, threshold,
+                             check_persistent=True):
+        """Undo every python-visible effect of the abstract scout: re-bind
+        original values into arg + mutated tensors, restore pre-trace grad
+        bindings exactly (a param's accumulated eager grad must survive the
+        capture pass), and return (persistents, mut_pre): the
+        created-and-persistent tensors (lazily-created state) restored to
+        their concrete init values, and the pre-existing mutated tensors
+        (id -> Tensor).  CONSUMES mut_log, orig_vals and orig_grads — their
+        strong references must be gone before the aliveness gc below, or
+        every trace-created tensor that was mutated in place (e.g. grads
+        under clip_grad_norm_) reads as persistent.  Raises when a
+        persistent created tensor has a trace-dependent init — it cannot be
+        materialized without running the function for real."""
+        def is_tracer(v):
+            return isinstance(v, jax.core.Tracer)
+
+        for t, v in orig_vals.values():
+            t._value = v
+        # every grad rebind during the scout was recorded with its
+        # pre-trace binding (Tensor.grad setter hook): restore exactly —
+        # concrete accumulated grads survive, tracer grads vanish
+        for t, g in orig_grads.values():
+            t._grad = g
+        # args AFTER orig_vals/orig_grads: a mutated arg's "pre-mutation"
+        # value is the bound tracer — the snapshot holds its true values
+        for t, v, g in arg_snap:
+            t._value = v
+            t._grad = g
+        created = list(creation_log.values())
+        creation_log.clear()
+        orig_grads.clear()
+        # drop loop bindings: a leftover reference in THIS frame would
+        # survive the gc.collect() below and misclassify the last created
+        # temporary as persistent state
+        t = g = None
+        if not check_persistent:
+            # failure path: re-bind concrete init values where known and
+            # stop — no aliveness classification (see caller)
+            for t, fv in created:
+                rv = orig_vals.get(id(t), (None, fv))[1]
+                if not is_tracer(rv):
+                    t._value = rv
+            mut_log.clear()
+            orig_vals.clear()
+            return [], {}, []
+        refs = [(i, weakref.ref(t), orig_vals.get(id(t), (None, fv))[1])
+                for i, (t, fv) in enumerate(created)]
+        mut_pre = {tid: t for tid, t in mut_log.items()
+                   if t._gen < threshold}
+        mut_log.clear()
+        orig_vals.clear()
+        del created
+        t = None
+        gc.collect()
+        persistents = []
+        stale: List[tuple] = []
+        for i, r, fv in refs:
+            t = r()
+            if t is None:
+                continue
+            if is_tracer(fv):
+                # per-call "result attribute" (layer.aux_loss style): a
+                # tensor CREATED each call with a trace-dependent value and
+                # stashed on a python object.  Functionalized as an extra
+                # program output keyed by its creation ordinal — the
+                # compiled trace recreates it at the same ordinal and the
+                # writeback keeps the attribute fresh after every call.
+                stale.append((i, tuple(fv.shape), str(fv.dtype)))
+                continue
+            t._value = fv
+            persistents.append(t)
+        return persistents, mut_pre, stale
+
     def _scout_and_compile(self, key, args, kwargs, arg_tensors):
         entry = self._cache.get(key) or _CompiledEntry()
 
@@ -206,13 +434,26 @@ class StaticFunction:
         entry.out_spec = _tree_flatten(result, out_tensors)
         entry._scout_result = result  # type: ignore[attr-defined]
 
-        # 2. build the pure function over (args, mut-captured, ro-captured)
+        self._install_jitted(entry, args, kwargs)
+        self._cache[key] = entry
+        return entry
+
+    def _install_jitted(self, entry, args, kwargs):
+        """Build the pure function over (args, mut-captured, ro-captured)
+        and jit it with the mutated state donated."""
         fn = self._fn
         mut_list = entry.mut_caps
         ro_list = entry.ro_caps
-        arg_spec = _tree_flatten((args, kwargs), [])
+        arg_list: List[Tensor] = []
+        arg_spec = _tree_flatten((args, kwargs), arg_list)
+        # the trace rebuilds arg Tensors from raw values — preserve each
+        # arg's stop_gradient so differentiating w.r.t. an input works
+        arg_sgs = [t.stop_gradient for t in arg_list]
+        del arg_list
 
         def pure_fn(raw_args, raw_mut, raw_ro):
+            from .. import tensor as _tensor_mod
+
             # bind tracers into the live Tensor objects, run, then restore
             cap_pairs = list(zip(mut_list, raw_mut)) + list(zip(ro_list, raw_ro))
             snapshot = [(t, t._value, t.grad) for t, _ in cap_pairs]
@@ -221,11 +462,23 @@ class StaticFunction:
             prev_t = _jit_state.tracing
             dispatch._trace_state.mutation_log = mut
             _jit_state.tracing = True
+            st = _tensor_mod._SCOUT_STATE
+            prev_cl = st.creation_log
+            clog: Dict[int, tuple] = {}
             try:
                 for t, rv in cap_pairs:
                     t._value = rv
                 a, kw = _tree_unflatten(arg_spec, list(raw_args))
+                rebuilt: List[Tensor] = []
+                _tree_flatten((a, kw), rebuilt)
+                for rt, sg in zip(rebuilt, arg_sgs):
+                    rt.stop_gradient = sg
+                if entry.stale_ordinals:
+                    # track creations so per-call result attributes can be
+                    # matched by ordinal (scout discovered them)
+                    st.creation_log = clog
                 res = fn(*a, **kw)
+                st.creation_log = prev_cl
                 outs: List[Tensor] = []
                 _tree_flatten(res, outs)
                 out_raws = tuple(o._value for o in outs)
@@ -245,19 +498,35 @@ class StaticFunction:
                 order.extend(extra)
                 ro_mutated = [t for t in ro_list if id(t) in mut]
                 order.extend(ro_mutated)
+                if entry.stale_ordinals:
+                    created = list(clog.values())
+                    for i, shape, dtype in entry.stale_ordinals:
+                        if i >= len(created):
+                            raise AbstractScoutUnsupported(
+                                "per-call result attribute not recreated at "
+                                f"creation ordinal {i} in the compiled "
+                                "trace; set PADDLE_TPU_EAGER_SCOUT=1")
+                        t_new = created[i][0]
+                        if (tuple(t_new._value.shape) != shape
+                                or str(t_new._value.dtype) != dtype):
+                            raise AbstractScoutUnsupported(
+                                f"creation ordinal {i} shape/dtype mismatch"
+                                f" ({tuple(t_new._value.shape)}:"
+                                f"{t_new._value.dtype} vs {shape}:{dtype});"
+                                " set PADDLE_TPU_EAGER_SCOUT=1")
+                        order.append(t_new)
                 entry.mutated_order = order
                 new_states = tuple(t._value for t in order)
                 return out_raws, new_states
             finally:
                 dispatch._trace_state.mutation_log = prev_m
                 _jit_state.tracing = prev_t
+                st.creation_log = prev_cl
                 for t, v, g in snapshot:
                     t._value = v
                     t.grad = g
 
         entry.jitted = jax.jit(pure_fn, donate_argnums=(1,))
-        self._cache[key] = entry
-        return entry
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):

@@ -1,0 +1,571 @@
+"""AST dy2static: native python ``if``/``while`` over traced Tensors.
+
+Reference: python/paddle/jit/dy2static/ast_transformer.py (DygraphToStaticAst
+rewrites IfElse/While/For into conditional_block / while ops) +
+program_translator.py:305 (StaticFunction applies the transform before
+tracing).
+
+TPU-native redesign: instead of rewriting into ProgramDesc ops, each native
+``if``/``while`` is rewritten into a RUNTIME-DISPATCHED site:
+
+* predicate is a concrete python value / eager Tensor -> the ORIGINAL python
+  control flow runs, preserving dygraph semantics bit-for-bit (including
+  ``break``/``continue``/side effects);
+* predicate is a traced Tensor (inside ``jit.to_static``'s capture or
+  compile trace) -> the site lowers through ``static.nn.cond`` /
+  ``static.nn.while_loop`` onto ``lax.cond`` / ``lax.while_loop`` inside
+  the SAME compiled program.
+
+A site whose shape can't be functionalized (early return out of one branch
+only, ``break`` in a tensor-predicate loop, attribute mutation inside a
+branch) keeps its python path and raises a clear error NAMING THE SOURCE
+LINE only if the predicate actually turns out to be traced.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import List, Optional, Set, Tuple
+
+import jax
+
+from ...tensor import Tensor
+
+__all__ = ["convert_to_static", "Dy2StaticUnsupported"]
+
+
+class Dy2StaticUnsupported(RuntimeError):
+    """A tensor-dependent control-flow site could not be functionalized."""
+
+
+# -- runtime helpers (referenced by transformed code as __pt_d2s.*) --------
+
+class _Missing:
+    def __repr__(self):
+        return "<dy2static: name undefined before this control-flow site>"
+
+
+_MISSING = _Missing()
+
+
+def _get(f):
+    """Evaluate a deferred name lookup, tolerating not-yet-bound names
+    (python defines them inside the branch/loop; the seeded default is then
+    never read)."""
+    try:
+        return f()
+    except NameError:
+        return _MISSING
+
+
+def _is_traced_pred(p) -> bool:
+    return isinstance(p, Tensor) and isinstance(p._value, jax.core.Tracer)
+
+
+def run_cond(pred, true_fn, false_fn):
+    from ...static import nn as static_nn
+
+    def _checked(fn):
+        def wrapper():
+            out = fn()
+            flat = out if isinstance(out, tuple) else (out,)
+            if any(o is _MISSING for o in flat):
+                raise Dy2StaticUnsupported(
+                    "dy2static: a variable is assigned in only one branch "
+                    "of a tensor `if` and undefined before it — both "
+                    "branches of a traced conditional must produce every "
+                    "output (initialize the variable before the if)")
+            return out
+        return wrapper
+
+    return static_nn.cond(pred, _checked(true_fn), _checked(false_fn))
+
+
+def reraise_unsupported(e, lineno, reason):
+    """Convert Tensor.__bool__'s generic trace error (raised from an
+    untransformable loop that actually hit a traced predicate) into the
+    precise dy2static error naming the source line."""
+    if "data-dependent Python control flow" in str(e):
+        unsupported(lineno, reason)
+    raise e
+
+
+def run_while(cond_fn, body_fn, vals, max_iter=None):
+    from ...static import nn as static_nn
+
+    if any(v is _MISSING for v in vals):
+        raise Dy2StaticUnsupported(
+            "dy2static: a loop variable is undefined before a "
+            "tensor-predicate while loop; initialize it first")
+    out = static_nn.while_loop(cond_fn, body_fn, list(vals),
+                               max_iter=max_iter)
+    return tuple(out)
+
+
+def unsupported(lineno, reason):
+    raise Dy2StaticUnsupported(
+        f"dy2static: tensor-dependent control flow at source line {lineno} "
+        f"cannot be functionalized: {reason}. Restructure with "
+        "paddle_tpu.static.nn.cond / while_loop, or keep the predicate "
+        "un-traced.")
+
+
+# -- AST analysis ----------------------------------------------------------
+
+def _assigned_names(stmts: List[ast.stmt]) -> Set[str]:
+    """Simple-Name binding targets in a statement list (recursing into
+    nested compound statements but NOT into nested function/class defs)."""
+    names: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):  # don't descend
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+        def _target(self, t):
+            if isinstance(t, ast.Name):
+                if not t.id.startswith("__pt_"):  # synthetic temps stay local
+                    names.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    self._target(e)
+            elif isinstance(t, ast.Starred):
+                self._target(t.value)
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                self._target(t)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            self._target(node.target)
+            self.generic_visit(node)
+
+        def visit_AnnAssign(self, node):
+            if node.value is not None:
+                self._target(node.target)
+            self.generic_visit(node)
+
+        def visit_For(self, node):
+            self._target(node.target)
+            self.generic_visit(node)
+
+        def visit_withitem(self, node):
+            if node.optional_vars is not None:
+                self._target(node.optional_vars)
+            self.generic_visit(node)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return names
+
+
+def _has_node(stmts: List[ast.stmt], kinds, stop_at_loops=False) -> bool:
+    """Does any statement contain a node of the given kinds (not descending
+    into nested defs; optionally not into nested loops for break/continue
+    ownership)?"""
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_ClassDef(self, node):
+            pass
+
+        def visit_Lambda(self, node):
+            pass
+
+        def visit_For(self, node):
+            if stop_at_loops:
+                # break/continue inside a NESTED loop belong to it
+                self.visit(node.iter)
+                return
+            self.generic_visit(node)
+
+        def visit_While(self, node):
+            if stop_at_loops:
+                self.visit(node.test)
+                return
+            self.generic_visit(node)
+
+        def generic_visit(self, node):
+            if isinstance(node, kinds):
+                self.found = True
+                return
+            super().generic_visit(node)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _non_name_bindings(stmts: List[ast.stmt]) -> bool:
+    """Attribute/Subscript assignment targets (python-object mutation a
+    traced branch cannot functionalize)."""
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_FunctionDef(self, node):
+            pass
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+        def _target(self, t):
+            if isinstance(t, (ast.Attribute, ast.Subscript)):
+                self.found = True
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    self._target(e)
+
+        def visit_Assign(self, node):
+            for t in node.targets:
+                self._target(t)
+            self.generic_visit(node)
+
+        def visit_AugAssign(self, node):
+            self._target(node.target)
+            self.generic_visit(node)
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _trailing_return(stmts: List[ast.stmt]):
+    """(stmts_without_trailing_return, return_expr | None)."""
+    if stmts and isinstance(stmts[-1], ast.Return):
+        ret = stmts[-1].value
+        return stmts[:-1], (ret if ret is not None
+                            else ast.Constant(value=None))
+    return stmts, None
+
+
+def _src(stmts: List[ast.stmt], indent: str) -> str:
+    if not stmts:
+        return f"{indent}pass"
+    body = ast.unparse(ast.Module(body=stmts, type_ignores=[]))
+    return textwrap.indent(body, indent)
+
+
+def _ends_in_return(stmts: List[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(stmts[-1], ast.Return)
+
+
+def _normalize_early_returns(stmts: List[ast.stmt],
+                             at_function_top: bool) -> List[ast.stmt]:
+    """Fold the early-return idiom into if/else so it functionalizes:
+
+        if c: return A          if c: return A
+        <rest>           ->     else: <rest>
+
+    Applied recursively to nested compound bodies.  At function top level
+    an early-return `if` that is the LAST statement gains an explicit
+    `else: return None` (python's implicit fallthrough)."""
+    out: List[ast.stmt] = []
+    for i, s in enumerate(stmts):
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(s, field, None)
+            if (sub and not isinstance(
+                    s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.ClassDef))):
+                setattr(s, field, _normalize_early_returns(sub, False))
+        if (isinstance(s, ast.If) and not s.orelse
+                and _ends_in_return(s.body)):
+            rest = _normalize_early_returns(stmts[i + 1:], at_function_top)
+            if rest:
+                s.orelse = rest
+                out.append(s)
+                return out
+            if at_function_top:
+                s.orelse = [ast.Return(value=ast.Constant(value=None))]
+        out.append(s)
+    return out
+
+
+# -- the transformer -------------------------------------------------------
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.changed = False
+
+    def _n(self) -> int:
+        self.counter += 1
+        return self.counter
+
+    # nested defs keep their own control flow untouched (they are traced
+    # as closures; converting them requires their own convert_to_static)
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        n = self._n()
+        lineno = getattr(node, "lineno", 0)
+        body, orelse = node.body, node.orelse
+        test_src = ast.unparse(node.test)
+
+        py_arm = (f"if __pt_p{n}:\n{_src(body, '    ')}\n"
+                  + (f"else:\n{_src(orelse, '    ')}" if orelse else ""))
+
+        reason = None
+        if _non_name_bindings(body) or _non_name_bindings(orelse):
+            reason = ("a branch assigns to an attribute/subscript "
+                      "(python-object mutation)")
+        elif _has_node(body + orelse, (ast.Break, ast.Continue),
+                       stop_at_loops=True):
+            reason = "a branch breaks/continues an enclosing loop"
+
+        body2, ret_t = _trailing_return(body)
+        orelse2, ret_f = _trailing_return(orelse)
+        has_inner_ret = _has_node(body2 + orelse2, (ast.Return,))
+
+        if reason is None and has_inner_ret:
+            reason = "a branch returns from the middle of its body"
+        elif reason is None and (ret_t is None) != (ret_f is None):
+            reason = ("one branch returns and the other falls through "
+                      "(make both return, or neither)")
+
+        if reason is not None:
+            block = (
+                f"__pt_p{n} = {test_src}\n"
+                f"if __pt_d2s._is_traced_pred(__pt_p{n}):\n"
+                f"    __pt_d2s.unsupported({lineno}, {reason!r})\n"
+                f"{py_arm}"
+            )
+            self.changed = True
+            return ast.parse(block).body
+
+        if ret_t is not None:
+            # both branches return: the traced arm returns cond(...).
+            # Helper params seed branch-local names from enclosing scope so
+            # read-then-assign patterns (`x = x + 1`) resolve like the
+            # original code did.
+            assigned = sorted(_assigned_names(body2) | _assigned_names(orelse2))
+            seeds = ", ".join(
+                f"{v}=__pt_d2s._get(lambda: {v})" for v in assigned)
+            block = (
+                f"__pt_p{n} = {test_src}\n"
+                f"def __pt_t{n}({seeds}):\n{_src(body2, '    ')}\n"
+                f"    return {ast.unparse(ret_t)}\n"
+                f"def __pt_f{n}({seeds}):\n{_src(orelse2, '    ')}\n"
+                f"    return {ast.unparse(ret_f)}\n"
+                f"if __pt_d2s._is_traced_pred(__pt_p{n}):\n"
+                f"    return __pt_d2s.run_cond(__pt_p{n}, __pt_t{n}, __pt_f{n})\n"
+                f"else:\n"
+                + textwrap.indent(py_arm, "    ")
+            )
+            self.changed = True
+            return ast.parse(block).body
+
+        assigned = sorted(_assigned_names(body) | _assigned_names(orelse))
+        if not assigned:
+            block = (
+                f"__pt_p{n} = {test_src}\n"
+                f"if __pt_d2s._is_traced_pred(__pt_p{n}):\n"
+                f"    __pt_d2s.unsupported({lineno}, "
+                f"'branches bind no variables and return nothing "
+                f"(side-effect-only branch)')\n"
+                f"{py_arm}"
+            )
+            self.changed = True
+            return ast.parse(block).body
+
+        vars_tuple = ", ".join(assigned)
+        seeds = ", ".join(f"{v}=__pt_d2s._get(lambda: {v})" for v in assigned)
+        block = (
+            f"__pt_p{n} = {test_src}\n"
+            f"def __pt_t{n}({seeds}):\n{_src(body, '    ')}\n"
+            f"    return ({vars_tuple},)\n"
+            f"def __pt_f{n}({seeds}):\n{_src(orelse, '    ')}\n"
+            f"    return ({vars_tuple},)\n"
+            f"if __pt_d2s._is_traced_pred(__pt_p{n}):\n"
+            f"    ({vars_tuple},) = __pt_d2s.run_cond("
+            f"__pt_p{n}, __pt_t{n}, __pt_f{n})\n"
+            f"else:\n"
+            + textwrap.indent(py_arm, "    ")
+        )
+        self.changed = True
+        return ast.parse(block).body
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        n = self._n()
+        lineno = getattr(node, "lineno", 0)
+        test_src = ast.unparse(node.test)
+        py_arm = (f"while {test_src}:\n{_src(node.body, '    ')}\n"
+                  + (f"else:\n{_src(node.orelse, '    ')}"
+                     if node.orelse else ""))
+
+        reason = None
+        if node.orelse:
+            reason = "while/else is not supported for tensor predicates"
+        elif _has_node(node.body, (ast.Break, ast.Continue),
+                       stop_at_loops=True):
+            reason = "break/continue in a tensor-predicate loop"
+        elif _has_node(node.body, (ast.Return,)):
+            reason = "return inside a tensor-predicate loop"
+        elif _non_name_bindings(node.body):
+            reason = ("the loop body assigns to an attribute/subscript "
+                      "(python-object mutation)")
+
+        assigned = sorted(_assigned_names(node.body))
+        if reason is None and not assigned:
+            reason = "the loop body binds no variables"
+
+        if reason is not None:
+            # untransformable shape: keep the ORIGINAL loop untouched (no
+            # extra predicate evaluation — it may have side effects); if it
+            # actually hits a traced predicate, Tensor.__bool__ raises and
+            # is converted into the precise source-line error
+            block = (
+                f"try:\n"
+                + textwrap.indent(py_arm, "    ") + "\n"
+                f"except RuntimeError as __pt_e{n}:\n"
+                f"    __pt_d2s.reraise_unsupported(__pt_e{n}, {lineno}, "
+                f"{reason!r})"
+            )
+            self.changed = True
+            return ast.parse(block).body
+
+        # supported shape (no break/continue/return): dispatch on the
+        # PREDICATE value only — python-valued predicates keep python
+        # control flow (traced loop VARS just unroll, a valid trace), and
+        # the probe evaluation is REUSED as the loop's first test so the
+        # predicate is never evaluated an extra time
+        vars_tuple = ", ".join(assigned)
+        inits = ", ".join(f"__pt_d2s._get(lambda: {v})" for v in assigned)
+        block = (
+            f"def __pt_wc{n}({vars_tuple}):\n    return {test_src}\n"
+            f"def __pt_wb{n}({vars_tuple}):\n{_src(node.body, '    ')}\n"
+            f"    return ({vars_tuple},)\n"
+            f"__pt_c{n} = {test_src}\n"
+            f"if __pt_d2s._is_traced_pred(__pt_c{n}):\n"
+            f"    ({vars_tuple},) = __pt_d2s.run_while("
+            f"__pt_wc{n}, __pt_wb{n}, ({inits},), "
+            f"max_iter=__pt_d2s.DEFAULT_MAX_ITER)\n"
+            f"else:\n"
+            f"    while __pt_c{n}:\n"
+            f"{_src(node.body, '        ')}\n"
+            f"        __pt_c{n} = {test_src}"
+        )
+        self.changed = True
+        return ast.parse(block).body
+
+
+# tensor-predicate `while` under a DIFFERENTIATED trace needs a static trip
+# bound (lax.scan); None -> lax.while_loop (forward-only).  Users set this
+# via paddle_tpu.jit.dy2static.set_default_max_iter(N).
+DEFAULT_MAX_ITER: Optional[int] = None
+
+
+def set_default_max_iter(n: Optional[int]):
+    global DEFAULT_MAX_ITER
+    DEFAULT_MAX_ITER = n
+
+
+# -- entry point -----------------------------------------------------------
+
+def convert_to_static(fn):
+    """Return ``fn`` with native if/while rewritten for trace dispatch, or
+    ``fn`` unchanged when it has no control flow / no retrievable source.
+
+    The transform is semantics-preserving for python-valued predicates (the
+    original control flow runs); only traced-Tensor predicates divert into
+    static.nn.cond / while_loop."""
+    if inspect.ismethod(fn):
+        import types
+
+        converted = convert_to_static(fn.__func__)
+        if converted is fn.__func__:
+            return fn
+        return types.MethodType(converted, fn.__self__)
+    if not inspect.isfunction(fn):
+        return fn
+    if fn.__name__ == "<lambda>":
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, ast.FunctionDef):
+        return fn
+    if not any(isinstance(n, (ast.If, ast.While)) for n in ast.walk(fdef)):
+        return fn
+    if any(isinstance(n, (ast.Global, ast.Nonlocal)) for n in ast.walk(fdef)):
+        return fn  # name-scope rewrites would break global/nonlocal decls
+
+    tr = _ControlFlowTransformer()
+    fdef.decorator_list = []
+    fdef.body = _normalize_early_returns(fdef.body, at_function_top=True)
+    # visit the BODY, not the def itself — visit_FunctionDef is the guard
+    # that keeps nested defs untouched and would skip the whole function
+    new_body: List[ast.stmt] = []
+    for s in fdef.body:
+        r = tr.visit(s)
+        if isinstance(r, list):
+            new_body.extend(r)
+        elif r is not None:
+            new_body.append(r)
+    fdef.body = new_body
+    new_fdef = fdef
+    if not tr.changed:
+        return fn
+    ast.fix_missing_locations(new_fdef)
+
+    freevars = fn.__code__.co_freevars
+    inner = ast.unparse(new_fdef)
+    factory_src = (
+        f"def __pt_factory({', '.join(freevars)}):\n"
+        + textwrap.indent(inner, "    ")
+        + f"\n    return {fn.__name__}"
+    )
+    # exec with fn's REAL globals mapping (not a snapshot) so helpers
+    # defined after the decorated function — and later reassignments of
+    # module globals — resolve exactly like they do in the original.
+    # `__pt_d2s` is installed once per module; `__pt_factory` is removed.
+    import sys as _sys
+    ns = fn.__globals__
+    ns["__pt_d2s"] = _sys.modules[__name__]
+    try:
+        exec(compile(factory_src, f"<dy2static {fn.__name__}>", "exec"), ns)
+        cells = [c.cell_contents for c in (fn.__closure__ or ())]
+        new_fn = ns.pop("__pt_factory")(*cells)
+    except Exception:
+        ns.pop("__pt_factory", None)
+        return fn
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    new_fn.__doc__ = fn.__doc__
+    new_fn.__module__ = fn.__module__
+    new_fn.__qualname__ = fn.__qualname__
+    new_fn.__dict__.update(fn.__dict__)
+    new_fn.__wrapped__ = fn
+    return new_fn

@@ -1,7 +1,8 @@
 """nn namespace (reference: python/paddle/nn/__init__.py)."""
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
-from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue, clip_grad_norm_  # noqa: F401
+from . import utils  # noqa: F401
 from .layer import Layer, get_default_dtype, set_default_dtype  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 from .modules.activation import (  # noqa: F401

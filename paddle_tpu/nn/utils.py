@@ -1,0 +1,5 @@
+"""nn.utils namespace (reference: python/paddle/nn/utils/__init__.py —
+clip_grad_norm_ lives at paddle.nn.utils.clip_grad_norm_)."""
+from .clip import clip_grad_norm_  # noqa: F401
+
+__all__ = ["clip_grad_norm_"]

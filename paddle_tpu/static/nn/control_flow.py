@@ -162,34 +162,43 @@ def cond(pred, true_fn: Callable, false_fn: Callable, name=None,
 
 
 def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
-               is_test=False, name=None):
+               is_test=False, name=None, max_iter=None):
     """Reference control_flow.py while_loop: iterate ``body_fn`` while
     ``cond_fn(*loop_vars)`` holds.
 
     Eagerly this is a Python loop.  Under tracing it lowers to
-    ``lax.while_loop``; XLA's while is forward-only, so differentiating
-    through a traced while_loop is rejected with guidance to use a
-    bounded ``lax.scan``-style loop (matching XLA semantics rather than
-    the reference's while_grad op).
+    ``lax.while_loop``; XLA's while is forward-only (no transpose), so to
+    DIFFERENTIATE through a data-dependent loop pass ``max_iter=N``: the
+    loop lowers to a ``lax.scan`` of N masked steps (iterations past the
+    dynamic exit keep values unchanged), which reverse-differentiates like
+    any scan — the TPU-native analog of the reference's while_grad op
+    (operators/controlflow/while_op.cc) with a static trip bound.
     """
     loop_vars = [ensure_tensor(v) for v in loop_vars]
     traced = any(_is_traced(v._value) for v in loop_vars)
     if not traced:
         vals = list(loop_vars)
+        it = 0
         while bool(np.asarray(ensure_tensor(cond_fn(*vals))._value)):
+            if max_iter is not None and it >= max_iter:
+                break
             out = body_fn(*vals)
             if not isinstance(out, (list, tuple)):
                 out = [out]
             vals = [ensure_tensor(v) for v in out]
+            it += 1
         return vals
+
+    if max_iter is not None:
+        return _bounded_while(cond_fn, body_fn, loop_vars, int(max_iter))
 
     if dispatch.is_grad_enabled() and any(
             not v.stop_gradient for v in loop_vars):
         raise NotImplementedError(
             "while_loop over traced values is not reverse-differentiable "
-            "(XLA while has no transpose). Run it under no_grad, or "
-            "restructure as a bounded loop (e.g. lax.scan via "
-            "paddle_tpu ops) for training")
+            "(XLA while has no transpose). Run it under no_grad, or pass "
+            "max_iter=N to lower to a masked lax.scan, which "
+            "differentiates")
 
     _, c_caps = _run_captured(cond_fn, tuple(loop_vars))
     body_res, b_caps = _run_captured(body_fn, tuple(loop_vars))
@@ -219,6 +228,54 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
         return jax.lax.while_loop(cond_w, body_w, tuple(lv))
 
     outs = dispatch.apply_nondiff(raw, *loop_vars, *c_caps, *b_caps)
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def _bounded_while(cond_fn: Callable, body_fn: Callable,
+                   loop_vars: List[Tensor], max_iter: int):
+    """Differentiable data-dependent loop: a ``lax.scan`` of ``max_iter``
+    masked steps.  Each step computes ``active = active & cond(vals)`` and
+    selects ``body(vals)`` where active else passes values through, so the
+    dynamic exit is honored while the trace stays a fixed-length scan that
+    XLA can reverse-differentiate (unlike ``lax.while_loop``)."""
+    _, c_caps = _run_captured(cond_fn, tuple(loop_vars))
+    body_res, b_caps = _run_captured(body_fn, tuple(loop_vars))
+    if not isinstance(body_res, (list, tuple)):
+        body_res = [body_res]
+    if len(body_res) != len(loop_vars):
+        raise ValueError(
+            f"while_loop body must return as many values as loop_vars "
+            f"({len(body_res)} vs {len(loop_vars)})")
+    for a, b in zip(body_res, loop_vars):
+        if tuple(a._value.shape) != tuple(b._value.shape):
+            raise ValueError(
+                f"while_loop(max_iter=...) requires shape-stable loop vars, "
+                f"got {tuple(b._value.shape)} -> {tuple(a._value.shape)}")
+
+    n_loop = len(loop_vars)
+    pure_c = _pure_branch(cond_fn, c_caps, n_loop, 1)
+    pure_b = _pure_branch(body_fn, b_caps, n_loop, n_loop)
+
+    def raw(*all_raws):
+        lv = all_raws[:n_loop]
+        cc = all_raws[n_loop:n_loop + len(c_caps)]
+        bc = all_raws[n_loop + len(c_caps):]
+
+        def step(carry, _):
+            active, vals = carry
+            (c,) = pure_c(vals, cc)
+            act = jnp.logical_and(active, jnp.reshape(c, ()).astype(bool))
+            new_vals = pure_b(vals, bc)
+            vals = tuple(
+                jnp.where(act, nv, v) for nv, v in zip(new_vals, vals))
+            return (act, vals), None
+
+        (_, final), _ = jax.lax.scan(
+            step, (jnp.asarray(True), tuple(lv)), None, length=max_iter)
+        return final
+
+    outs = dispatch.apply(raw, *loop_vars, *c_caps, *b_caps,
+                          op_name="while_loop_bounded")
     return list(outs) if isinstance(outs, tuple) else [outs]
 
 

@@ -33,12 +33,32 @@ __all__ = ["Tensor", "Parameter", "to_tensor"]
 # keys) apart from tensors created during the traced call.
 _GENERATION = [0]
 
+# Abstract-scout bookkeeping (jit.to_static's zero-compute capture pass, see
+# paddle_tpu/jit/api.py): while active, every Tensor creation is logged with
+# its initial raw value, and every ``_set_value`` records the pre-mutation
+# value once.  This lets the scout restore ALL python-visible state after
+# tracing under jax.eval_shape — no eager warmup step (and no eager-step HBM
+# residency) is ever needed.  Thread-local (like dispatch._TraceState): a
+# concurrent thread's tensor writes must not be captured — or rolled back —
+# by another thread's scout.
+import threading as _threading
+
+
+class _ScoutState(_threading.local):
+    def __init__(self):
+        self.creation_log = None
+        self.orig_values = None
+        self.orig_grads = None
+
+
+_SCOUT_STATE = _ScoutState()
+
 
 class Tensor:
     __slots__ = (
         "_value",
         "stop_gradient",
-        "grad",
+        "_grad",
         "_grad_node",
         "_output_index",
         "_hooks",
@@ -52,18 +72,35 @@ class Tensor:
     def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
         self._value = value
         self.stop_gradient = stop_gradient
-        self.grad: Optional["Tensor"] = None
+        self._grad: Optional["Tensor"] = None
         self._grad_node = None
         self._output_index = 0
         self._hooks = {}
         self._next_hook_id = 0
         self._gen = _GENERATION[0]
         self.name = name
+        _cl = _SCOUT_STATE.creation_log
+        if _cl is not None:
+            _cl[id(self)] = (self, value)
 
     # -- raw value plumbing ------------------------------------------------
     @property
     def value(self):
         return self._value
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, g: Optional["Tensor"]):
+        # abstract-scout bookkeeping: record the PRE-trace grad binding once
+        # so the scout can restore it exactly (a param's accumulated eager
+        # grad must survive a zero-side-effect capture pass)
+        _og = _SCOUT_STATE.orig_grads
+        if _og is not None and id(self) not in _og:
+            _og[id(self)] = (self, self._grad)
+        self._grad = g
 
     def _set_value(self, raw):
         """Rebind the underlying array (in-place update semantics).
@@ -72,6 +109,13 @@ class Tensor:
         functionalize it (return the new value as a program output)."""
         from .ops import dispatch as _dispatch
 
+        _ov = _SCOUT_STATE.orig_values
+        if _ov is not None and id(self) not in _ov:
+            # (tensor, pre-mutation value): keyed off the raw _set_value hook
+            # rather than the jit mutation log, because nested tracing scopes
+            # (static.nn.cond branch functionalization) swap the mutation log
+            # out — the scout must still restore those tensors afterwards.
+            _ov[id(self)] = (self, self._value)
         self._value = raw
         log = _dispatch._trace_state.mutation_log
         if log is not None:

@@ -1,0 +1,177 @@
+"""AST dy2static: NATIVE python if/while over traced tensors compile.
+
+Reference: python/paddle/jit/dy2static/ast_transformer.py + the BERT
+dygraph_to_static fixture (test/dygraph_to_static/test_bert.py) — the
+acceptance bar is compiled == eager with UNMODIFIED model code."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.jit.dy2static import Dy2StaticUnsupported, set_default_max_iter
+
+
+def test_native_if_bert_style_branch():
+    """The round-3 BERT fixture, with static_nn.cond replaced by a NATIVE
+    python if — the dy2static AST pass must functionalize it."""
+
+    class TinyBertWithBranch(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            pt.seed(11)
+            self.emb = pt.nn.Embedding(64, 16)
+            self.fc = pt.nn.Linear(16, 16)
+            self.head = pt.nn.Linear(16, 2)
+
+        def forward(self, ids):
+            h = self.emb(ids)
+            h = pt.ops.mean(h, axis=1)
+            if pt.ops.mean(h) > 0.0:
+                h = pt.nn.functional.gelu(self.fc(h))
+            else:
+                h = pt.nn.functional.relu(self.fc(h)) * 0.5
+            return self.head(h)
+
+    model = TinyBertWithBranch()
+    ids = pt.to_tensor(np.random.RandomState(0).randint(0, 64, (4, 8)),
+                       dtype="int64")
+    eager = model(ids).numpy()
+    compiled_fwd = pt.jit.to_static(model.forward)
+    for _ in range(3):
+        np.testing.assert_allclose(compiled_fwd(ids).numpy(), eager,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_native_if_read_then_assign():
+    def fn(x):
+        y = x * 2.0
+        if pt.ops.sum(x) > 0.0:
+            y = y + 1.0  # read-then-assign of an enclosing local
+        return pt.ops.sum(y)
+
+    compiled = pt.jit.to_static(fn)
+    xp = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+    xn = pt.to_tensor(np.array([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(float(compiled(xp)), float(fn(xp)), rtol=1e-6)
+    np.testing.assert_allclose(float(compiled(xn)), float(fn(xn)), rtol=1e-6)
+
+
+def test_native_if_both_branches_return():
+    def fn(x):
+        if pt.ops.sum(x) > 0.0:
+            return x * 2.0
+        else:
+            return x - 1.0
+
+    compiled = pt.jit.to_static(fn)
+    xp = pt.to_tensor(np.array([3.0], np.float32))
+    xn = pt.to_tensor(np.array([-3.0], np.float32))
+    np.testing.assert_allclose(compiled(xp).numpy(), fn(xp).numpy())
+    np.testing.assert_allclose(compiled(xn).numpy(), fn(xn).numpy())
+
+
+def test_native_elif_chain():
+    def fn(x):
+        s = pt.ops.sum(x)
+        if s > 10.0:
+            out = x * 3.0
+        elif s > 0.0:
+            out = x * 2.0
+        else:
+            out = x * -1.0
+        return pt.ops.sum(out)
+
+    compiled = pt.jit.to_static(fn)
+    for arr in ([20.0], [1.0], [-5.0]):
+        x = pt.to_tensor(np.array(arr, np.float32))
+        np.testing.assert_allclose(float(compiled(x)), float(fn(x)),
+                                   rtol=1e-6)
+
+
+def test_native_while_accumulates():
+    def fn(x):
+        i = pt.to_tensor(0)
+        with pt.no_grad():
+            while i < 4:
+                x = x * 2.0
+                i = i + 1
+        return pt.ops.sum(x)
+
+    compiled = pt.jit.to_static(fn)
+    x = pt.to_tensor(np.array([1.5], np.float32))
+    np.testing.assert_allclose(float(compiled(x)), 1.5 * 16, rtol=1e-6)
+    np.testing.assert_allclose(float(compiled(x)), 1.5 * 16, rtol=1e-6)
+
+
+def test_native_while_differentiable_with_max_iter():
+    set_default_max_iter(8)
+    try:
+        def fn(x):
+            i = pt.to_tensor(0)
+            while i < 3:
+                x = x * 2.0
+                i = i + 1
+            loss = pt.ops.sum(x)
+            loss.backward()
+            return loss, x.grad
+
+        compiled = pt.jit.to_static(fn)
+        x = pt.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+        loss, _ = compiled(x)
+        np.testing.assert_allclose(float(loss), 8.0, rtol=1e-6)
+    finally:
+        set_default_max_iter(None)
+
+
+def test_python_predicates_untouched():
+    """if/while over plain python values keep exact python semantics
+    (side effects, break) — no tensor machinery involved."""
+    log = []
+
+    def fn(x, flag):
+        if flag:  # python bool
+            log.append("taken")
+            x = x + 1.0
+        n = 0
+        while n < 3:
+            if n == 1:
+                n += 2
+                continue
+            n += 1
+        return x * float(n)
+
+    compiled = pt.jit.to_static(fn)
+    x = pt.to_tensor(np.array([1.0], np.float32))
+    out = compiled(x, True)
+    assert log  # python side effect ran
+    np.testing.assert_allclose(out.numpy(), [6.0], rtol=1e-6)
+
+
+def test_unsupported_pattern_names_source_line():
+    """break inside a tensor-predicate while: eager (undecorated) python
+    semantics are untouched; to_static raises a clear error naming the
+    source line on the FIRST call (the reference dy2static also errors at
+    conversion, not after N eager calls)."""
+
+    def fn(x):
+        i = pt.to_tensor(0)
+        while i < 5:
+            if int(i) == 2:  # host read: cannot trace
+                break
+            i = i + 1
+        return x
+
+    x = pt.to_tensor(np.array([1.0], np.float32))
+    np.testing.assert_allclose(fn(x).numpy(), [1.0])  # eager untouched
+
+    def traced_bad(x):
+        s = pt.ops.sum(x)
+        while s > 0.0:
+            if True:
+                break
+            s = s - 1.0
+        return x
+
+    compiled = pt.jit.to_static(traced_bad)
+    with pytest.raises((Dy2StaticUnsupported, RuntimeError)) as ei:
+        compiled(x)
+    assert "line" in str(ei.value) or "control flow" in str(ei.value)

@@ -73,6 +73,38 @@ def test_gpt_to_static_train_step_matches_eager():
     np.testing.assert_allclose(eager_losses, static_losses, rtol=2e-4, atol=2e-5)
 
 
+def test_gpt_to_static_with_grad_clip_matches_eager():
+    """Abstract-scout regression: clip_grad_norm_ mutates grads CREATED
+    during the trace (p.grad._set_value) — those must be classified as
+    call-local, not as persistent lazily-created state (the strong refs
+    held by the scout's own mutation/orig-value logs once defeated the
+    aliveness check and poisoned the compile)."""
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    pt.seed(13)
+    m1 = GPTForPretraining(cfg)
+    pt.seed(13)
+    m2 = GPTForPretraining(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    o1 = pt.optimizer.SGD(learning_rate=1e-2, parameters=m1.parameters())
+    o2 = pt.optimizer.SGD(learning_rate=1e-2, parameters=m2.parameters())
+    ids, labels = _batch(cfg)
+
+    def step(model, opt, ids, labels):
+        loss = crit(model(ids), labels)
+        loss.backward()
+        pt.nn.clip_grad_norm_(model.parameters(), max_norm=1.0)
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    static_step = pt.jit.to_static(lambda i, l: step(m2, o2, i, l))
+    eager_losses, static_losses = [], []
+    for _ in range(3):
+        eager_losses.append(float(step(m1, o1, ids, labels)))
+        static_losses.append(float(static_step(ids, labels)))
+    np.testing.assert_allclose(eager_losses, static_losses, rtol=2e-4, atol=2e-5)
+
+
 def test_gpt_loss_mask():
     cfg = gpt_tiny()
     crit = GPTPretrainingCriterion(cfg)
