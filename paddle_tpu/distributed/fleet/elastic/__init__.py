@@ -49,7 +49,32 @@ class ElasticManager:
         self._on_change = on_change
         self._stop = threading.Event()
         # rank -> (last counter value seen, local monotonic time it changed)
+        # _seen and the last-computed membership are shared between user
+        # calls to alive_nodes() and the watch thread; ALL detection state
+        # is guarded by _lock and on_change fires from the DETECTION SITE
+        # (single-detector contract — round-3 race: the user's poll flipped
+        # a node to dead and the watch thread's next-tick comparison fired
+        # the callback only after the caller had already observed the
+        # change).
+        # Two-lock notification design.  _lock guards detection state
+        # (_seen) and stamps each computed membership with a sequence
+        # number; _notify_lock serializes callback delivery and keeps it
+        # ordered via the sequence (a stale racer is skipped, so callbacks
+        # can never be delivered out of order).  The callback itself runs
+        # holding only _notify_lock — NOT _lock — so user code inside
+        # on_change may take its own locks and call alive_nodes()/health()
+        # without a cross-lock deadlock.  On callback failure the
+        # last-notified membership is left unchanged so the next detection
+        # re-fires.
         self._seen: Dict[int, tuple] = {}
+        self._lock = threading.Lock()
+        # RLock: an on_change callback may itself call alive_nodes()/
+        # health() (re-entering _deliver on the same thread) without
+        # deadlocking; cross-thread ordering is still serialized
+        self._notify_lock = threading.RLock()
+        self._seq = 0
+        self._notified_seq = 0
+        self._notified_set: Optional[frozenset] = None
         self._threads: List[threading.Thread] = []
         self.enabled = True
 
@@ -84,35 +109,69 @@ class ElasticManager:
 
     # -- watch -----------------------------------------------------------
     def alive_nodes(self) -> List[int]:
-        now = time.monotonic()
-        alive = []
-        for r in range(self._max):
-            key = f"elastic/beat/{r}"
-            try:
-                if not self._store.check(key):
+        """Compute current membership; if it CHANGED since the last
+        computation (by any caller), fire on_change before returning —
+        whoever detects, notifies, so a user poll can never observe a
+        membership the callback hasn't been told about."""
+        with self._lock:
+            now = time.monotonic()
+            alive = []
+            for r in range(self._max):
+                key = f"elastic/beat/{r}"
+                try:
+                    if not self._store.check(key):
+                        continue
+                    # add(key, 0) reads the counter without bumping it
+                    ctr = self._store.add(key, 0)
+                except Exception:
                     continue
-                # add(key, 0) reads the counter without bumping it
-                ctr = self._store.add(key, 0)
-            except Exception:
-                continue
-            last = self._seen.get(r)
-            if last is None or last[0] != ctr:
-                self._seen[r] = (ctr, now)
-                alive.append(r)
-            elif now - last[1] <= self._ttl:
-                alive.append(r)
+                last = self._seen.get(r)
+                if last is None or last[0] != ctr:
+                    self._seen[r] = (ctr, now)
+                    alive.append(r)
+                elif now - last[1] <= self._ttl:
+                    alive.append(r)
+            cur = frozenset(alive)
+            self._seq += 1
+            seq = self._seq
+        self._deliver(cur, seq)
         return alive
 
+    def _deliver(self, cur: frozenset, seq: int):
+        with self._notify_lock:
+            if seq <= self._notified_seq:
+                return  # a newer detection already delivered
+            self._notified_seq = seq
+            prev = self._notified_set
+            if prev is None:
+                # very first computation: record silently.  prev may later
+                # be the EMPTY set (total store outage) — recovery from
+                # that IS a change and notifies.
+                self._notified_set = cur
+                return
+            if cur == prev or self._on_change is None:
+                self._notified_set = cur
+                return
+            try:
+                self._on_change(sorted(cur))
+                self._notified_set = cur
+            except Exception as e:
+                # leave _notified_set at prev so the next detection
+                # re-fires — a transient callback failure must not
+                # permanently swallow the membership change (nor propagate
+                # into user calls of alive_nodes()/health()/wait())
+                import sys
+                sys.stderr.write(
+                    f"[paddle_tpu.elastic] on_change failed: {e!r}; "
+                    "will retry on next detection\n")
+
     def _watch_loop(self):
-        prev = set()
+        # periodic detection only: notification lives in alive_nodes()
         while not self._stop.wait(self._interval):
             try:
-                cur = set(self.alive_nodes())
+                self.alive_nodes()
             except Exception:
                 continue
-            if prev and cur != prev and self._on_change is not None:
-                self._on_change(sorted(cur))
-            prev = cur
 
     # -- reference-API surface ------------------------------------------
     def health(self) -> str:

@@ -1,5 +1,8 @@
 #!/bin/bash
 # CPU test runner: sanitized env (no TPU site-hook), 8 virtual devices.
+#
+# Default: the FAST set (deselects @pytest.mark.slow — multi-minute XLA
+# compiles).  Pass --all to run everything (CI budget), or any pytest args.
 export JAX_PLATFORMS=cpu
 export PYTHONPATH=$(python - << 'PY'
 import os
@@ -8,4 +11,9 @@ PY
 )
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 export JAX_COMPILATION_CACHE_DIR=/tmp/paddle_tpu_jax_cache
-exec python -m pytest "$@"
+
+if [ "$1" = "--all" ]; then
+    shift
+    exec python -m pytest "$@"
+fi
+exec python -m pytest -m "not slow" "$@"

@@ -24,14 +24,17 @@ def test_membership_and_failure_detection():
     time.sleep(0.6)
     assert sorted(m0.alive_nodes()) == [0, 1]
     assert m0.health() == ElasticStatus.COMPLETED
-    # node 1 dies (heartbeat stops); TTL expires -> membership change fires
+    # node 1 dies (heartbeat stops); TTL expires -> membership change fires.
+    # Wait on the CALLBACK (the notification contract), not wall-clock: the
+    # detector that observes the change must fire on_change before any
+    # caller can see the shrunken membership.
     m1.stop()
-    deadline = time.time() + 5
-    while time.time() < deadline and 1 in m0.alive_nodes():
+    deadline = time.time() + 10
+    while time.time() < deadline and not any(a == [0] for a in changes):
         time.sleep(0.2)
+    assert any(alive == [0] for alive in changes)
     assert m0.alive_nodes() == [0]
     assert m0.health() in (ElasticStatus.RESTART, ElasticStatus.HOLD)
-    assert any(alive == [0] for alive in changes)
     m0.stop()
 
 

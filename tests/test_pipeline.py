@@ -158,8 +158,13 @@ def test_pipeline_interleave_grad_matches(pp_mesh):
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_gpt_stacked_interleave_trains(no_mesh):
-    """GPT stacked decoder with virtual_pp_degree=2 on a pp mesh trains."""
+    """GPT stacked decoder with virtual_pp_degree=2 on a pp mesh trains.
+
+    slow: the interleaved wavefront fwd+bwd is one huge XLA graph on the
+    8-device CPU mesh (>10 min compile); the fast set covers interleave
+    correctness via test_pipeline_interleave_{matches_scan,grad_matches}."""
     prev = M._global_mesh
     try:
         mesh = M.build_mesh({"pp": 2, "dp": 2})
