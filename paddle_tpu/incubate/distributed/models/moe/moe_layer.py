@@ -81,9 +81,20 @@ class MoELayer(Layer):
 
     def __init__(self, d_model, num_experts=None, experts: Optional[ExpertFFN] = None,
                  gate="gshard", top_k=2, capacity_factor=None, d_hidden=None,
-                 group=None, recompute_interval=0, name=None):
+                 group=None, recompute_interval=0, dispatch_mode="dense",
+                 name=None):
         super().__init__()
         self.d_model = d_model
+        # 'dense': GShard einsum dispatch, GSPMD derives the collectives.
+        # 'alltoall': explicit lax.all_to_all over the 'ep' mesh axis inside
+        # a shard_map — the TPU-native analog of the reference's
+        # global_scatter/global_gather (moe_layer.py:117,138), with the
+        # capacity-overflow count exposed via self.last_overflow.
+        if dispatch_mode not in ("dense", "alltoall"):
+            raise ValueError(f"dispatch_mode must be 'dense' or 'alltoall', "
+                             f"got {dispatch_mode!r}")
+        self.dispatch_mode = dispatch_mode
+        self.last_overflow: Optional[Tensor] = None
         if experts is None:
             assert num_experts is not None
             experts = ExpertFFN(num_experts, d_model, d_hidden or 4 * d_model)
@@ -105,16 +116,15 @@ class MoELayer(Layer):
         self.aux_loss: Optional[Tensor] = None
 
     def forward(self, x: Tensor) -> Tensor:
-        """x: [B, S, H] (or [T, H]). Returns same shape; sets self.aux_loss."""
-        orig_shape = x.shape
+        """x: [B, S, H] (or [T, H]). Returns same shape; sets self.aux_loss
+        and self.last_overflow (count of capacity-dropped assignments)."""
         E, K, cf = self.num_experts, self.top_k, self.capacity_factor
         logits = self.gate(x)  # [..., E]
 
-        def route(xr, lg):
-            T = int(np.prod(lg.shape[:-1]))
-            xt = xr.reshape(T, -1)
-            lt = lg.reshape(T, E)
-            C = max(1, int(np.ceil(K * T / E * cf)))
+        def route(xt, lt, C):
+            """xt [T, H], lt [T, E] -> (dispatch [T,E,C], combine [T,E,C],
+            aux scalar, overflow scalar)."""
+            T = xt.shape[0]
             probs = jax.nn.softmax(lt, axis=-1)                      # [T, E]
 
             # top-k expert choice per token
@@ -130,6 +140,7 @@ class MoELayer(Layer):
             within = pos < C
             choice_raw = choice                                       # pre-capacity assignment
             choice = choice * within                                  # drop overflow
+            overflow = jnp.sum(choice_raw) - jnp.sum(choice)
 
             gates = jnp.swapaxes(topv, 0, 1)[..., None] * choice      # [K, T, E]
             denom = jnp.sum(gates, axis=(0, 2), keepdims=True) + 1e-9
@@ -147,23 +158,72 @@ class MoELayer(Layer):
             me = jnp.mean(probs, axis=0)                              # [E]
             frac = jnp.sum(choice_raw[0], axis=0) / max(T, 1)         # [E]
             aux = E * jnp.sum(me * frac)
-
-            ex_in = jnp.einsum("tec,th->ech", dispatch, xt)           # [E, C, H]
-            return dispatch, combine, ex_in, aux
+            return dispatch, combine, aux, overflow
 
         act = {"gelu": lambda a: jax.nn.gelu(a, approximate=True),
                "relu": jax.nn.relu, "silu": jax.nn.silu,
                "swish": jax.nn.silu}[self.experts.activation]
 
-        def moe_fwd(xr, lg, w1, b1, w2, b2):
-            dispatchT, combine, ex_in, aux = route(xr, lg)
+        def expert_ffn(ex_in, w1, b1, w2, b2):
             hmid = jnp.einsum("ech,ehf->ecf", ex_in, w1) + b1[:, None, :]
             hmid = act(hmid)
-            ex_out = jnp.einsum("ecf,efh->ech", hmid, w2) + b2[:, None, :]
-            yt = jnp.einsum("tec,ech->th", combine, ex_out)
-            return yt.reshape(xr.shape), aux
+            return jnp.einsum("ecf,efh->ech", hmid, w2) + b2[:, None, :]
 
-        out, aux = _dispatch.apply(
-            moe_fwd, x, logits, *self.experts.stacked(), op_name="moe_layer")
+        def moe_fwd(xr, lg, w1, b1, w2, b2):
+            T = int(np.prod(lg.shape[:-1]))
+            xt = xr.reshape(T, -1)
+            lt = lg.reshape(T, E)
+            C = max(1, int(np.ceil(K * T / E * cf)))
+            dispatch, combine, aux, overflow = route(xt, lt, C)
+            ex_in = jnp.einsum("tec,th->ech", dispatch, xt)           # [E, C, H]
+            ex_out = expert_ffn(ex_in, w1, b1, w2, b2)
+            yt = jnp.einsum("tec,ech->th", combine, ex_out)
+            return yt.reshape(xr.shape), aux, overflow
+
+        def moe_fwd_alltoall(xr, lg, w1, b1, w2, b2):
+            """Explicit expert-parallel dispatch (reference global_scatter/
+            global_gather): tokens sharded over 'ep', experts sharded over
+            'ep'; two lax.all_to_all collectives move expert slots between
+            peers inside a shard_map."""
+            from .....distributed import mesh as M
+
+            mesh = M.get_mesh()
+            P = jax.sharding.PartitionSpec
+
+            def per_shard(xr_l, lg_l, w1_l, b1_l, w2_l, b2_l):
+                Tl = int(np.prod(lg_l.shape[:-1]))
+                xt = xr_l.reshape(Tl, -1)
+                lt = lg_l.reshape(Tl, E)
+                Cl = max(1, int(np.ceil(K * Tl / E * cf)))
+                dispatch, combine, aux, overflow = route(xt, lt, Cl)
+                ex_in = jnp.einsum("tec,th->ech", dispatch, xt)  # [E, Cl, H]
+                # send each expert's slots to its owner:
+                # [E, Cl, H] -> [E/ep, ep*Cl, H]
+                ex_in = jax.lax.all_to_all(ex_in, "ep", split_axis=0,
+                                           concat_axis=1, tiled=True)
+                ex_out = expert_ffn(ex_in, w1_l, b1_l, w2_l, b2_l)
+                # return slots to their source peers: [E, Cl, H]
+                ex_out = jax.lax.all_to_all(ex_out, "ep", split_axis=1,
+                                            concat_axis=0, tiled=True)
+                yt = jnp.einsum("tec,ech->th", combine, ex_out)
+                aux = jax.lax.pmean(aux, "ep")
+                overflow = jax.lax.psum(overflow, "ep")
+                return yt.reshape(xr_l.shape), aux, overflow
+
+            return jax.shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(P("ep"), P("ep"), P("ep"), P("ep"), P("ep"),
+                          P("ep")),
+                out_specs=(P("ep"), P(), P()),
+                check_vma=False,
+            )(xr, lg, w1, b1, w2, b2)
+
+        use_a2a = (self.dispatch_mode == "alltoall" and _mesh.has_mesh()
+                   and "ep" in _mesh.get_mesh().axis_names
+                   and _mesh.get_mesh().shape["ep"] > 1)
+        fwd = moe_fwd_alltoall if use_a2a else moe_fwd
+        out, aux, overflow = _dispatch.apply(
+            fwd, x, logits, *self.experts.stacked(), op_name="moe_layer")
         self.aux_loss = aux
+        self.last_overflow = overflow
         return out
