@@ -29,6 +29,12 @@ from .strategy import Strategy
 __all__ = ["Engine", "Strategy"]
 
 
+def _jax_devices():
+    import jax
+
+    return jax.devices()
+
+
 def _to_tensor_batch(batch):
     from ...tensor import to_tensor
 
@@ -195,8 +201,53 @@ class Engine:
             if os.path.exists(path + ".pdopt"):
                 self._optimizer.set_state_dict(load(path + ".pdopt"))
 
-    def cost(self, mode="train"):
-        """Cost model stub (reference cost_model.py): returns rough FLOPs of
-        one step from parameter count."""
-        n = sum(int(np.prod(p.shape)) for p in self._model.parameters())
-        return {"flops_per_sample": 6 * n}
+    # -- planning (reference static/engine.py:729 _plan + parallel_tuner) --
+    def _model_spec(self, batch=8):
+        from .planner import ModelSpec
+
+        cfg = getattr(self._model, "config", None)
+        if cfg is not None and hasattr(cfg, "hidden_size"):
+            return ModelSpec.from_gpt_config(cfg, batch=batch)
+        # generic fallback: synthesize a transformer-shaped spec from the
+        # parameter shapes.  hidden = the most FREQUENT dimension among 2-D
+        # weights (the largest dim would pick up the vocab of any embedding
+        # table); vocab = the largest dim seen.
+        from collections import Counter
+
+        shapes = [tuple(p.shape) for p in self._model.parameters()]
+        n = sum(int(np.prod(s)) for s in shapes)
+        dims = Counter(d for s in shapes if len(s) == 2 for d in s)
+        h = dims.most_common(1)[0][0] if dims else 1024
+        vocab = max([max(s) for s in shapes if len(s) == 2] or [32000])
+        layers = max(1, round((n - vocab * h) / (12 * h * h)))
+        return ModelSpec(hidden=h, layers=layers, seq=1024, vocab=vocab,
+                         batch=batch)
+
+    def cost(self, mode="train", batch=8, cluster=None):
+        """Analytic per-candidate cost estimates (reference cost_model.py +
+        parallel_tuner): every dp*mp*pp factorization of the device count,
+        scored by the roofline model, ranked feasible-first."""
+        from .planner import ClusterSpec, plan
+
+        if cluster is None:
+            cluster = ClusterSpec(n_devices=len(_jax_devices()))
+        cands = plan(self._model_spec(batch=batch), cluster)
+        return {"candidates": [c.as_dict() for c in cands],
+                "best": cands[0].mesh if cands else None}
+
+    def plan(self, batch=8, cluster=None):
+        """Pick the best mesh factorization, build + install the mesh, and
+        place the model's parameters by the Megatron row/col rules.
+        Returns the chosen Candidate."""
+        from .planner import ClusterSpec, apply_placement_rules, plan
+
+        if cluster is None:
+            cluster = ClusterSpec(n_devices=len(_jax_devices()))
+        cands = plan(self._model_spec(batch=batch), cluster)
+        best = cands[0]
+        mesh_axes = {ax: n for ax, n in best.mesh.items() if n > 1} or {"dp": 1}
+        mesh = _mesh.build_mesh(mesh_axes)
+        _mesh.set_mesh(mesh)
+        n_placed = apply_placement_rules(self._model, best.mesh)
+        self._planned = (best, n_placed)
+        return best

@@ -1,0 +1,189 @@
+"""Parallelism planner + analytic cost model.
+
+Reference: auto_parallel/static/completion.py:936 (dist-attr propagation),
+tuner/parallel_tuner.py (candidate search), cost_model.py (op-level cost).
+
+TPU-native redesign: the reference searches per-op dist_attrs over a
+ProgramDesc; on TPU the per-op placement is GSPMD's job, so the planning
+problem collapses to picking the MESH FACTORIZATION (dp × mp × pp) and the
+canonical Megatron-style parameter placements for it.  The cost model is
+the scaling-book roofline: per-device compute time + TP activation
+all-reduce time on ICI + the pipeline bubble + the (overlappable) DP grad
+all-reduce, with an HBM-residency feasibility gate.
+
+``plan()`` enumerates factorizations of the device count, scores the
+feasible ones, and returns them ranked; ``Engine.cost()``/``Engine.plan``
+drive it (engine.py) and ``apply_placement_rules`` places the model's
+parameters for the winning mesh (dist_matmul's row/col rules, TPU-style).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["ModelSpec", "ClusterSpec", "Candidate", "plan",
+           "apply_placement_rules"]
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """Transformer-shaped workload description (the flagship family)."""
+    hidden: int
+    layers: int
+    seq: int
+    vocab: int
+    batch: int                      # global batch, sequences
+    ffn_mult: int = 4
+    param_bytes: int = 2            # bf16
+    grad_bytes: int = 2
+    moment_bytes: int = 4           # two bf16 moments
+    act_bytes: int = 2
+    n_micro: int = 4                # pipeline microbatches
+
+    @property
+    def params(self) -> int:
+        h, L, V = self.hidden, self.layers, self.vocab
+        per_layer = (4 + 2 * self.ffn_mult) * h * h
+        return L * per_layer + V * h
+
+    @property
+    def step_flops(self) -> float:
+        """Megatron fwd+bwd FLOPs per step (bench.py uses the same form)."""
+        b, s, L, h, V = (self.batch, self.seq, self.layers, self.hidden,
+                         self.vocab)
+        return 72.0 * b * s * L * h * h * (
+            1 + s / (6.0 * h) + V / (12.0 * L * h))
+
+    @classmethod
+    def from_gpt_config(cls, cfg, batch: int, seq: Optional[int] = None):
+        return cls(hidden=cfg.hidden_size, layers=cfg.num_layers,
+                   seq=seq or cfg.max_position_embeddings,
+                   vocab=cfg.vocab_size, batch=batch)
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    """Per-chip numbers; defaults are TPU v5e-class (the bench chip)."""
+    n_devices: int = 8
+    hbm_bytes: float = 16e9
+    flops: float = 197e12          # bf16 peak
+    ici_bw: float = 4.5e10         # bytes/s per link, v5e-class
+    mfu: float = 0.4               # achievable fraction of peak
+
+
+@dataclasses.dataclass
+class Candidate:
+    mesh: Dict[str, int]
+    step_time: float               # seconds, estimated
+    compute_time: float
+    tp_comm_time: float
+    dp_comm_time: float
+    bubble_frac: float
+    mem_bytes: float               # per-device residency
+    feasible: bool
+    reason: str = ""
+
+    def as_dict(self):
+        d = dataclasses.asdict(self)
+        return d
+
+
+def _factorizations(n: int) -> List[Tuple[int, int, int]]:
+    """(dp, mp, pp) triples with dp*mp*pp == n."""
+    out = []
+    for mp in [d for d in range(1, n + 1) if n % d == 0]:
+        rest = n // mp
+        for pp in [d for d in range(1, rest + 1) if rest % d == 0]:
+            out.append((rest // pp, mp, pp))
+    return out
+
+
+def _score(m: ModelSpec, c: ClusterSpec, dp: int, mp: int, pp: int) -> Candidate:
+    mesh = {"dp": dp, "mp": mp, "pp": pp}
+    n = dp * mp * pp
+    h, s, L, V = m.hidden, m.seq, m.layers, m.vocab
+
+    # ---- feasibility: per-device HBM residency ----
+    state_bytes = (m.params / (mp * pp)) * (
+        m.param_bytes + m.grad_bytes + m.moment_bytes)
+    # activation residency per device: microbatch activations on the live
+    # stages, remat'd to layer boundaries — one [b, s, h] boundary per
+    # layer plus roughly one layer's working set (factor 2)
+    b_local = max(1, m.batch // dp)
+    b_micro = max(1, b_local // m.n_micro) if pp > 1 else b_local
+    act_bytes = (L / pp) * b_micro * s * (h / mp) * m.act_bytes * 2
+    mem = state_bytes + act_bytes
+    feasible = mem < 0.9 * c.hbm_bytes
+    reason = "" if feasible else (
+        f"per-device residency {mem/1e9:.1f} GB > 90% of {c.hbm_bytes/1e9:.0f} GB HBM")
+
+    # ---- compute ----
+    compute = m.step_flops / (n * c.flops * c.mfu)
+
+    # ---- TP activation all-reduces (Megatron: 4 per layer fwd+bwd) ----
+    if mp > 1:
+        per_ar = 2.0 * b_local * s * h * m.act_bytes * (mp - 1) / mp / c.ici_bw
+        tp_comm = 4.0 * L / pp * per_ar * (m.n_micro if pp > 1 else 1)
+    else:
+        tp_comm = 0.0
+
+    # ---- pipeline bubble (1F1B): (pp-1)/m extra ----
+    bubble = (pp - 1) / max(m.n_micro, 1) if pp > 1 else 0.0
+
+    # ---- DP grad all-reduce (bf16 grads, ring over dp), half overlapped --
+    if dp > 1:
+        dp_comm = 0.5 * (2.0 * (m.params / (mp * pp)) * m.grad_bytes
+                         * (dp - 1) / dp) / c.ici_bw
+    else:
+        dp_comm = 0.0
+
+    step_time = (compute + tp_comm) * (1 + bubble) + dp_comm
+    return Candidate(mesh=mesh, step_time=step_time, compute_time=compute,
+                     tp_comm_time=tp_comm, dp_comm_time=dp_comm,
+                     bubble_frac=bubble, mem_bytes=mem, feasible=feasible,
+                     reason=reason)
+
+
+def plan(model: ModelSpec, cluster: ClusterSpec) -> List[Candidate]:
+    """All factorizations of the device count, scored; feasible ones first,
+    each group sorted by estimated step time."""
+    cands = [_score(model, cluster, dp, mp, pp)
+             for dp, mp, pp in _factorizations(cluster.n_devices)]
+    return sorted(cands, key=lambda c: (not c.feasible, c.step_time))
+
+
+def apply_placement_rules(model, mesh_axes: Dict[str, int]) -> int:
+    """Megatron-style parameter placement for the chosen mesh (the analog
+    of the reference's dist_matmul/dist_embedding rules applied by the
+    Completer): embeddings vocab-parallel, linear weights alternately
+    column/row parallel over 'mp'.  Returns the number of params sharded."""
+    from ...nn.modules.common import Embedding, Linear
+    from ...ops.sharding_ops import shard_param
+    from .. import mesh as _mesh
+
+    if not _mesh.has_mesh() or mesh_axes.get("mp", 1) <= 1:
+        return 0
+    mp = mesh_axes["mp"]
+    count = 0
+    col_next = True
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, Embedding):
+            w = layer.weight
+            if w.shape[0] % mp == 0:
+                shard_param(w, "mp", None)      # vocab-parallel rows
+                count += 1
+        elif isinstance(layer, Linear):
+            w = layer.weight                      # [in, out]
+            if col_next and w.shape[1] % mp == 0:
+                shard_param(w, None, "mp")      # column parallel
+                b = getattr(layer, "bias", None)
+                if b is not None and b.shape[0] % mp == 0:
+                    shard_param(b, "mp")
+                count += 1
+            elif (not col_next) and w.shape[0] % mp == 0:
+                shard_param(w, "mp", None)      # row parallel
+                count += 1
+            col_next = not col_next
+    return count
