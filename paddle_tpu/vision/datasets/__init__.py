@@ -1,21 +1,46 @@
 """vision.datasets (reference: python/paddle/vision/datasets/).
 
-IMPORTANT: in this zero-egress build every dataset class is a SYNTHETIC
-STAND-IN (random images/labels via FakeData) — "MNIST"/"Cifar10" here
-exercise the data pipeline and model plumbing, they do NOT contain the
-real corpora.  A "model trains on MNIST" result with these classes means
-"the training loop runs end-to-end", not a real-accuracy claim.  Point
-``paddle_tpu.io.Dataset`` subclasses at real files for actual data."""
+MNIST and Cifar10/Cifar100 parse the CANONICAL local file formats
+(reference mnist.py: gzipped IDX images/labels; cifar.py: the
+cifar-10-python tar of pickled batches).  This is a zero-egress build, so
+``download=True`` cannot fetch anything: point the constructors at local
+files (or set PADDLE_TPU_DATA_HOME) and a missing corpus raises a clear
+error instead of silently fabricating data.  ``FakeData`` remains the
+EXPLICIT opt-in synthetic stand-in for plumbing tests."""
 from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
 
 import numpy as np
 
 from ...io import Dataset
 
+__all__ = ["FakeData", "MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+
+
+def _data_home():
+    return os.environ.get(
+        "PADDLE_TPU_DATA_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "dataset"))
+
+
+def _missing(what, paths):
+    return FileNotFoundError(
+        f"{what} not found (looked at: {', '.join(paths)}). This build has "
+        "no network egress — place the canonical files there, pass explicit "
+        "paths, or use paddle_tpu.vision.datasets.FakeData for synthetic "
+        "plumbing tests.")
+
 
 class FakeData(Dataset):
-    """Deterministic synthetic image classification data (stand-in for
-    Cifar10/MNIST downloads, which require network access)."""
+    """Deterministic synthetic image classification data — explicit
+    stand-in for real corpora (exercises pipelines, NOT a real-accuracy
+    claim)."""
 
     def __init__(self, num_samples=1000, image_shape=(3, 224, 224), num_classes=1000,
                  transform=None, seed=0):
@@ -37,21 +62,132 @@ class FakeData(Dataset):
         return self.num_samples
 
 
-class MNIST(FakeData):
-    def __init__(self, mode="train", transform=None, download=False, backend=None):
-        super().__init__(
-            num_samples=60000 if mode == "train" else 10000,
-            image_shape=(1, 28, 28),
-            num_classes=10,
-            transform=transform,
-        )
+def _read_idx_images(path):
+    """Gzipped IDX3 (reference mnist.py parses the same struct layout)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad IDX image magic {magic} (want 2051)")
+        data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+    return data.reshape(n, rows, cols)
 
 
-class Cifar10(FakeData):
-    def __init__(self, mode="train", transform=None, download=False, backend=None):
-        super().__init__(
-            num_samples=50000 if mode == "train" else 10000,
-            image_shape=(3, 32, 32),
-            num_classes=10,
-            transform=transform,
-        )
+def _read_idx_labels(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad IDX label magic {magic} (want 2049)")
+        data = np.frombuffer(f.read(n), dtype=np.uint8)
+    return data.astype(np.int64)
+
+
+class _ArrayDataset(Dataset):
+    """Shared access plumbing for in-memory (images, labels) corpora."""
+
+    images: np.ndarray
+    labels: np.ndarray
+
+    def _finish_init(self, transform, backend):
+        if len(self.images) != len(self.labels):
+            raise ValueError(
+                f"image/label count mismatch: {len(self.images)} vs "
+                f"{len(self.labels)}")
+        self.transform = transform
+        self.backend = backend or "numpy"
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class MNIST(_ArrayDataset):
+    """reference python/paddle/vision/datasets/mnist.py: gzipped IDX
+    image/label pairs; mode 'train' or 'test'."""
+
+    _prefix = "mnist"
+    _files = {
+        "train": ("train-images-idx3-ubyte.gz", "train-labels-idx1-ubyte.gz"),
+        "test": ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"),
+    }
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        assert mode in ("train", "test"), mode
+        if image_path is None or label_path is None:
+            base = os.path.join(_data_home(), self._prefix)
+            img_name, lbl_name = self._files[mode]
+            image_path = image_path or os.path.join(base, img_name)
+            label_path = label_path or os.path.join(base, lbl_name)
+        if not (os.path.exists(image_path) and os.path.exists(label_path)):
+            raise _missing(f"{type(self).__name__} ({mode})",
+                           [image_path, label_path])
+        self.images = _read_idx_images(image_path)
+        self.labels = _read_idx_labels(label_path)
+        self._finish_init(transform, backend)
+
+
+class FashionMNIST(MNIST):
+    """Same IDX layout, different corpus directory (reference
+    fashion_mnist.py)."""
+
+    _prefix = "fashion-mnist"
+
+
+class _CifarBase(_ArrayDataset):
+    """reference python/paddle/vision/datasets/cifar.py: a .tar.gz of
+    pickled batches with b'data' [N, 3072] uint8 + labels."""
+
+    _train_members = ()
+    _test_members = ()
+    _label_keys = (b"labels", b"fine_labels")
+    _default_name = ""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        assert mode in ("train", "test"), mode
+        if data_file is None:
+            data_file = os.path.join(_data_home(), "cifar", self._default_name)
+        if not os.path.exists(data_file):
+            raise _missing(f"{type(self).__name__} ({mode})", [data_file])
+        wanted = self._train_members if mode == "train" else self._test_members
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for member in tf.getmembers():
+                name = os.path.basename(member.name)
+                if name not in wanted:
+                    continue
+                batch = pickle.load(tf.extractfile(member), encoding="bytes")
+                data = np.asarray(batch[b"data"], dtype=np.uint8)
+                images.append(data.reshape(-1, 3, 32, 32))
+                lab = None
+                for k in self._label_keys:
+                    if k in batch:
+                        lab = batch[k]
+                        break
+                labels.append(np.asarray(lab, dtype=np.int64))
+        if not images:
+            raise ValueError(
+                f"{data_file}: no {mode} batches "
+                f"({'/'.join(wanted)}) found in archive")
+        self.images = np.concatenate(images)
+        self.labels = np.concatenate(labels)
+        self._finish_init(transform, backend)
+
+
+class Cifar10(_CifarBase):
+    _train_members = tuple(f"data_batch_{i}" for i in range(1, 6))
+    _test_members = ("test_batch",)
+    _default_name = "cifar-10-python.tar.gz"
+
+
+class Cifar100(_CifarBase):
+    _train_members = ("train",)
+    _test_members = ("test",)
+    _default_name = "cifar-100-python.tar.gz"

@@ -1,0 +1,105 @@
+"""Real dataset parsers: canonical MNIST IDX + CIFAR pickled-batch formats
+over tiny generated fixtures; clear errors when corpora are absent
+(reference: python/paddle/vision/datasets/{mnist,cifar}.py)."""
+import gzip
+import io
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.vision.datasets import Cifar10, Cifar100, FakeData, MNIST
+from paddle_tpu.vision.transforms import Compose, Normalize, ToTensor
+
+
+def _write_mnist_fixture(dirpath, n=7, train=True):
+    os.makedirs(dirpath, exist_ok=True)
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (n, 28, 28), dtype=np.uint8)
+    labels = (np.arange(n) % 10).astype(np.uint8)
+    img_name = ("train-images-idx3-ubyte.gz" if train
+                else "t10k-images-idx3-ubyte.gz")
+    lbl_name = ("train-labels-idx1-ubyte.gz" if train
+                else "t10k-labels-idx1-ubyte.gz")
+    with gzip.open(os.path.join(dirpath, img_name), "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(os.path.join(dirpath, lbl_name), "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return imgs, labels
+
+
+def _write_cifar10_fixture(path, n_per_batch=4):
+    rng = np.random.RandomState(1)
+    with tarfile.open(path, "w:gz") as tf:
+        def add(name, batch):
+            blob = pickle.dumps(batch)
+            info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+
+        for i in range(1, 6):
+            add(f"data_batch_{i}", {
+                b"data": rng.randint(0, 256, (n_per_batch, 3072),
+                                     dtype=np.uint8),
+                b"labels": list((np.arange(n_per_batch) + i) % 10),
+            })
+        add("test_batch", {
+            b"data": rng.randint(0, 256, (n_per_batch, 3072), dtype=np.uint8),
+            b"labels": list(np.arange(n_per_batch) % 10),
+        })
+
+
+def test_mnist_parses_idx(tmp_path):
+    imgs, labels = _write_mnist_fixture(str(tmp_path))
+    ds = MNIST(image_path=str(tmp_path / "train-images-idx3-ubyte.gz"),
+               label_path=str(tmp_path / "train-labels-idx1-ubyte.gz"))
+    assert len(ds) == 7
+    img, lab = ds[3]
+    np.testing.assert_array_equal(img, imgs[3])
+    assert lab == labels[3]
+
+
+def test_mnist_via_data_home_and_transform(tmp_path, monkeypatch):
+    _write_mnist_fixture(str(tmp_path / "mnist"))
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    tfm = Compose([ToTensor(), Normalize(mean=[0.5], std=[0.5])])
+    ds = MNIST(mode="train", transform=tfm)
+    img, _ = ds[0]
+    assert img.shape == (1, 28, 28)
+    assert img.dtype == np.float32
+    assert img.min() >= -1.0 and img.max() <= 1.0
+
+
+def test_mnist_missing_raises_clear_error(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path / "nowhere"))
+    with pytest.raises(FileNotFoundError, match="FakeData"):
+        MNIST(mode="train")
+
+
+def test_cifar10_parses_batches(tmp_path):
+    path = str(tmp_path / "cifar-10-python.tar.gz")
+    _write_cifar10_fixture(path)
+    train = Cifar10(data_file=path, mode="train")
+    test = Cifar10(data_file=path, mode="test")
+    assert len(train) == 20 and len(test) == 4
+    img, lab = train[0]
+    assert img.shape == (3, 32, 32) and img.dtype == np.uint8
+    assert 0 <= int(lab) < 10
+
+
+def test_cifar100_missing_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    with pytest.raises(FileNotFoundError, match="no network egress"):
+        Cifar100(mode="train")
+
+
+def test_fakedata_explicit_opt_in():
+    ds = FakeData(num_samples=10, image_shape=(1, 8, 8), num_classes=3)
+    img, lab = ds[0]
+    assert img.shape == (1, 8, 8)
+    assert 0 <= int(lab) < 3
