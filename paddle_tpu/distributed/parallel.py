@@ -22,6 +22,14 @@ class DataParallel(Layer):
         super().__init__()
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
+        if comm_buffer_size != 25 or last_comm_buffer_size != 1:
+            import sys
+
+            sys.stderr.write(
+                "[paddle_tpu.distributed] DataParallel comm_buffer_size/"
+                "last_comm_buffer_size accepted; inert on XLA (the SPMD "
+                "partitioner schedules and fuses the gradient all-reduce "
+                "itself)\n")
 
     def forward(self, *inputs, **kwargs):
         if _mesh.has_mesh() and "dp" in _mesh.get_mesh().axis_names:

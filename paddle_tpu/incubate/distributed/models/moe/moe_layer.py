@@ -221,6 +221,14 @@ class MoELayer(Layer):
         use_a2a = (self.dispatch_mode == "alltoall" and _mesh.has_mesh()
                    and "ep" in _mesh.get_mesh().axis_names
                    and _mesh.get_mesh().shape["ep"] > 1)
+        if use_a2a:
+            ep = _mesh.get_mesh().shape["ep"]
+            lead = x.shape[0]
+            if E % ep or lead % ep:
+                raise ValueError(
+                    f"alltoall dispatch needs num_experts ({E}) and the "
+                    f"leading token dim ({lead}) divisible by the ep axis "
+                    f"size ({ep})")
         fwd = moe_fwd_alltoall if use_a2a else moe_fwd
         out, aux, overflow = _dispatch.apply(
             fwd, x, logits, *self.experts.stacked(), op_name="moe_layer")
