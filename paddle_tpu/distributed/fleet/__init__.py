@@ -75,12 +75,17 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
+    from .meta_optimizers import apply_strategy_meta_optimizers
     from .meta_parallel.hybrid_optimizer import HybridParallelOptimizer
 
+    strategy = strategy or _user_strategy
+    # meta-optimizer selection pass (reference meta_optimizer_factory):
+    # lars/dgc/localsgd strategy flags wrap the inner optimizer
+    optimizer = apply_strategy_meta_optimizers(optimizer, strategy)
     hcg = get_hybrid_communicate_group()
     if hcg is None:
         return optimizer
-    return HybridParallelOptimizer(optimizer, hcg, strategy or _user_strategy)
+    return HybridParallelOptimizer(optimizer, hcg, strategy)
 
 
 # PS-era APIs kept for surface parity (reference fleet.py server methods)

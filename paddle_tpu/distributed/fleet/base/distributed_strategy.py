@@ -34,8 +34,14 @@ class DistributedStrategy:
         self.sharding_configs = {"stage": 1, "degree": 1}
         self.lamb = False
         self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001,
+                             "lars_weight_decay": 0.0005,
+                             "epsilon": 1e-9,
+                             "exclude_from_weight_decay": []}
         self.dgc = False
+        self.dgc_configs = {"rampup_begin_step": 0, "sparsity": [0.999]}
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 4, "begin_step": 1}
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
         self.nccl_comm_num = 1

@@ -1,2 +1,3 @@
 """incubate: experimental features (reference: python/paddle/incubate/)."""
+from . import asp  # noqa: F401
 from . import nn  # noqa: F401

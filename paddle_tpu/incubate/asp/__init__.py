@@ -1,0 +1,108 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference: python/paddle/incubate/asp/ (prune_model computes n:m masks
+with mask_1d/mask_2d algorithms; decorate() wraps the optimizer with
+OptimizerWithSparsityGuarantee so masks are re-applied after every step;
+supported layers are Linear-like).
+
+TPU-native: masks are plain jnp arrays applied as elementwise multiplies —
+under jit.to_static the mask-multiply fuses into the update program.  (The
+MXU has no 2:4 sparse path like sparse tensor cores; the value here is
+model compression + parity of the pruning/fine-tuning workflow.)
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...nn.layer import Layer
+from ...nn.modules.common import Linear
+from ...ops import dispatch
+from ...tensor import Tensor
+
+__all__ = ["prune_model", "decorate", "calculate_density", "check_sparsity",
+           "reset_excluded_layers", "set_excluded_layers"]
+
+# id(param) -> mask ndarray; the decorated optimizer re-applies these
+_masks: Dict[int, jnp.ndarray] = {}
+_excluded: set = set()
+
+
+def set_excluded_layers(layer_names, main_program=None):
+    _excluded.update(layer_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def calculate_density(x) -> float:
+    a = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(a)) / max(a.size, 1)
+
+
+def _nm_mask_1d(w: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the n largest magnitudes in every group of m along the LAST
+    axis (reference mask_1d algorithm)."""
+    shape = w.shape
+    if shape[-1] % m != 0:
+        return np.ones_like(w, dtype=np.float32)
+    g = w.reshape(-1, m)
+    order = np.argsort(-np.abs(g), axis=1)
+    mask = np.zeros_like(g, dtype=np.float32)
+    np.put_along_axis(mask, order[:, :n], 1.0, axis=1)
+    return mask.reshape(shape)
+
+
+def check_sparsity(x, n=2, m=4) -> bool:
+    a = np.asarray(x._value if isinstance(x, Tensor) else x)
+    if a.ndim < 2 or a.shape[-1] % m:
+        return False
+    g = a.reshape(-1, m)
+    return bool((np.count_nonzero(g, axis=1) <= n).all())
+
+
+def prune_model(model: Layer, n=2, m=4, mask_algo="mask_1d",
+                with_mask=True) -> Dict[int, jnp.ndarray]:
+    """Compute n:m masks for every supported (Linear) weight, apply them in
+    place, and register them for the decorated optimizer."""
+    if mask_algo in ("mask_2d_greedy", "mask_2d_best"):
+        raise NotImplementedError(
+            f"{mask_algo} (2-D n:m patterns) is not implemented; use "
+            "'mask_1d'")
+    if mask_algo != "mask_1d":
+        raise ValueError(f"unknown mask_algo {mask_algo!r}")
+    for name, layer in model.named_sublayers(include_self=True):
+        if not isinstance(layer, Linear) or name in _excluded:
+            continue
+        w = layer.weight
+        mask = _nm_mask_1d(np.asarray(w._value, np.float32), n, m)
+        mk = jnp.asarray(mask, w._value.dtype)
+        with dispatch.no_grad():
+            w._set_value(w._value * mk)
+        if with_mask:
+            _masks[id(w)] = mk
+    return dict(_masks)
+
+
+def decorate(optimizer):
+    """Wrap ``optimizer.step`` so registered masks re-apply after every
+    update (reference OptimizerWithSparsityGuarantee) — pruned entries stay
+    exactly zero through training."""
+    if getattr(optimizer, "_asp_decorated", False):
+        return optimizer
+    inner_step = optimizer.step
+
+    def step():
+        inner_step()
+        with dispatch.no_grad():
+            for p in optimizer._parameter_list:
+                mk = _masks.get(id(p))
+                if mk is not None:
+                    p._set_value(p._value * mk)
+
+    optimizer.step = step
+    optimizer._asp_decorated = True
+    return optimizer
