@@ -162,6 +162,8 @@ from . import sparse  # noqa: E402,F401
 from . import audio  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
+from . import geometric  # noqa: E402,F401
+from . import text  # noqa: E402,F401
 from . import onnx  # noqa: E402,F401
 from . import device  # noqa: E402,F401
 from . import version  # noqa: E402,F401

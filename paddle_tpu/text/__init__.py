@@ -1,0 +1,6 @@
+"""paddle.text parity (reference: python/paddle/text/__init__.py exposing
+the text datasets).  Zero-egress build: datasets parse canonical LOCAL
+files and raise clearly when absent."""
+from .datasets import Imdb, UCIHousing  # noqa: F401
+
+__all__ = ["Imdb", "UCIHousing"]

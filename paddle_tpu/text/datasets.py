@@ -1,0 +1,125 @@
+"""Text datasets over canonical local files.
+
+Reference: python/paddle/text/datasets/imdb.py (aclImdb tar: tokenize
+train/{pos,neg}/*.txt, build a cutoff word dict, docs as index lists) and
+uci_housing.py (whitespace 14-column table, feature normalization,
+80/20 train/test split).  Zero egress: missing corpora raise with the
+exact path looked at.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import re
+import string
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "UCIHousing"]
+
+
+def _data_home():
+    return os.environ.get(
+        "PADDLE_TPU_DATA_HOME",
+        os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                     "dataset"))
+
+
+def _missing(what, path):
+    return FileNotFoundError(
+        f"{what} not found at {path}. This build has no network egress — "
+        "place the canonical file there or pass an explicit path.")
+
+
+class Imdb(Dataset):
+    """aclImdb sentiment corpus (reference imdb.py): docs are lists of
+    word indices from a frequency dict with ``cutoff``; label 0 = pos,
+    1 = neg (reference encodes 'neg' in the path as label 1)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        assert mode in ("train", "test"), mode
+        if data_file is None:
+            data_file = os.path.join(_data_home(), "imdb",
+                                     "aclImdb_v1.tar.gz")
+        if not os.path.exists(data_file):
+            raise _missing(f"Imdb ({mode})", data_file)
+        self._data_file = data_file
+        self.word_idx = self._build_word_dict(cutoff)
+        self.docs, self.labels = self._load(mode)
+
+    def _tokenize(self, pattern):
+        trans = str.maketrans("", "", string.punctuation)
+        with tarfile.open(self._data_file) as tf:
+            for member in tf.getmembers():
+                if pattern.match(member.name):
+                    data = tf.extractfile(member).read().decode(
+                        "utf-8", errors="ignore")
+                    yield data.lower().translate(trans).split()
+
+    def _build_word_dict(self, cutoff):
+        pattern = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        counter = collections.Counter()
+        for doc in self._tokenize(pattern):
+            counter.update(doc)
+        counter["<unk>"] = -1  # sorts last
+        words = [w for w, c in sorted(
+            counter.items(), key=lambda kv: (-kv[1], kv[0])) if c > cutoff]
+        word_idx = {w: i for i, w in enumerate(words)}
+        word_idx["<unk>"] = len(words)
+        return word_idx
+
+    def _load(self, mode):
+        unk = self.word_idx["<unk>"]
+        docs, labels = [], []
+        for label, sub in ((0, "pos"), (1, "neg")):
+            pattern = re.compile(rf"aclImdb/{mode}/{sub}/.*\.txt$")
+            for doc in self._tokenize(pattern):
+                docs.append(np.asarray(
+                    [self.word_idx.get(w, unk) for w in doc], np.int64))
+                labels.append(label)
+        return docs, np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    """Boston housing table (reference uci_housing.py): 14 whitespace
+    columns; features min/max/mean-normalized over the WHOLE table, then
+    an 80/20 train/test split."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        assert mode in ("train", "test"), mode
+        if data_file is None:
+            data_file = os.path.join(_data_home(), "uci_housing",
+                                     "housing.data")
+        if not os.path.exists(data_file):
+            raise _missing(f"UCIHousing ({mode})", data_file)
+        data = np.loadtxt(data_file).astype(np.float32)
+        if data.ndim != 2 or data.shape[1] != 14:
+            raise ValueError(
+                f"{data_file}: expected 14 whitespace-separated columns, "
+                f"got shape {data.shape}")
+        mx, mn, avg = data.max(0), data.min(0), data.mean(0)
+        span = np.where(mx - mn == 0, 1.0, mx - mn).astype(np.float32)
+        feats = (data[:, :13] - avg[:13]) / span[:13]
+        split = int(data.shape[0] * 0.8)
+        if mode == "train":
+            self.data = feats[:split]
+            self.label = data[:split, 13:14]
+        else:
+            self.data = feats[split:]
+            self.label = data[split:, 13:14]
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.label[idx]
+
+    def __len__(self):
+        return len(self.data)

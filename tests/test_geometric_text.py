@@ -1,0 +1,137 @@
+"""paddle.geometric segment/message-passing ops + paddle.text datasets
+(reference: python/paddle/geometric/, python/paddle/text/datasets/)."""
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import geometric as G
+
+
+def test_segment_ops_match_numpy():
+    rng = np.random.RandomState(0)
+    data = rng.randn(10, 4).astype(np.float32)
+    ids = np.array([0, 0, 1, 1, 1, 2, 2, 3, 3, 3], np.int64)
+    d = pt.to_tensor(data)
+    i = pt.to_tensor(ids)
+
+    s = G.segment_sum(d, i).numpy()
+    m = G.segment_mean(d, i).numpy()
+    mx = G.segment_max(d, i).numpy()
+    mn = G.segment_min(d, i).numpy()
+    for seg in range(4):
+        rows = data[ids == seg]
+        np.testing.assert_allclose(s[seg], rows.sum(0), rtol=1e-5)
+        np.testing.assert_allclose(m[seg], rows.mean(0), rtol=1e-5)
+        np.testing.assert_allclose(mx[seg], rows.max(0), rtol=1e-5)
+        np.testing.assert_allclose(mn[seg], rows.min(0), rtol=1e-5)
+
+
+def test_segment_sum_grad():
+    data = pt.to_tensor(np.ones((4, 2), np.float32), stop_gradient=False)
+    ids = pt.to_tensor(np.array([0, 1, 1, 0], np.int64))
+    out = G.segment_sum(data, ids)
+    pt.ops.sum(out * out).backward()
+    # d/dx sum(seg_sum^2) = 2 * seg_sum[ids]
+    expect = 2 * np.array([[2, 2], [2, 2], [2, 2], [2, 2]], np.float32)
+    np.testing.assert_allclose(np.asarray(data.grad._value), expect)
+
+
+def test_send_u_recv_and_ue_recv():
+    x = pt.to_tensor(np.array([[1.0], [2.0], [3.0]], np.float32))
+    src = pt.to_tensor(np.array([0, 1, 2, 0], np.int64))
+    dst = pt.to_tensor(np.array([1, 2, 1, 0], np.int64))
+    out = G.send_u_recv(x, src, dst, reduce_op="sum").numpy()
+    # dst0 <- x[0]; dst1 <- x[0]+x[2]; dst2 <- x[1]
+    np.testing.assert_allclose(out, [[1.0], [4.0], [2.0]])
+
+    e = pt.to_tensor(np.array([[10.0], [20.0], [30.0], [40.0]], np.float32))
+    out2 = G.send_ue_recv(x, e, src, dst, message_op="add",
+                          reduce_op="max").numpy()
+    np.testing.assert_allclose(out2, [[41.0], [33.0], [22.0]])
+
+
+def test_send_uv():
+    x = pt.to_tensor(np.array([[1.0], [2.0]], np.float32))
+    y = pt.to_tensor(np.array([[10.0], [20.0]], np.float32))
+    src = pt.to_tensor(np.array([0, 1], np.int64))
+    dst = pt.to_tensor(np.array([1, 0], np.int64))
+    out = G.send_uv(x, y, src, dst, message_op="mul").numpy()
+    np.testing.assert_allclose(out, [[20.0], [20.0]])
+
+
+def test_segment_under_jit_requires_out_size():
+    def fn(d, i):
+        return G.segment_sum(d, i)  # no out_size
+
+    compiled = pt.jit.to_static(fn)
+    d = pt.to_tensor(np.ones((4, 2), np.float32))
+    i = pt.to_tensor(np.array([0, 0, 1, 1], np.int64))
+    # the abstract scout falls back to the eager protocol (whose first two
+    # calls run concrete), so the error surfaces by the compile call
+    with pytest.raises((ValueError, RuntimeError), match="out_size"):
+        for _ in range(3):
+            compiled(d, i)
+
+    def fn2(d, i):
+        return G.segment_sum(d, i, out_size=2)
+
+    out = pt.jit.to_static(fn2)(d, i)
+    np.testing.assert_allclose(out.numpy(), [[2, 2], [2, 2]])
+
+
+# -- text ------------------------------------------------------------------
+
+def _write_imdb_fixture(path):
+    docs = {
+        "aclImdb/train/pos/0.txt": b"a great great movie",
+        "aclImdb/train/neg/0.txt": b"a terrible movie, bad!",
+        "aclImdb/test/pos/0.txt": b"great fun",
+        "aclImdb/test/neg/0.txt": b"bad bad bad",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, blob in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+
+
+def test_imdb_parses_tar(tmp_path):
+    from paddle_tpu.text import Imdb
+
+    path = str(tmp_path / "aclImdb_v1.tar.gz")
+    _write_imdb_fixture(path)
+    ds = Imdb(data_file=path, mode="train", cutoff=0)
+    assert len(ds) == 2
+    doc, label = ds[0]
+    assert doc.dtype == np.int64 and len(doc) == 4
+    assert label in (0, 1)
+    assert "<unk>" in ds.word_idx
+    # punctuation stripped, lowercased
+    assert "bad" in ds.word_idx and "bad!" not in ds.word_idx
+
+
+def test_imdb_missing_raises(tmp_path, monkeypatch):
+    from paddle_tpu.text import Imdb
+
+    monkeypatch.setenv("PADDLE_TPU_DATA_HOME", str(tmp_path))
+    with pytest.raises(FileNotFoundError, match="no network egress"):
+        Imdb(mode="train")
+
+
+def test_uci_housing(tmp_path):
+    from paddle_tpu.text import UCIHousing
+
+    rng = np.random.RandomState(0)
+    table = rng.rand(20, 14).astype(np.float32)
+    path = str(tmp_path / "housing.data")
+    np.savetxt(path, table)
+    train = UCIHousing(data_file=path, mode="train")
+    test = UCIHousing(data_file=path, mode="test")
+    assert len(train) == 16 and len(test) == 4
+    f, y = train[0]
+    assert f.shape == (13,) and y.shape == (1,)
+    np.testing.assert_allclose(y[0], table[0, 13], rtol=1e-6)
