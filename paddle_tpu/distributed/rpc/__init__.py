@@ -1,0 +1,207 @@
+"""distributed.rpc: remote procedure calls over the native TCPStore.
+
+Reference: python/paddle/distributed/rpc/rpc.py (init_rpc/rpc_sync/
+rpc_async/shutdown over a C++ RpcAgent with brpc transport,
+paddle/fluid/distributed/rpc/rpc_agent.cc).
+
+TPU-native redesign: the control plane this framework already runs on a
+job-wide native TCPStore (core/native/tcp_store.py — C++ server); RPC
+rides the same substrate instead of a second brpc stack.  A caller posts
+a pickled (fn, args, kwargs) under ``rpc/req/<callee>/<seq>`` and blocks
+(or futures) on ``rpc/resp/<caller>/<seq>``; every worker runs one daemon
+serving thread that polls its request counter.  Functions must be
+importable/picklable — same constraint as the reference.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, NamedTuple, Optional
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+class WorkerInfo(NamedTuple):
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _RpcState:
+    def __init__(self):
+        self.store = None
+        self.name = None
+        self.rank = -1
+        self.world_size = 0
+        self.seq = 0
+        self.seq_lock = threading.Lock()
+        self.serving = None
+        self.stop = threading.Event()
+        self.workers: Dict[str, WorkerInfo] = {}
+
+
+_state = _RpcState()
+_POLL = 0.02
+
+
+def _req_key(rank, seq):
+    return f"rpc/req/{rank}/{seq}"
+
+
+def _resp_key(rank, seq):
+    return f"rpc/resp/{rank}/{seq}"
+
+
+def init_rpc(name: str, rank: int = -1, world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Register this worker and start the serving thread (reference
+    rpc.py:init_rpc).  Uses the job's TCPStore when one is initialized,
+    else connects/creates one at ``master_endpoint``."""
+    from ..env import get_store
+
+    store = get_store()
+    if store is None:
+        from ...core.native.tcp_store import TCPStore
+
+        host, port = (master_endpoint or "127.0.0.1:0").rsplit(":", 1)
+        store = TCPStore(host=host, port=int(port), is_master=(rank <= 0),
+                         world_size=world_size or 1)
+    _state.store = store
+    _state.name = name
+    _state.rank = rank if rank >= 0 else 0
+    _state.world_size = world_size or 1
+    info = WorkerInfo(name, _state.rank, "127.0.0.1",
+                      getattr(store, "port", 0))
+    store.set(f"rpc/worker/{_state.rank}", pickle.dumps(info))
+    store.set(f"rpc/name/{name}", str(_state.rank).encode())
+    _state.stop.clear()
+    _state.serving = threading.Thread(target=_serve_loop, daemon=True)
+    _state.serving.start()
+    # wait until every worker registered (reference barriers at init)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if all(store.check(f"rpc/worker/{r}")
+               for r in range(_state.world_size)):
+            return
+        time.sleep(_POLL)
+    raise TimeoutError("init_rpc: not all workers registered")
+
+
+def _serve_loop():
+    import sys
+
+    store = _state.store
+    served = 0
+    while not _state.stop.is_set():
+        key = _req_key(_state.rank, served)
+        try:
+            if not store.check(key):
+                time.sleep(_POLL)
+                continue
+            blob = store.get(key)
+        except Exception:
+            if _state.stop.is_set():
+                return
+            time.sleep(_POLL)
+            continue
+        # from here the slot is CONSUMED no matter what — a poison request
+        # (e.g. a function unimportable on this worker) must not wedge the
+        # queue for every later caller
+        served += 1
+        try:
+            src_rank, src_seq, fn, args, kwargs = pickle.loads(blob)
+        except Exception as e:
+            sys.stderr.write(
+                f"[paddle_tpu.rpc] dropping undecodable request in {key}: "
+                f"{e!r} (caller will time out)\n")
+            try:
+                store.delete(key)
+            except Exception:
+                pass
+            continue
+        try:
+            result = (True, fn(*args, **kwargs))
+        except Exception as e:  # deliver the exception to the caller
+            result = (False, e)
+        try:
+            store.set(_resp_key(src_rank, src_seq), pickle.dumps(result))
+            store.delete(key)
+        except Exception:
+            if _state.stop.is_set():
+                return
+
+
+def _resolve_rank(to: str) -> int:
+    if to in _state.workers:
+        return _state.workers[to].rank
+    raw = _state.store.wait(f"rpc/name/{to}", timeout=60.0)
+    return int(raw.decode())
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None,
+              timeout: float = 60.0) -> Future:
+    """Post the call and return a Future (reference rpc.py:rpc_async)."""
+    if _state.store is None:
+        raise RuntimeError("call init_rpc first")
+    dst = _resolve_rank(to)
+    with _state.seq_lock:
+        seq = _state.seq
+        _state.seq += 1
+    blob = pickle.dumps((_state.rank, seq, fn, args or (), kwargs or {}))
+    # the CALLEE consumes requests in order; its next slot is its served
+    # counter — use a per-destination sequence from the store
+    slot = _state.store.add(f"rpc/reqctr/{dst}", 1) - 1
+    _state.store.set(_req_key(dst, slot), blob)
+
+    fut: Future = Future()
+
+    def waiter():
+        try:
+            raw = _state.store.wait(_resp_key(_state.rank, seq),
+                                    timeout=timeout)
+            ok, payload = pickle.loads(raw)
+            _state.store.delete(_resp_key(_state.rank, seq))
+            if ok:
+                fut.set_result(payload)
+            else:
+                fut.set_exception(payload)
+        except Exception as e:
+            fut.set_exception(e)
+
+    threading.Thread(target=waiter, daemon=True).start()
+    return fut
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout: float = 60.0):
+    """Blocking call (reference rpc.py:rpc_sync)."""
+    return rpc_async(to, fn, args, kwargs, timeout).result(timeout=timeout)
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    rank = _resolve_rank(name)
+    return pickle.loads(_state.store.wait(f"rpc/worker/{rank}", timeout=60.0))
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    return [pickle.loads(_state.store.wait(f"rpc/worker/{r}", timeout=60.0))
+            for r in range(_state.world_size)]
+
+
+def shutdown():
+    """Drain and stop serving (reference rpc.py:shutdown barriers first so
+    in-flight peers finish)."""
+    if _state.store is None:
+        return
+    try:
+        _state.store.barrier("rpc/shutdown", _state.world_size, timeout=60.0)
+    except Exception:
+        pass
+    _state.stop.set()
+    if _state.serving is not None:
+        _state.serving.join(timeout=5.0)
+    _state.store = None
+    _state.serving = None
