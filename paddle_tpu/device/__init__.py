@@ -17,11 +17,25 @@ from ..core.place import (  # noqa: F401
 )
 
 
+from . import plugin  # noqa: F401
+
+
 def get_all_device_type():
     types = ["cpu"]
     if is_compiled_with_tpu():
         types.append("tpu")
     return types
+
+
+def get_all_custom_device_type():
+    """Device types added through the plugin boundary (reference
+    device_manager GetAllCustomDeviceTypes)."""
+    builtin = set(get_all_device_type())
+    return [t for t in plugin.registered_types() if t not in builtin]
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    return device_type in get_all_custom_device_type()
 
 
 def get_available_device():

@@ -1,0 +1,127 @@
+"""Custom-device plugin boundary (N35).
+
+Reference: paddle/phi/capi/ (C-ABI a vendor implements: device init,
+memory, stream, kernel hooks) + paddle/phi/backends/device_manager.h:283
+(DeviceManager registry keyed by device type, loaded from
+CUSTOM_DEVICE_ROOT .so files).
+
+TPU-native redesign: the compute ABI is PJRT — a vendor backend IS a PJRT
+plugin, and jax discovers it through its own plugin registry, so this
+boundary does not re-invent kernel dispatch.  What it DOES own is the
+framework-level registry the reference's DeviceManager provides: device
+types visible to ``paddle_tpu.device``, per-type device counts, memory
+stats, and synchronize — mockable for tests, and the seam where a
+non-PJRT native runtime (or a monitoring shim around a real one) plugs
+in without touching framework code.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+__all__ = ["DeviceBackend", "PJRTBackend", "register_backend",
+           "unregister_backend", "get_backend", "registered_types",
+           "device_count", "synchronize", "memory_stats"]
+
+
+class DeviceBackend:
+    """The plugin interface (reference phi/capi C_Device* hooks, reduced
+    to the runtime surface the framework consumes — compute goes through
+    PJRT/XLA, not through this object)."""
+
+    name: str = "custom"
+
+    def device_count(self) -> int:
+        raise NotImplementedError
+
+    def synchronize(self, device_id: int = 0) -> None:
+        raise NotImplementedError
+
+    def memory_stats(self, device_id: int = 0) -> Dict[str, int]:
+        return {}
+
+
+class PJRTBackend(DeviceBackend):
+    """Default backend: whatever platform jax's PJRT client exposes."""
+
+    def __init__(self, platform: str):
+        self.name = platform
+
+    def _devices(self):
+        import jax
+
+        return [d for d in jax.devices() if d.platform == self.name]
+
+    def device_count(self) -> int:
+        try:
+            return len(self._devices())
+        except RuntimeError:
+            return 0
+
+    def synchronize(self, device_id: int = 0) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        devs = self._devices()
+        if devs:
+            jax.block_until_ready(jax.device_put(jnp.zeros(()), devs[device_id]))
+
+    def memory_stats(self, device_id: int = 0) -> Dict[str, int]:
+        devs = self._devices()
+        if not devs:
+            return {}
+        return devs[device_id].memory_stats() or {}
+
+
+_registry: Dict[str, DeviceBackend] = {}
+
+
+def _ensure_defaults():
+    if _registry:
+        return
+    import jax
+
+    try:
+        platforms = {d.platform for d in jax.devices()}
+    except RuntimeError:
+        platforms = {"cpu"}
+    for p in sorted(platforms):
+        _registry[p] = PJRTBackend(p)
+
+
+def register_backend(backend: DeviceBackend) -> None:
+    """Register a device plugin (reference DeviceManager::Register via
+    LoadCustomRuntimeLib; here any DeviceBackend instance)."""
+    _ensure_defaults()
+    if not backend.name or backend.name in _registry:
+        raise ValueError(f"backend name {backend.name!r} empty or taken")
+    _registry[backend.name] = backend
+
+
+def unregister_backend(name: str) -> None:
+    _ensure_defaults()
+    _registry.pop(name, None)
+
+
+def get_backend(name: str) -> DeviceBackend:
+    _ensure_defaults()
+    if name not in _registry:
+        raise KeyError(
+            f"no device backend {name!r}; registered: {sorted(_registry)}")
+    return _registry[name]
+
+
+def registered_types() -> List[str]:
+    _ensure_defaults()
+    return sorted(_registry)
+
+
+def device_count(name: str) -> int:
+    return get_backend(name).device_count()
+
+
+def synchronize(name: str, device_id: int = 0) -> None:
+    get_backend(name).synchronize(device_id)
+
+
+def memory_stats(name: str, device_id: int = 0) -> Dict[str, int]:
+    return get_backend(name).memory_stats(device_id)

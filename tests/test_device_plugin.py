@@ -1,0 +1,56 @@
+"""Custom-device plugin boundary (N35; reference phi/capi +
+device_manager.h registry): the registry is a real, mockable seam."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.device import (get_all_custom_device_type,
+                               is_compiled_with_custom_device, plugin)
+
+
+class FakeNPU(plugin.DeviceBackend):
+    name = "fake_npu"
+
+    def __init__(self):
+        self.synced = 0
+
+    def device_count(self):
+        return 4
+
+    def synchronize(self, device_id=0):
+        self.synced += 1
+
+    def memory_stats(self, device_id=0):
+        return {"bytes_in_use": 123, "bytes_limit": 1000}
+
+
+@pytest.fixture
+def fake_backend():
+    b = FakeNPU()
+    plugin.register_backend(b)
+    yield b
+    plugin.unregister_backend("fake_npu")
+
+
+def test_default_pjrt_backends_present():
+    types = plugin.registered_types()
+    assert "cpu" in types
+    assert plugin.device_count("cpu") >= 1
+    plugin.synchronize("cpu")  # must not raise
+    assert isinstance(plugin.memory_stats("cpu"), dict)
+
+
+def test_register_and_query_custom_backend(fake_backend):
+    assert "fake_npu" in get_all_custom_device_type()
+    assert is_compiled_with_custom_device("fake_npu")
+    assert plugin.device_count("fake_npu") == 4
+    plugin.synchronize("fake_npu", 1)
+    assert fake_backend.synced == 1
+    assert plugin.memory_stats("fake_npu")["bytes_limit"] == 1000
+
+
+def test_duplicate_and_unknown_backends(fake_backend):
+    with pytest.raises(ValueError, match="taken"):
+        plugin.register_backend(FakeNPU())
+    with pytest.raises(KeyError, match="no device backend"):
+        plugin.get_backend("never_registered")
