@@ -1,30 +1,67 @@
-"""ONNX export surface (reference: python/paddle/onnx/export.py, which
-delegates to the external paddle2onnx package).
+"""ONNX export (reference: python/paddle/onnx/export.py, which delegates
+to the external paddle2onnx package walking the ProgramDesc).
 
-This build has no onnx/paddle2onnx (zero-egress image); the portable
-serialized form of a compiled model is the StableHLO program written by
-``paddle_tpu.jit.save`` (load it anywhere with jax.export, including
-non-TPU backends).  ``export`` therefore writes that artifact and raises
-a clear error only if asked for a literal .onnx protobuf.
+TPU-native redesign with zero external deps: the model's forward is
+traced to a JAXPR and converted primitive-by-primitive into an ONNX
+GraphProto, serialized by a first-party protobuf wire-format writer
+(proto.py — the onnx python package is not in this image).  Models using
+primitives outside the supported inference subset raise naming the
+primitive; the StableHLO artifact from ``jit.save`` remains the
+universal compiled-model format.
 """
 from __future__ import annotations
+
+import numpy as np
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
+def export(layer, path, input_spec=None, opset_version=17, **configs):
     """reference onnx/export.py export(layer, path, input_spec).
 
-    Writes the StableHLO inference artifact at ``path`` (pdmodel/pdiparams
-    pair).  A true ONNX protobuf requires the external paddle2onnx/onnx
-    packages, which are not in this image.
+    ``path`` ending in ``.onnx`` writes a real ONNX protobuf; any other
+    path writes the StableHLO inference artifact (jit.save).
     """
-    if str(path).endswith(".onnx"):
-        raise NotImplementedError(
-            "literal .onnx protobuf export needs the external onnx package "
-            "(not in this zero-egress image); jit.save's StableHLO artifact "
-            "is the portable compiled-model format here")
-    from ..jit.save_load import save as _save
+    if not str(path).endswith(".onnx"):
+        from ..jit.save_load import save as _save
 
-    _save(layer, path, input_spec=input_spec)
+        _save(layer, path, input_spec=input_spec)
+        return path
+
+    import jax
+
+    from ..tensor import Tensor
+    from .export_jaxpr import jaxpr_to_onnx
+
+    if not input_spec:
+        raise ValueError(
+            ".onnx export needs input_spec (example tensors or InputSpec "
+            "shapes) to trace the forward")
+
+    def to_struct(spec):
+        if isinstance(spec, Tensor):
+            return jax.ShapeDtypeStruct(tuple(spec._value.shape),
+                                        spec._value.dtype)
+        shape = tuple(int(d) if d and d > 0 else 1
+                      for d in getattr(spec, "shape", spec))
+        dtype = np.dtype(getattr(spec, "dtype", "float32"))
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    structs = [to_struct(s) for s in input_spec]
+    fn = layer.forward if hasattr(layer, "forward") else layer
+
+    def pure(*raws):
+        from ..ops import dispatch
+
+        with dispatch.no_grad():
+            out = fn(*[Tensor(r) for r in raws])
+        if isinstance(out, (list, tuple)):
+            return tuple(o._value for o in out)
+        return out._value
+
+    closed = jax.make_jaxpr(pure)(*structs)
+    names = [f"x{i}" for i in range(len(structs))]
+    blob = jaxpr_to_onnx(closed, names, opset=opset_version)
+    with open(path, "wb") as f:
+        f.write(blob)
     return path

@@ -1,0 +1,248 @@
+"""jaxpr -> ONNX graph conversion.
+
+Reference: python/paddle/onnx/export.py delegates to paddle2onnx, which
+walks the static ProgramDesc op-by-op.  TPU-native redesign: the portable
+typed IR here is the JAXPR of the model's forward — each supported
+primitive maps to an ONNX op; ``pjit``/``custom_jvp``/``remat`` regions
+are inlined recursively.  Unsupported primitives raise naming the
+primitive so the failure is actionable.
+
+Covers the inference subset (linear/conv-free MLP-and-attention-style
+math): dot_general (2-D contractions), elementwise arithmetic, activation
+chains (tanh/erf/exp/log/logistic/sqrt/rsqrt/abs/max/min/pow),
+reductions, reshape/transpose/broadcast/cast/select/slice/concat.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import numpy as np
+
+from . import proto
+
+__all__ = ["jaxpr_to_onnx"]
+
+
+class _Builder:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.initializers: List[bytes] = []
+        self.counter = 0
+        self.names: Dict[Any, str] = {}
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def name_of(self, var, jaxpr_consts):
+        from jax._src.core import Literal
+
+        if isinstance(var, Literal):
+            return self.add_const(np.asarray(var.val))
+        if var not in self.names:
+            self.names[var] = self.fresh("v")
+        return self.names[var]
+
+    def add_const(self, arr: np.ndarray, hint="const"):
+        name = self.fresh(hint)
+        if arr.dtype == np.float64:
+            arr = arr.astype(np.float32)
+        if arr.dtype not in proto.NP_TO_ONNX:
+            arr = np.asarray(arr, np.float32)
+        self.initializers.append(proto.tensor_proto(name, arr))
+        return name
+
+    def emit(self, op, inputs, n_out=1, attrs=None, hint=None):
+        outs = [self.fresh(hint or op.lower())]
+        if n_out > 1:
+            outs = [self.fresh(op.lower()) for _ in range(n_out)]
+        self.nodes.append(proto.node_proto(op, inputs, outs, attrs=attrs))
+        return outs[0] if n_out == 1 else outs
+
+
+_ELEMWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow",
+    "tanh": "Tanh", "exp": "Exp", "log": "Log", "neg": "Neg",
+    "sqrt": "Sqrt", "abs": "Abs", "sign": "Sign", "floor": "Floor",
+    "ceil": "Ceil", "erf": "Erf", "logistic": "Sigmoid",
+    "sin": "Sin", "cos": "Cos",
+}
+
+
+def _convert_eqn(b: _Builder, eqn) -> None:
+    prim = eqn.primitive.name
+    ins = [b.name_of(v, None) for v in eqn.invars]
+
+    def bind(out_name):
+        b.names[eqn.outvars[0]] = out_name
+
+    if prim in ("pjit", "jit", "closed_call", "core_call",
+                "custom_jvp_call", "custom_vjp_call", "remat", "checkpoint",
+                "custom_vjp_call_jaxpr"):
+        inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+        if inner is None:
+            raise NotImplementedError(f"opaque call primitive '{prim}'")
+        closed = inner if hasattr(inner, "jaxpr") else None
+        inner_jaxpr = inner.jaxpr if closed is not None else inner
+        consts = inner.consts if closed is not None else []
+        for cv, cval in zip(inner_jaxpr.constvars, consts):
+            b.names[cv] = b.add_const(np.asarray(cval))
+        for iv, name in zip(inner_jaxpr.invars, ins):
+            b.names[iv] = name
+        for e in inner_jaxpr.eqns:
+            _convert_eqn(b, e)
+        for ov, outer in zip(inner_jaxpr.outvars, eqn.outvars):
+            b.names[outer] = b.name_of(ov, None)
+        return
+
+    if prim in _ELEMWISE:
+        bind(b.emit(_ELEMWISE[prim], ins))
+        return
+    if prim == "rsqrt":
+        s = b.emit("Sqrt", ins)
+        bind(b.emit("Reciprocal", [s]))
+        return
+    if prim == "square":
+        bind(b.emit("Mul", [ins[0], ins[0]]))
+        return
+    if prim == "erfc":
+        one = b.add_const(np.asarray(1.0, np.float32))
+        e = b.emit("Erf", ins)
+        bind(b.emit("Sub", [one, e]))
+        return
+    if prim == "integer_pow":
+        y = eqn.params["y"]
+        if y == 2:
+            bind(b.emit("Mul", [ins[0], ins[0]]))
+        else:
+            e = b.add_const(np.asarray(float(y), np.float32))
+            bind(b.emit("Pow", [ins[0], e]))
+        return
+    if prim == "dot_general":
+        ((lc, rc), (lb_, rb_)) = eqn.params["dimension_numbers"]
+        lhs, rhs = eqn.invars
+        if lb_ or rb_:
+            raise NotImplementedError("batched dot_general")
+        l_ndim = len(lhs.aval.shape)
+        r_ndim = len(rhs.aval.shape)
+        if tuple(lc) == (l_ndim - 1,) and tuple(rc) == (0,):
+            bind(b.emit("MatMul", ins))
+            return
+        if tuple(lc) == (l_ndim - 1,) and tuple(rc) == (1,) and r_ndim == 2:
+            # x @ W^T
+            t = b.emit("Transpose", [ins[1]], attrs={"perm": [1, 0]})
+            bind(b.emit("MatMul", [ins[0], t]))
+            return
+        raise NotImplementedError(
+            f"dot_general contraction {eqn.params['dimension_numbers']}")
+    if prim == "reshape":
+        shape = b.add_const(np.asarray(eqn.params["new_sizes"], np.int64))
+        bind(b.emit("Reshape", [ins[0], shape]))
+        return
+    if prim == "transpose":
+        bind(b.emit("Transpose", ins,
+                    attrs={"perm": list(eqn.params["permutation"])}))
+        return
+    if prim == "broadcast_in_dim":
+        in_shape = tuple(eqn.invars[0].aval.shape)
+        out_shape = tuple(eqn.params["shape"])
+        bdims = tuple(eqn.params["broadcast_dimensions"])
+        # reshape to aligned rank (1s elsewhere), then Expand
+        aligned = [1] * len(out_shape)
+        for src_dim, dst_dim in enumerate(bdims):
+            aligned[dst_dim] = in_shape[src_dim]
+        cur = ins[0]
+        if tuple(aligned) != in_shape:
+            shp = b.add_const(np.asarray(aligned, np.int64))
+            cur = b.emit("Reshape", [cur, shp])
+        if tuple(aligned) != out_shape:
+            shp = b.add_const(np.asarray(out_shape, np.int64))
+            cur = b.emit("Expand", [cur, shp])
+        bind(cur)
+        return
+    if prim == "convert_element_type":
+        dt = np.dtype(eqn.params["new_dtype"])
+        if dt == np.dtype(np.float64):
+            dt = np.dtype(np.float32)
+        bind(b.emit("Cast", ins, attrs={"to": proto.NP_TO_ONNX[dt]}))
+        return
+    if prim in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod"):
+        op = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
+              "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd"}[prim]
+        axes = list(eqn.params["axes"])
+        # opset 17: ReduceSum takes axes as input; Reduce{Max,Min,Prod}
+        # still use the attribute form
+        if op == "ReduceSum":
+            ax = b.add_const(np.asarray(axes, np.int64))
+            bind(b.emit(op, [ins[0], ax], attrs={"keepdims": 0}))
+        else:
+            bind(b.emit(op, ins, attrs={"axes": axes, "keepdims": 0}))
+        return
+    if prim == "select_n":
+        if len(ins) != 3:
+            raise NotImplementedError("select_n with >2 cases")
+        # jax: select_n(pred, on_false, on_true) ; ONNX Where(c, X=true, Y=false)
+        bind(b.emit("Where", [ins[0], ins[2], ins[1]]))
+        return
+    if prim == "concatenate":
+        bind(b.emit("Concat", ins, attrs={"axis": eqn.params["dimension"]}))
+        return
+    if prim == "slice":
+        starts = b.add_const(np.asarray(eqn.params["start_indices"], np.int64))
+        ends = b.add_const(np.asarray(eqn.params["limit_indices"], np.int64))
+        axes = b.add_const(np.asarray(range(len(eqn.params["start_indices"])),
+                                      np.int64))
+        strides = eqn.params.get("strides")
+        inputs = [ins[0], starts, ends, axes]
+        if strides:
+            inputs.append(b.add_const(np.asarray(strides, np.int64)))
+        bind(b.emit("Slice", inputs))
+        return
+    if prim == "squeeze":
+        ax = b.add_const(np.asarray(eqn.params["dimensions"], np.int64))
+        bind(b.emit("Squeeze", [ins[0], ax]))
+        return
+    if prim == "expand_dims":
+        ax = b.add_const(np.asarray(eqn.params["dimensions"], np.int64))
+        bind(b.emit("Unsqueeze", [ins[0], ax]))
+        return
+    if prim == "stop_gradient":
+        bind(b.emit("Identity", ins))
+        return
+    if prim == "copy":
+        bind(b.emit("Identity", ins))
+        return
+    raise NotImplementedError(
+        f"ONNX export: unsupported jax primitive '{prim}' — the "
+        "StableHLO artifact (jit.save) remains the universal format")
+
+
+def jaxpr_to_onnx(closed_jaxpr, input_names: List[str], opset=17) -> bytes:
+    """Convert a ClosedJaxpr to serialized ONNX ModelProto bytes."""
+    b = _Builder()
+    jaxpr = closed_jaxpr.jaxpr
+    for cv, cval in zip(jaxpr.constvars, closed_jaxpr.consts):
+        b.names[cv] = b.add_const(np.asarray(cval), hint="w")
+    g_inputs = []
+    for iv, name in zip(jaxpr.invars, input_names):
+        b.names[iv] = name
+        dt = np.dtype(iv.aval.dtype)
+        if dt == np.dtype(np.float64):
+            dt = np.dtype(np.float32)
+        g_inputs.append(proto.value_info_proto(
+            name, proto.NP_TO_ONNX[dt], tuple(iv.aval.shape)))
+    for eqn in jaxpr.eqns:
+        _convert_eqn(b, eqn)
+    g_outputs = []
+    for i, ov in enumerate(jaxpr.outvars):
+        name = b.name_of(ov, None)
+        dt = np.dtype(ov.aval.dtype)
+        if dt == np.dtype(np.float64):
+            dt = np.dtype(np.float32)
+        g_outputs.append(proto.value_info_proto(
+            name, proto.NP_TO_ONNX[dt], tuple(ov.aval.shape)))
+    graph = proto.graph_proto(b.nodes, "paddle_tpu_graph", b.initializers,
+                              g_inputs, g_outputs)
+    return proto.model_proto(graph, opset=opset)

@@ -1,0 +1,298 @@
+"""Minimal ONNX protobuf wire-format writer/reader (no onnx package).
+
+The ONNX schema's field numbers are stable public API (onnx/onnx.proto);
+this module hand-encodes the subset the exporter emits — ModelProto,
+GraphProto, NodeProto, TensorProto, ValueInfoProto, AttributeProto — with
+a generic varint/length-delimited writer, and a matching reader used by
+the test-side interpreter.  Reference analog: paddle2onnx's use of the
+onnx python bindings; here the encoder is first-party so export works in
+a zero-dependency image.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+# TensorProto.DataType
+FLOAT, UINT8, INT8, INT32, INT64, BOOL, FLOAT16, DOUBLE = 1, 2, 3, 6, 7, 9, 10, 11
+
+NP_TO_ONNX = {
+    np.dtype(np.float32): FLOAT,
+    np.dtype(np.float64): DOUBLE,
+    np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64,
+    np.dtype(np.bool_): BOOL,
+    np.dtype(np.uint8): UINT8,
+    np.dtype(np.int8): INT8,
+    np.dtype(np.float16): FLOAT16,
+}
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+# AttributeProto.AttributeType
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+
+# -- wire encoding ---------------------------------------------------------
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def f_varint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def f_bytes(field: int, blob: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(blob)) + blob
+
+
+def f_string(field: int, s: str) -> bytes:
+    return f_bytes(field, s.encode())
+
+
+def f_packed_varints(field: int, values) -> bytes:
+    blob = b"".join(_varint(int(v)) for v in values)
+    return f_bytes(field, blob)
+
+
+# -- message builders ------------------------------------------------------
+
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    dt = NP_TO_ONNX[arr.dtype]
+    msg = f_packed_varints(1, arr.shape)        # dims
+    msg += f_varint(2, dt)                      # data_type
+    msg += f_string(8, name)                    # name
+    msg += f_bytes(9, arr.tobytes())            # raw_data
+    return msg
+
+
+def attribute_proto(name: str, value) -> bytes:
+    msg = f_string(1, name)
+    if isinstance(value, float):
+        msg += _tag(2, 5) + struct.pack("<f", value)     # f
+        msg += f_varint(20, ATTR_FLOAT)
+    elif isinstance(value, bool) or isinstance(value, (int, np.integer)):
+        msg += f_varint(3, int(value))                   # i
+        msg += f_varint(20, ATTR_INT)
+    elif isinstance(value, str):
+        msg += f_bytes(4, value.encode())                # s
+        msg += f_varint(20, ATTR_STRING)
+    elif isinstance(value, (list, tuple)) and all(
+            isinstance(v, (int, np.integer)) for v in value):
+        for v in value:
+            msg += f_varint(8, int(v))                   # ints (unpacked)
+        msg += f_varint(20, ATTR_INTS)
+    else:
+        raise TypeError(f"unsupported attribute {name}={value!r}")
+    return msg
+
+
+def node_proto(op_type: str, inputs: List[str], outputs: List[str],
+               name: str = "", attrs: Dict[str, Any] = None) -> bytes:
+    msg = b"".join(f_string(1, i) for i in inputs)
+    msg += b"".join(f_string(2, o) for o in outputs)
+    msg += f_string(3, name or f"{op_type}_{outputs[0]}")
+    msg += f_string(4, op_type)
+    for k, v in (attrs or {}).items():
+        msg += f_bytes(5, attribute_proto(k, v))
+    return msg
+
+
+def value_info_proto(name: str, dtype: int, shape: Tuple[int, ...]) -> bytes:
+    dims = b"".join(f_bytes(1, f_varint(1, d)) for d in shape)  # dim_value
+    shape_msg = dims
+    tensor_type = f_varint(1, dtype) + f_bytes(2, shape_msg)
+    type_msg = f_bytes(1, tensor_type)
+    return f_string(1, name) + f_bytes(2, type_msg)
+
+
+def graph_proto(nodes: List[bytes], name: str, initializers: List[bytes],
+                inputs: List[bytes], outputs: List[bytes]) -> bytes:
+    msg = b"".join(f_bytes(1, n) for n in nodes)
+    msg += f_string(2, name)
+    msg += b"".join(f_bytes(5, t) for t in initializers)
+    msg += b"".join(f_bytes(11, i) for i in inputs)
+    msg += b"".join(f_bytes(12, o) for o in outputs)
+    return msg
+
+
+def model_proto(graph: bytes, opset: int = 17,
+                producer: str = "paddle_tpu") -> bytes:
+    msg = f_varint(1, 8)                          # ir_version = 8
+    msg += f_string(2, producer)
+    msg += f_bytes(7, graph)
+    opset_msg = f_string(1, "") + f_varint(2, opset)
+    msg += f_bytes(8, opset_msg)
+    return msg
+
+
+# -- wire decoding (test-side interpreter support) -------------------------
+
+def parse_fields(blob: bytes):
+    """Yield (field_number, wire_type, value) triples."""
+    i, n = 0, len(blob)
+    while i < n:
+        tag = 0
+        shift = 0
+        while True:
+            b = blob[i]
+            i += 1
+            tag |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val = 0
+            shift = 0
+            while True:
+                b = blob[i]
+                i += 1
+                val |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            yield field, wire, val
+        elif wire == 2:
+            ln = 0
+            shift = 0
+            while True:
+                b = blob[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                shift += 7
+                if not b & 0x80:
+                    break
+            yield field, wire, blob[i:i + ln]
+            i += ln
+        elif wire == 5:
+            yield field, wire, blob[i:i + 4]
+            i += 4
+        elif wire == 1:
+            yield field, wire, blob[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+
+
+def _unpack_varints(blob: bytes) -> List[int]:
+    out, i = [], 0
+    while i < len(blob):
+        val, shift = 0, 0
+        while True:
+            b = blob[i]
+            i += 1
+            val |= (b & 0x7F) << shift
+            shift += 7
+            if not b & 0x80:
+                break
+        out.append(val)
+    return out
+
+
+def parse_tensor(blob: bytes) -> Tuple[str, np.ndarray]:
+    dims: List[int] = []
+    dt = FLOAT
+    name = ""
+    raw = b""
+    for field, wire, val in parse_fields(blob):
+        if field == 1:
+            dims += _unpack_varints(val) if wire == 2 else [val]
+        elif field == 2:
+            dt = val
+        elif field == 8:
+            name = val.decode()
+        elif field == 9:
+            raw = val
+    arr = np.frombuffer(raw, dtype=ONNX_TO_NP[dt]).reshape(dims)
+    return name, arr
+
+
+def parse_attribute(blob: bytes):
+    name, atype = "", 0
+    fields = {}
+    ints: List[int] = []
+    for field, wire, val in parse_fields(blob):
+        if field == 1:
+            name = val.decode()
+        elif field == 2:
+            fields["f"] = struct.unpack("<f", val)[0]
+        elif field == 3:
+            fields["i"] = val
+        elif field == 4:
+            fields["s"] = val.decode()
+        elif field == 8:
+            ints.append(val)
+        elif field == 20:
+            atype = val
+    if atype == ATTR_INTS:
+        return name, ints
+    if atype == ATTR_INT:
+        return name, fields.get("i", 0)
+    if atype == ATTR_FLOAT:
+        return name, fields.get("f", 0.0)
+    if atype == ATTR_STRING:
+        return name, fields.get("s", "")
+    return name, fields or ints
+
+
+def parse_node(blob: bytes):
+    inputs, outputs, op_type, attrs = [], [], "", {}
+    for field, wire, val in parse_fields(blob):
+        if field == 1:
+            inputs.append(val.decode())
+        elif field == 2:
+            outputs.append(val.decode())
+        elif field == 4:
+            op_type = val.decode()
+        elif field == 5:
+            k, v = parse_attribute(val)
+            attrs[k] = v
+    return {"op": op_type, "inputs": inputs, "outputs": outputs,
+            "attrs": attrs}
+
+
+def parse_model(blob: bytes):
+    graph = None
+    for field, wire, val in parse_fields(blob):
+        if field == 7:
+            graph = val
+    if graph is None:
+        raise ValueError("no GraphProto in model")
+    nodes, inits, g_inputs, g_outputs = [], {}, [], []
+    for field, wire, val in parse_fields(graph):
+        if field == 1:
+            nodes.append(parse_node(val))
+        elif field == 5:
+            name, arr = parse_tensor(val)
+            inits[name] = arr
+        elif field == 11:
+            g_inputs.append(_value_info_name(val))
+        elif field == 12:
+            g_outputs.append(_value_info_name(val))
+    return {"nodes": nodes, "initializers": inits,
+            "inputs": g_inputs, "outputs": g_outputs}
+
+
+def _value_info_name(blob: bytes) -> str:
+    for field, wire, val in parse_fields(blob):
+        if field == 1:
+            return val.decode()
+    return ""
