@@ -144,39 +144,75 @@ def interpolate(
     name=None,
 ):
     x = ensure_tensor(x)
-    if data_format not in ("NCHW", "NHWC"):
-        raise NotImplementedError("interpolate supports 4-D inputs")
-    hw_axes = (2, 3) if data_format == "NCHW" else (1, 2)
-    in_h, in_w = x._value.shape[hw_axes[0]], x._value.shape[hw_axes[1]]
+    # spatial axes for every layout the reference accepts (3/4/5-D)
+    layouts = {"NCW": (2,), "NWC": (1,), "NCL": (2,), "NLC": (1,),
+               "NCHW": (2, 3), "NHWC": (1, 2),
+               "NCDHW": (2, 3, 4), "NDHWC": (1, 2, 3)}
+    if data_format not in layouts:
+        raise NotImplementedError(f"interpolate data_format {data_format!r}")
+    axes = layouts[data_format]
+    in_sizes = [x._value.shape[a] for a in axes]
     if size is not None:
         if isinstance(size, Tensor):
             size = [int(s) for s in size.numpy()]
-        out_h, out_w = int(size[0]), int(size[1])
+        if not isinstance(size, (list, tuple)):
+            size = [size]
+        out_sizes = [int(s) for s in size]
     else:
-        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * 2
-        out_h, out_w = int(in_h * sf[0]), int(in_w * sf[1])
+        if scale_factor is None:
+            raise ValueError(
+                "interpolate: one of size / scale_factor must be set")
+        sf = (list(scale_factor) if isinstance(scale_factor, (list, tuple))
+              else [scale_factor] * len(axes))
+        out_sizes = [int(d * f) for d, f in zip(in_sizes, sf)]
+    if len(out_sizes) != len(axes):
+        raise ValueError(
+            f"interpolate: {len(axes)} spatial dims but size has "
+            f"{len(out_sizes)} entries")
 
-    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    linear_family = {"linear", "bilinear", "trilinear", "area"}
+    if mode not in linear_family | {"nearest", "bicubic"}:
+        raise NotImplementedError(f"interpolate mode {mode!r}")
+
+    def _axis_lerp(a, axis, n_out, nearest):
+        """Resize ONE axis by gather+lerp — supports align_corners
+        exactly, any rank (the reference's separable kernels)."""
+        n_in = a.shape[axis]
+        if n_out == n_in and not nearest:
+            return a
+        if align_corners and n_out > 1:
+            pos = jnp.linspace(0.0, n_in - 1, n_out)
+        else:
+            pos = (jnp.arange(n_out) + 0.5) * (n_in / n_out) - 0.5
+            pos = jnp.clip(pos, 0, n_in - 1)
+        if nearest:
+            idx = jnp.clip(jnp.round(pos).astype(jnp.int32), 0, n_in - 1)
+            return jnp.take(a, idx, axis=axis)
+        lo = jnp.floor(pos).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, n_in - 1)
+        w = pos - lo
+        shape = [1] * a.ndim
+        shape[axis] = n_out
+        w = w.reshape(shape)
+        return (jnp.take(a, lo, axis=axis) * (1 - w)
+                + jnp.take(a, hi, axis=axis) * w)
 
     def fn(a):
-        shape = list(a.shape)
-        shape[hw_axes[0]], shape[hw_axes[1]] = out_h, out_w
-        if align_corners and method != "nearest":
-            # jax.image.resize has no align_corners; emulate with explicit coords
-            idx_h = jnp.linspace(0, in_h - 1, out_h)
-            idx_w = jnp.linspace(0, in_w - 1, out_w)
-            a_m = jnp.moveaxis(a, hw_axes, (a.ndim - 2, a.ndim - 1))
-            h0 = jnp.floor(idx_h).astype(jnp.int32)
-            h1 = jnp.minimum(h0 + 1, in_h - 1)
-            wh = (idx_h - h0)[..., None]
-            w0 = jnp.floor(idx_w).astype(jnp.int32)
-            w1 = jnp.minimum(w0 + 1, in_w - 1)
-            ww = idx_w - w0
-            top = a_m[..., h0, :][..., :, w0] * (1 - ww) + a_m[..., h0, :][..., :, w1] * ww
-            bot = a_m[..., h1, :][..., :, w0] * (1 - ww) + a_m[..., h1, :][..., :, w1] * ww
-            out = top * (1 - wh) + bot * wh
-            return jnp.moveaxis(out, (a.ndim - 2, a.ndim - 1), hw_axes)
-        return jax.image.resize(a, shape, method=method)
+        if mode == "bicubic":
+            if align_corners:
+                raise NotImplementedError(
+                    "bicubic with align_corners=True")
+            shape = list(a.shape)
+            for ax, n_out in zip(axes, out_sizes):
+                shape[ax] = n_out
+            return jax.image.resize(a, shape, method="cubic")
+        out = a
+        # 'nearest' in paddle defaults to the legacy floor behavior when
+        # align_corners is False and align_mode is 0; round() matches the
+        # half-pixel convention used for the linear family
+        for ax, n_out in zip(axes, out_sizes):
+            out = _axis_lerp(out, ax, n_out, nearest=(mode == "nearest"))
+        return out
 
     return dispatch.apply(fn, x, op_name="interpolate")
 

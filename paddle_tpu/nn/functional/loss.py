@@ -119,6 +119,31 @@ def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noq
     return dispatch.apply(fn, input, label, op_name="smooth_l1_loss")
 
 
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):  # noqa: A002
+    """reference phi huber_loss: quadratic below delta, linear above
+    (NOT delta-rescaled like smooth_l1)."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+
+    return dispatch.apply(fn, input, label, op_name="huber_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    """reference phi log_loss: -y*log(p+eps) - (1-y)*log(1-p+eps),
+    elementwise (no reduction)."""
+    input, label = ensure_tensor(input), ensure_tensor(label)
+
+    def fn(p, y):
+        return (-y * jnp.log(p + epsilon)
+                - (1.0 - y) * jnp.log(1.0 - p + epsilon))
+
+    return dispatch.apply(fn, input, label, op_name="log_loss")
+
+
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
     input, label = ensure_tensor(input), ensure_tensor(label)
     tensors = [input, label]

@@ -1,0 +1,110 @@
+"""Vision sampling ops: affine_grid, grid_sample, channel_shuffle.
+
+Reference: paddle/phi/kernels/{affine_grid,grid_sample}_kernel.*,
+channel_shuffle_kernel.cc.  TPU-native: pure gather/interp math over
+jnp — XLA fuses the coordinate arithmetic with the gathers.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops import dispatch
+from ...ops._factory import ensure_tensor
+
+__all__ = ["affine_grid", "grid_sample", "channel_shuffle"]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta [N, 2, 3] + out_shape [N, C, H, W] -> sampling grid
+    [N, H, W, 2] in normalized [-1, 1] coords (reference affine_grid)."""
+    theta = ensure_tensor(theta)
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy()]
+    n, c, h, w = [int(v) for v in out_shape]
+
+    def fn(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+            xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)          # [H, W, 3]
+        out = jnp.einsum("hwk,njk->nhwj", base, th)        # [N, H, W, 2]
+        return out.astype(th.dtype)
+
+    return dispatch.apply(fn, theta, op_name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """x [N, C, H, W] sampled at grid [N, Hg, Wg, 2] (xy in [-1, 1]) —
+    reference grid_sample; bilinear/nearest, zeros/border padding."""
+    if mode not in ("bilinear", "nearest"):
+        raise NotImplementedError(f"grid_sample mode {mode!r}")
+    if padding_mode not in ("zeros", "border"):
+        raise NotImplementedError(f"grid_sample padding_mode {padding_mode!r}")
+    x = ensure_tensor(x)
+    grid = ensure_tensor(grid)
+
+    def fn(a, g):
+        n, c, h, w = a.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def gather(ix, iy):
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            # [N, Hg, Wg] indices into [N, C, H, W] -> [N, C, Hg, Wg]
+            bidx = jnp.arange(n)[:, None, None]
+            vals = a[bidx, :, iyc, ixc]                    # [N, Hg, Wg, C]
+            vals = jnp.moveaxis(vals, -1, 1)
+            if padding_mode == "zeros":
+                inside = ((ix >= 0) & (ix <= w - 1)
+                          & (iy >= 0) & (iy <= h - 1))
+                vals = vals * inside[:, None, :, :].astype(vals.dtype)
+            return vals
+
+        if mode == "nearest":
+            return gather(jnp.round(fx).astype(jnp.int32),
+                          jnp.round(fy).astype(jnp.int32))
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (fx - x0)[:, None, :, :]
+        wy = (fy - y0)[:, None, :, :]
+        v00 = gather(x0, y0)
+        v01 = gather(x1, y0)
+        v10 = gather(x0, y1)
+        v11 = gather(x1, y1)
+        top = v00 * (1 - wx) + v01 * wx
+        bot = v10 * (1 - wx) + v11 * wx
+        return top * (1 - wy) + bot * wy
+
+    return dispatch.apply(fn, x, grid, op_name="grid_sample")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """reference channel_shuffle: [N, g*k, H, W] -> interleave groups."""
+    x = ensure_tensor(x)
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            a = jnp.swapaxes(a, 1, 2)
+            return a.reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        a = jnp.swapaxes(a, 3, 4)
+        return a.reshape(n, h, w, c)
+
+    return dispatch.apply(fn, x, op_name="channel_shuffle")

@@ -76,6 +76,10 @@ from .manipulation import (  # noqa: F401
     index_sample,
     index_select,
     moveaxis,
+    diag_embed,
+    fill_diagonal_,
+    fill_diagonal_tensor,
+    gather_tree,
     numel,
     put_along_axis,
     repeat_interleave,
@@ -114,6 +118,7 @@ from .random import (  # noqa: F401
     bernoulli,
     binomial,
     default_generator,
+    exponential_,
     gaussian,
     get_rng_state,
     multinomial,

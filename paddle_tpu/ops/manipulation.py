@@ -518,3 +518,95 @@ def reshape__(x, shape, name=None):
 
 def flatten_(x, start_axis=0, stop_axis=-1, name=None):
     return _inplace_from(x, flatten(x, start_axis, stop_axis))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):  # noqa: A002
+    """reference phi diag_embed: embed the last dim as a diagonal of a new
+    matrix spanned by (dim1, dim2)."""
+    x = ensure_tensor(input)
+    out_ndim = x._value.ndim + 1
+    d1 = dim1 if dim1 >= 0 else out_ndim + dim1
+    d2 = dim2 if dim2 >= 0 else out_ndim + dim2
+    if d1 == d2:
+        raise ValueError(
+            f"diag_embed: dim1 and dim2 must differ, both resolve to {d1}")
+
+    def fn(a):
+        n = a.shape[-1]
+        size = n + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (size, size), a.dtype)
+        idx = jnp.arange(n)
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        # the two new axes currently sit at (-2, -1); move them to (d1, d2)
+        perm = list(range(out.ndim - 2))
+        order = sorted([(d1, out.ndim - 2), (d2, out.ndim - 1)])
+        for pos, src in order:
+            perm.insert(pos, src)
+        return jnp.transpose(out, perm)
+
+    return dispatch.apply(fn, x, op_name="diag_embed")
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    """reference Tensor.fill_diagonal_: in-place write of the main
+    diagonal (2-D; offset supported, wrap unsupported)."""
+    if wrap:
+        raise NotImplementedError("fill_diagonal_(wrap=True)")
+    t = ensure_tensor(x)
+    if t._value.ndim != 2:
+        # the reference's >2-D semantics write the TRUE main diagonal
+        # a[i, i, ..., i]; restrict rather than silently fill per-batch
+        raise NotImplementedError(
+            f"fill_diagonal_ supports 2-D tensors, got ndim={t._value.ndim}")
+
+    def fn(a):
+        h, w = a.shape[-2], a.shape[-1]
+        n = min(h - max(-offset, 0), w - max(offset, 0))
+        r = jnp.arange(n) + max(-offset, 0)
+        c = jnp.arange(n) + max(offset, 0)
+        return a.at[..., r, c].set(value)
+
+    return _inplace_from(x, dispatch.apply(fn, t, op_name="fill_diagonal_"))
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """reference fill_diagonal_tensor: write tensor y onto the (dim1,
+    dim2) diagonal of x (out-of-place)."""
+    xt, yt = ensure_tensor(x), ensure_tensor(y)
+
+    def fn(a, b):
+        d1 = dim1 if dim1 >= 0 else a.ndim + dim1
+        d2 = dim2 if dim2 >= 0 else a.ndim + dim2
+        am = jnp.moveaxis(a, (d1, d2), (-2, -1))
+        h, w = am.shape[-2], am.shape[-1]
+        n = min(h - max(-offset, 0), w - max(offset, 0))
+        r = jnp.arange(n) + max(-offset, 0)
+        c = jnp.arange(n) + max(offset, 0)
+        am = am.at[..., r, c].set(b)
+        return jnp.moveaxis(am, (-2, -1), (d1, d2))
+
+    return dispatch.apply(fn, xt, yt, op_name="fill_diagonal_tensor")
+
+
+def gather_tree(ids, parents, name=None):
+    """reference phi gather_tree (beam search backtrace): ids/parents
+    [T, B, W]; walk parents backwards so each beam's full token path is
+    materialized."""
+    ids_t, par_t = ensure_tensor(ids), ensure_tensor(parents)
+
+    def fn(idv, pav):
+        def step(carry, xs):
+            beam = carry                      # [B, W] current beam index
+            id_t, par_t_ = xs                 # rows at time t
+            tok = jnp.take_along_axis(id_t, beam, axis=-1)
+            beam = jnp.take_along_axis(par_t_, beam, axis=-1)
+            return beam, tok
+
+        init = jnp.broadcast_to(
+            jnp.arange(idv.shape[-1]), idv.shape[1:]).astype(idv.dtype)
+        _, toks = jax.lax.scan(step, init, (idv[::-1], pav[::-1]))
+        return toks[::-1]
+
+    return dispatch.apply(fn, ids_t, par_t, op_name="gather_tree")

@@ -216,7 +216,12 @@ def standard_normal(shape, dtype="float32", name=None):
 
 
 def exponential_(x, lam=1.0, name=None):
+    """reference Tensor.exponential_ (phi exponential kernel): fill x
+    in place with Exp(lam) samples.  Sampling happens in the key's float
+    dtype and is cast to x's dtype on store (jax.random.exponential
+    rejects integer dtypes)."""
     x = ensure_tensor(x)
     key = default_generator.split()
-    x._set_value(jax.random.exponential(key, x._value.shape, x._value.dtype) / lam)
+    samples = jax.random.exponential(key, x._value.shape) / lam
+    x._set_value(samples.astype(x._value.dtype))
     return x

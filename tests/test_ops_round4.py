@@ -1,0 +1,119 @@
+"""Round-4 op batch: diag_embed/fill_diagonal/gather_tree, huber/log loss,
+grid_sample/affine_grid/channel_shuffle, exponential_, generalized
+interpolate (3/4/5-D, align_corners) — numeric parity vs torch where
+torch has the op (CPU reference), else vs closed form."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+def test_diag_embed_and_fill_diagonal():
+    v = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    out = pt.ops.diag_embed(pt.to_tensor(v)).numpy()
+    ref = torch.diag_embed(torch.tensor(v)).numpy()
+    np.testing.assert_allclose(out, ref)
+    out_off = pt.ops.diag_embed(pt.to_tensor(v), offset=1).numpy()
+    ref_off = torch.diag_embed(torch.tensor(v), offset=1).numpy()
+    np.testing.assert_allclose(out_off, ref_off)
+
+    x = pt.to_tensor(np.zeros((4, 4), np.float32))
+    pt.ops.fill_diagonal_(x, 7.0)
+    np.testing.assert_allclose(np.diag(x.numpy()), [7.0] * 4)
+
+
+def test_fill_diagonal_tensor():
+    x = np.zeros((3, 3), np.float32)
+    y = np.array([1.0, 2.0, 3.0], np.float32)
+    out = pt.ops.fill_diagonal_tensor(pt.to_tensor(x), pt.to_tensor(y)).numpy()
+    np.testing.assert_allclose(np.diag(out), y)
+    assert out.sum() == y.sum()
+
+
+def test_gather_tree_backtrace():
+    # T=3, B=1, W=2 beams; beam1 at t=2 points at parent 1->0 chain
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+    par = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int64)
+    out = pt.ops.gather_tree(pt.to_tensor(ids), pt.to_tensor(par)).numpy()
+    np.testing.assert_array_equal(out, [[[1, 1]], [[4, 3]], [[5, 6]]])
+
+
+def test_huber_and_log_loss():
+    a = np.array([0.5, 2.0], np.float32)
+    b = np.zeros(2, np.float32)
+    ours = float(F.huber_loss(pt.to_tensor(a), pt.to_tensor(b),
+                              delta=1.0, reduction="sum"))
+    ref = float(TF.huber_loss(torch.tensor(a), torch.tensor(b),
+                              reduction="sum", delta=1.0))
+    np.testing.assert_allclose(ours, ref, rtol=1e-6)
+
+    p = np.array([0.9, 0.2], np.float32)
+    y = np.array([1.0, 0.0], np.float32)
+    out = F.log_loss(pt.to_tensor(p), pt.to_tensor(y), epsilon=1e-4).numpy()
+    want = -y * np.log(p + 1e-4) - (1 - y) * np.log(1 - p + 1e-4)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_grid_sample_matches_torch():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 5, 7).astype(np.float32)
+    theta = np.tile(np.array([[[0.8, 0.1, 0.1], [-0.2, 0.9, -0.1]]],
+                             np.float32), (2, 1, 1))
+    for ac in (True, False):
+        grid_t = TF.affine_grid(torch.tensor(theta), (2, 3, 6, 8),
+                                align_corners=ac)
+        grid_o = F.affine_grid(pt.to_tensor(theta), [2, 3, 6, 8],
+                               align_corners=ac)
+        np.testing.assert_allclose(grid_o.numpy(), grid_t.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        ref = TF.grid_sample(torch.tensor(x), grid_t, mode="bilinear",
+                             padding_mode="zeros", align_corners=ac)
+        ours = F.grid_sample(pt.to_tensor(x), grid_o, mode="bilinear",
+                             padding_mode="zeros", align_corners=ac)
+        np.testing.assert_allclose(ours.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_channel_shuffle_matches_torch():
+    x = np.arange(2 * 6 * 2 * 2, dtype=np.float32).reshape(2, 6, 2, 2)
+    ours = F.channel_shuffle(pt.to_tensor(x), 3).numpy()
+    ref = torch.channel_shuffle(torch.tensor(x), 3).numpy()
+    np.testing.assert_allclose(ours, ref)
+
+
+def test_exponential_inplace():
+    pt.seed(0)
+    x = pt.to_tensor(np.zeros(5000, np.float32))
+    pt.ops.exponential_(x, lam=2.0)
+    m = float(x.numpy().mean())
+    assert abs(m - 0.5) < 0.05  # E[Exp(2)] = 0.5
+    assert (x.numpy() >= 0).all()
+
+
+@pytest.mark.parametrize("ac", [False, True])
+def test_interpolate_parity_3d_4d_5d(ac):
+    rng = np.random.RandomState(1)
+    x3 = rng.randn(2, 3, 9).astype(np.float32)
+    ours = F.interpolate(pt.to_tensor(x3), size=[5], mode="linear",
+                         align_corners=ac, data_format="NCW").numpy()
+    ref = TF.interpolate(torch.tensor(x3), size=(5,), mode="linear",
+                         align_corners=ac).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+    x4 = rng.randn(2, 3, 5, 7).astype(np.float32)
+    ours = F.interpolate(pt.to_tensor(x4), size=[10, 14], mode="bilinear",
+                         align_corners=ac).numpy()
+    ref = TF.interpolate(torch.tensor(x4), size=(10, 14), mode="bilinear",
+                         align_corners=ac).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+    x5 = rng.randn(1, 2, 4, 5, 6).astype(np.float32)
+    ours = F.interpolate(pt.to_tensor(x5), size=[8, 10, 3],
+                         mode="trilinear", align_corners=ac,
+                         data_format="NCDHW").numpy()
+    ref = TF.interpolate(torch.tensor(x5), size=(8, 10, 3),
+                         mode="trilinear", align_corners=ac).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)

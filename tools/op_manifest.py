@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Op-coverage manifest (N14 / L2 analog).
+
+The reference generates its op surface from YAML manifests
+(paddle/phi/api/yaml/ops.yaml + legacy_ops.yaml); this tool measures the
+TPU framework's coverage AGAINST those manifests and writes
+OPS_COVERAGE.json — a judgeable, regenerable inventory instead of a
+hand-maintained claim.
+
+Usage:  python tools/op_manifest.py [--ref /root/reference] [--out OPS_COVERAGE.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# reference op name -> our public name, for renames / fusions that exist
+# under a different (jax-idiomatic) spelling
+ALIASES = {
+    "matmul": "matmul", "elementwise_add": "add", "elementwise_mul": "multiply",
+    "elementwise_sub": "subtract", "elementwise_div": "divide",
+    "elementwise_pow": "pow",
+    "reduce_sum": "sum", "reduce_mean": "mean", "reduce_max": "max",
+    "reduce_min": "min", "reduce_prod": "prod", "reduce_all": "all",
+    "reduce_any": "any", "arg_max": "argmax", "arg_min": "argmin",
+    "fill_constant": "full", "top_k": "topk", "one_hot_v2": "one_hot",
+    "softmax_with_cross_entropy": "cross_entropy",
+    "cross_entropy_with_softmax": "cross_entropy",
+    "sigmoid_cross_entropy_with_logits": "binary_cross_entropy_with_logits",
+    "flash_attn": "flash_attention",
+    "fused_adam_": "fused_adamw",
+    "bce_loss": "binary_cross_entropy",
+    "kldiv_loss": "kl_div",
+    "logsigmoid": "log_sigmoid",
+    "frobenius_norm": "norm",
+    "linear_interp": "interpolate", "bilinear_interp": "interpolate",
+    "trilinear_interp": "interpolate", "nearest_interp": "interpolate",
+    "bicubic_interp": "interpolate",
+}
+
+# reference ops whose surface in this framework is a CLASS or module
+# attribute rather than a flat function; each value is verified by
+# attribute lookup at generation time
+CLASS_COVERAGE = {
+    "adam_": "optimizer.Adam", "adamw_": "optimizer.AdamW",
+    "adamax_": "optimizer.Adamax", "adagrad_": "optimizer.Adagrad",
+    "sgd_": "optimizer.SGD", "momentum_": "optimizer.Momentum",
+    "rmsprop_": "optimizer.RMSProp", "lamb_": "optimizer.Lamb",
+    "lars_momentum_": "distributed.fleet.meta_optimizers.LarsMomentum",
+    "dgc_momentum": "distributed.fleet.meta_optimizers.DGCMomentum",
+    "accuracy": "metric.Accuracy", "auc": "metric.Auc",
+    "clip_by_norm": "nn.ClipGradByNorm",
+    "check_finite_and_unscale_": "amp.GradScaler",
+    "update_loss_scaling_": "amp.GradScaler",
+    "check_numerics": "amp.debugging.check_numerics",
+    "fft_c2c": "fft.fft", "fft_r2c": "fft.rfft", "fft_c2r": "fft.irfft",
+    "depthwise_conv2d": "nn.functional.conv2d",
+    "send_u_recv": "geometric.send_u_recv",
+    "send_ue_recv": "geometric.send_ue_recv",
+    "send_uv": "geometric.send_uv",
+    "graph_send_recv": "geometric.send_u_recv",
+    "segment_pool": "geometric.segment_sum",
+    "dirichlet": "distribution.Dirichlet",
+    "grid_sample": "nn.functional.grid_sample",
+    "affine_grid": "nn.functional.affine_grid",
+    "channel_shuffle": "nn.functional.channel_shuffle",
+    "huber_loss": "nn.functional.huber_loss",
+    "log_loss": "nn.functional.log_loss",
+}
+
+
+def reference_ops(ref_root: str):
+    ops = set()
+    for name in ("ops.yaml", "legacy_ops.yaml"):
+        path = os.path.join(ref_root, "paddle/phi/api/yaml", name)
+        if not os.path.exists(path):
+            continue
+        for line in open(path, encoding="utf-8"):
+            m = re.match(r"^- op\s*:\s*([a-zA-Z0-9_]+)", line)
+            if m:
+                ops.add(m.group(1))
+    return ops
+
+
+def our_surface():
+    """Public callables on the op-bearing namespaces."""
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu as pt
+
+    names = set()
+    spaces = [pt, pt.ops, pt.nn.functional, pt.linalg if hasattr(pt, "linalg")
+              else pt.ops, pt.fft, pt.signal, pt.sparse, pt.geometric]
+    for sp in spaces:
+        for n in dir(sp):
+            if n.startswith("_"):
+                continue
+            if callable(getattr(sp, n, None)):
+                names.add(n)
+    # pallas / fusion kernels
+    from paddle_tpu.ops import pallas_kernels as pk
+
+    for n in dir(pk):
+        if not n.startswith("_"):
+            names.add(n)
+    try:
+        from paddle_tpu.ops.pallas_kernels import flash_attention as fa  # noqa
+        names.add("flash_attention")
+    except Exception:
+        pass
+    from paddle_tpu.ops.pallas_kernels import fused_adamw  # noqa
+
+    names.add("fused_adamw")
+    return names
+
+
+def _resolve_dotted(path):
+    import paddle_tpu as pt
+
+    obj = pt
+    for part in path.split("."):
+        obj = getattr(obj, part, None)
+        if obj is None:
+            return None
+    return obj
+
+
+def classify(ref_ops, ours):
+    covered, missing = {}, []
+    for op in sorted(ref_ops):
+        base = op[:-1] if op.endswith("_") else op  # inplace variants
+        target = None
+        for cand in (op, base, ALIASES.get(op), ALIASES.get(base)):
+            if cand and cand in ours:
+                target = cand
+                break
+        if target is None:
+            dotted = CLASS_COVERAGE.get(op) or CLASS_COVERAGE.get(base)
+            if dotted and _resolve_dotted(dotted) is not None:
+                target = dotted
+        if target:
+            covered[op] = target
+        else:
+            missing.append(op)
+    return covered, missing
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ref", default="/root/reference")
+    ap.add_argument("--out", default=os.path.join(REPO, "OPS_COVERAGE.json"))
+    args = ap.parse_args()
+    ref_ops = reference_ops(args.ref)
+    ours = our_surface()
+    covered, missing = classify(ref_ops, ours)
+    doc = {
+        "reference_manifest_ops": len(ref_ops),
+        "covered": len(covered),
+        "coverage_pct": round(100.0 * len(covered) / max(len(ref_ops), 1), 1),
+        "our_public_callables": len(ours),
+        "missing": missing,
+        "covered_map": covered,
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=False)
+    print(f"{doc['covered']}/{doc['reference_manifest_ops']} reference "
+          f"manifest ops covered ({doc['coverage_pct']}%); "
+          f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
