@@ -55,6 +55,7 @@ from .ops import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa
 from .ops.random import get_rng_state, seed, set_rng_state  # noqa: E402,F401
 from . import autograd  # noqa: E402,F401
 from .autograd import grad  # noqa: E402,F401
+from .tensor_array import array_length, array_read, array_write, create_array  # noqa: E402,F401
 
 CUDAPlace = TPUPlace  # reference-API compat: the accelerator is the TPU
 XPUPlace = TPUPlace

@@ -1,0 +1,97 @@
+"""TensorArray API (reference: python/paddle/tensor/array.py
+create_array/array_read/array_write/array_length over LoDTensorArray —
+a C++ vector<LoDTensor> used by static control flow).
+
+TPU-native: eagerly a TensorArray is a python list of Tensors; inside a
+compiled region a dynamically-indexed read/write must be a fixed-shape
+``jnp.stack``-based gather/scatter, so reads/writes with TRACED indices
+require the array's elements to share shape/dtype (the same constraint
+XLA puts on lax.scan carries — and the same one the reference's
+write-once-per-op semantics implies in practice).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .ops import dispatch
+from .ops._factory import ensure_tensor
+from .tensor import Tensor
+
+__all__ = ["create_array", "array_read", "array_write", "array_length"]
+
+
+def create_array(dtype="float32", initialized_list=None) -> List[Tensor]:
+    """reference array.py:222 — returns the array container."""
+    out: List[Tensor] = []
+    for v in initialized_list or ():
+        out.append(ensure_tensor(v))
+    return out
+
+
+def array_length(array) -> Tensor:
+    """reference array.py:24."""
+    from .ops.creation import to_tensor
+
+    return to_tensor(len(array), dtype="int64")
+
+
+def _static_index(i) -> Optional[int]:
+    if isinstance(i, int):
+        return i
+    t = ensure_tensor(i)
+    if isinstance(t._value, jax.core.Tracer):
+        return None
+    return int(t._value)
+
+
+def array_read(array, i) -> Tensor:
+    """reference array.py:73: read array[i]; traced ``i`` gathers from the
+    stacked elements (fixed shapes required)."""
+    idx = _static_index(i)
+    if idx is not None:
+        return array[idx]
+    if not array:
+        raise IndexError("array_read from an empty TensorArray")
+    it = ensure_tensor(i)
+
+    def raw(iv, *elems):
+        return jnp.stack(elems)[jnp.reshape(iv, ())]
+
+    return dispatch.apply(raw, it, *array, op_name="array_read")
+
+
+def array_write(x, i, array=None) -> List[Tensor]:
+    """reference array.py:141: write x at position i (appending when
+    i == len); traced ``i`` lowers to a masked scatter over the stacked
+    elements."""
+    x = ensure_tensor(x)
+    if array is None:
+        array = []
+    idx = _static_index(i)
+    if idx is not None:
+        if idx == len(array):
+            array.append(x)
+        elif idx < len(array):
+            array[idx] = x
+        else:
+            raise IndexError(
+                f"array_write index {idx} beyond length {len(array)}")
+        return array
+    # traced index: every slot that might be written must already exist
+    it = ensure_tensor(i)
+
+    def raw(iv, xv, *elems):
+        stacked = jnp.stack(elems)
+        sel = (jnp.arange(len(elems)) == jnp.reshape(iv, ()))
+        sel = jnp.reshape(sel, (len(elems),) + (1,) * xv.ndim)
+        return tuple(jnp.where(sel[k], xv, stacked[k])
+                     for k in range(len(elems)))
+
+    outs = dispatch.apply(raw, it, x, *array, op_name="array_write")
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    for k, t in enumerate(outs):
+        array[k] = t
+    return array

@@ -1,0 +1,56 @@
+"""TensorArray API (reference python/paddle/tensor/array.py over
+LoDTensorArray): eager list semantics + traced-index gather/scatter."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_eager_write_read_append():
+    arr = pt.create_array("float32")
+    x0 = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+    x1 = pt.to_tensor(np.array([3.0, 4.0], np.float32))
+    pt.array_write(x0, 0, arr)
+    pt.array_write(x1, 1, arr)  # append at len
+    assert int(pt.array_length(arr)) == 2
+    np.testing.assert_allclose(pt.array_read(arr, 1).numpy(), [3.0, 4.0])
+    pt.array_write(x1, 0, arr)  # overwrite
+    np.testing.assert_allclose(pt.array_read(arr, 0).numpy(), [3.0, 4.0])
+    with pytest.raises(IndexError):
+        pt.array_write(x0, 5, arr)
+
+
+def test_traced_index_read_write():
+    x0 = pt.to_tensor(np.array([1.0, 2.0], np.float32))
+    x1 = pt.to_tensor(np.array([3.0, 4.0], np.float32))
+
+    def fn(i, x):
+        a = pt.create_array(initialized_list=[x0, x1])
+        a = pt.array_write(x, i, a)
+        other = pt.array_read(a, 1 - int(0))  # static read of slot 1
+        return pt.array_read(a, i), other
+
+    compiled = pt.jit.to_static(fn)
+    x = pt.to_tensor(np.array([9.0, 9.0], np.float32))
+    got, other = compiled(pt.to_tensor(np.array(0, np.int64)), x)
+    np.testing.assert_allclose(got.numpy(), [9.0, 9.0])
+    np.testing.assert_allclose(other.numpy(), [3.0, 4.0])
+    got, other = compiled(pt.to_tensor(np.array(1, np.int64)), x)
+    np.testing.assert_allclose(got.numpy(), [9.0, 9.0])
+    np.testing.assert_allclose(other.numpy(), [9.0, 9.0])
+
+
+def test_traced_write_differentiable():
+    def fn(i, x):
+        base = pt.to_tensor(np.zeros(2, np.float32))
+        a = pt.create_array(initialized_list=[base, base])
+        a = pt.array_write(x * 2.0, i, a)
+        loss = pt.ops.sum(pt.array_read(a, i))
+        loss.backward()
+        return loss, x.grad
+
+    compiled = pt.jit.to_static(fn)
+    x = pt.to_tensor(np.array([1.0, 2.0], np.float32), stop_gradient=False)
+    loss, g = compiled(pt.to_tensor(np.array(1, np.int64)), x)
+    np.testing.assert_allclose(float(loss), 6.0)
+    np.testing.assert_allclose(g.numpy(), [2.0, 2.0])
