@@ -238,8 +238,14 @@ def main():
     devs = jax.devices()
     on_tpu = devs[0].platform != "cpu"
     if on_tpu:
-        rung = int(os.environ.get("BENCH_RUNG", "0"))
-        name, batch, seq, steps, remat, pure_bf16 = _RUNGS[rung]
+        custom = os.environ.get("BENCH_CONFIG")  # "model:bs:seq:steps:remat:bf16"
+        if custom:
+            name, batch, seq, steps, remat, pure_bf16 = custom.split(":")
+            batch, seq, steps, remat = map(int, (batch, seq, steps, remat))
+            pure_bf16 = pure_bf16 in ("1", "true", "True")
+        else:
+            rung = int(os.environ.get("BENCH_RUNG", "0"))
+            name, batch, seq, steps, remat, pure_bf16 = _RUNGS[rung]
         mk = gpt_1p3b if name == "1p3b" else gpt_small
         cfg = mk(hidden_dropout=0.0, attention_dropout=0.0,
                  max_position_embeddings=max(seq, 1024),
@@ -257,8 +263,13 @@ def main():
         # pure-bf16 regime: params + moments in bf16 (no fp32 master) —
         # reference analog: amp O2 decorate + adam multi_precision=False
         pt.amp.decorate(model, level="O2", dtype="bfloat16")
+    # BENCH_FUSED_ADAM=1: route the update through the owned Pallas
+    # multi-tensor kernel (ops/pallas_kernels/fused_adamw.py) for A/B
+    # against the XLA-composed chain
     opt = pt.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
-                             multi_precision=not pure_bf16)
+                             multi_precision=not pure_bf16,
+                             use_fused_kernel=os.environ.get(
+                                 "BENCH_FUSED_ADAM") in ("1", "true", "True"))
 
     rng = np.random.RandomState(0)
     ids = pt.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)), dtype="int64")

@@ -7,8 +7,10 @@ static/nn/common.py) are thin functional forms over the nn ops: in this
 architecture there is no separate static graph, so "building an op into
 a program" IS calling the op under jit.to_static tracing.
 """
-from .common import batch_norm, conv2d, embedding, fc  # noqa: F401
+from .common import (  # noqa: F401
+    batch_norm, conv2d, embedding, fc, reset_param_cache, unique_name_guard,
+)
 from .control_flow import Assert, cond, while_loop  # noqa: F401
 
 __all__ = ["cond", "while_loop", "Assert", "fc", "embedding", "conv2d",
-           "batch_norm"]
+           "batch_norm", "reset_param_cache", "unique_name_guard"]

@@ -22,6 +22,56 @@ from ...tensor import Parameter, Tensor
 _param_cache: dict = {}
 
 
+def reset_param_cache():
+    """Drop every builder-created parameter (the analog of starting a
+    fresh Program — reference paddle.static.Program())."""
+    _param_cache.clear()
+
+
+_occ_stack: list = []
+
+
+class unique_name_guard:
+    """reference paddle.utils.unique_name.guard(): within the guard each
+    unnamed builder CALL gets a fresh occurrence index, so layers built
+    in a loop/helper get distinct parameters; re-entering the guard (the
+    next training step re-building the same graph) resets the indices so
+    the SAME parameters are reused.  Enter one guard per model build."""
+
+    def __enter__(self):
+        _occ_stack.append({})
+        return self
+
+    def __exit__(self, *exc):
+        _occ_stack.pop()
+        return False
+
+
+def _auto_key(kind: str, *extra) -> tuple:
+    """Key for an UNNAMED builder parameter: the CALLER's code location
+    (file:lineno outside this module), plus — inside a
+    ``unique_name_guard`` — the per-site occurrence index.  Same call
+    site across training steps -> same parameter (the builder's
+    append-once semantics); two layers built from different lines ->
+    distinct parameters (round-3 weak #10).  LIMITATION without a
+    guard: layers built from the SAME line (a loop or shared helper)
+    share parameters — wrap each build in ``unique_name_guard`` or pass
+    ``name=`` to make them distinct."""
+    import sys
+
+    f = sys._getframe(2)
+    while f is not None and f.f_code.co_filename == __file__:
+        f = f.f_back
+    site = (f.f_code.co_filename, f.f_lineno) if f is not None else ("?", 0)
+    key = (kind,) + site + tuple(extra)
+    if _occ_stack:
+        occ = _occ_stack[-1]
+        n = occ.get(key, -1) + 1
+        occ[key] = n
+        key = key + (n,)
+    return key
+
+
 def _get_param(key, shape, initializer, dtype="float32"):
     from ...core.dtype import to_jax_dtype
 
@@ -36,7 +86,8 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     """reference static/nn/common.py fc: flatten trailing dims, x @ W + b."""
     in_feat = int(np.prod(x.shape[num_flatten_dims:]))
     flat = ops.reshape(x, list(x.shape[:num_flatten_dims]) + [in_feat])
-    key = ("fc", name or f"auto_{id(fc)}_{in_feat}_{size}")
+    key = (("fc", name) if name
+           else _auto_key("fc", in_feat, size))
     w = _get_param(key + ("w",), [in_feat, size], XavierUniform())
     out = ops.matmul(flat, w)
     if bias_attr is not False:
@@ -50,7 +101,8 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
 def embedding(input, size, is_sparse=False, padding_idx=None,  # noqa: A002
               param_attr=None, weight_attr=None, dtype="float32", name=None):
     """reference static/nn/common.py embedding (lookup table)."""
-    key = ("embedding", name or f"auto_emb_{size[0]}_{size[1]}")
+    key = (("embedding", name) if name
+           else _auto_key("embedding", size[0], size[1]))
     from ...nn.initializer import Normal
 
     w = _get_param(key, list(size), Normal(0.0, 0.02), dtype)
@@ -63,7 +115,8 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
     """reference static/nn/common.py conv2d."""
     in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
     fs = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size, filter_size)
-    key = ("conv2d", name or f"auto_conv_{in_ch}_{num_filters}_{fs}")
+    key = (("conv2d", name) if name
+           else _auto_key("conv2d", in_ch, num_filters, tuple(fs)))
     from ...nn.initializer import KaimingUniform
 
     w = _get_param(key + ("w",), [num_filters, in_ch // groups, *fs],
@@ -83,7 +136,7 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,  # noqa: A002
                is_test=False, name=None):
     """reference static/nn/common.py batch_norm (stats as captured state)."""
     ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
-    key = ("bn", name or f"auto_bn_{ch}")
+    key = (("bn", name) if name else _auto_key("bn", ch))
     g = _get_param(key + ("g",), [ch], Constant(1.0))
     b = _get_param(key + ("b",), [ch], Constant(0.0))
     mean = _get_param(key + ("m",), [ch], Constant(0.0))

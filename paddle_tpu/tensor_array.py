@@ -80,7 +80,14 @@ def array_write(x, i, array=None) -> List[Tensor]:
             raise IndexError(
                 f"array_write index {idx} beyond length {len(array)}")
         return array
-    # traced index: every slot that might be written must already exist
+    # traced index: every slot that might be written must already exist.
+    # NOTE: an out-of-range traced index silently leaves the array
+    # unchanged (the mask selects nothing) — data-dependent bounds cannot
+    # raise inside a compiled program; the eager path raises IndexError.
+    if not array:
+        raise IndexError(
+            "array_write with a traced index needs a non-empty "
+            "TensorArray (slots must pre-exist inside compiled programs)")
     it = ensure_tensor(i)
 
     def raw(iv, xv, *elems):

@@ -1,0 +1,82 @@
+"""static.nn builder parameter scoping (round-3 weak #10: the name-keyed
+cache silently shared parameters between two unnamed models)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.static import nn as static_nn
+
+
+def test_two_unnamed_models_get_distinct_params():
+    static_nn.reset_param_cache()
+    x = pt.to_tensor(np.ones((2, 8), np.float32))
+
+    def model_a(x):
+        return static_nn.fc(x, 4)
+
+    def model_b(x):
+        return static_nn.fc(x, 4)  # same dims, DIFFERENT call site
+
+    ya1 = model_a(x).numpy()
+    yb = model_b(x).numpy()
+    ya2 = model_a(x).numpy()
+    # same call site across steps reuses the same parameter
+    np.testing.assert_allclose(ya1, ya2)
+    # different call sites with identical dims must NOT share weights
+    assert not np.allclose(ya1, yb)
+
+
+def test_unique_name_guard_distinguishes_loop_layers():
+    """Layers built from the SAME source line (a loop) get distinct
+    parameters inside unique_name_guard, and re-entering the guard (the
+    next step) reuses them (reference unique_name.guard semantics)."""
+    static_nn.reset_param_cache()
+    from paddle_tpu.static.nn.common import _param_cache
+
+    x = pt.to_tensor(np.ones((2, 8), np.float32))
+
+    def build():
+        h = x
+        with static_nn.unique_name_guard():
+            for _ in range(3):
+                h = static_nn.fc(h, 8)
+        return h
+
+    y1 = build().numpy()
+    n_params = len(_param_cache)
+    assert n_params == 6  # 3 layers x (W, b) — not one shared pair
+    y2 = build().numpy()
+    assert len(_param_cache) == n_params  # second step reuses, no growth
+    np.testing.assert_allclose(y1, y2)
+
+
+def test_named_params_are_shared_on_purpose():
+    static_nn.reset_param_cache()
+    x = pt.to_tensor(np.ones((2, 8), np.float32))
+    y1 = static_nn.fc(x, 4, name="tied")
+    y2 = static_nn.fc(x, 4, name="tied")
+    np.testing.assert_allclose(y1.numpy(), y2.numpy())
+
+
+def test_step_repetition_trains_single_param_set():
+    static_nn.reset_param_cache()
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(8, 8).astype(np.float32))
+    y = pt.to_tensor(rng.randn(8, 4).astype(np.float32))
+
+    def step():
+        out = static_nn.fc(x, 4, name="head")
+        return pt.ops.mean((out - y) ** 2)
+
+    from paddle_tpu.static.nn.common import _param_cache
+
+    losses = []
+    for _ in range(5):
+        loss = step()
+        loss.backward()
+        for p in list(_param_cache.values()):
+            if p.grad is not None:
+                p._set_value(p._value - 0.1 * p.grad._value)
+                p.grad = None
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    assert len(_param_cache) == 2  # one W + one b, not 5 sets
