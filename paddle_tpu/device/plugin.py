@@ -49,7 +49,10 @@ class PJRTBackend(DeviceBackend):
     def _devices(self):
         import jax
 
-        return [d for d in jax.devices() if d.platform == self.name]
+        try:
+            return list(jax.devices(self.name))
+        except RuntimeError:
+            return [d for d in jax.devices() if d.platform == self.name]
 
     def device_count(self) -> int:
         try:
@@ -83,7 +86,10 @@ def _ensure_defaults():
     try:
         platforms = {d.platform for d in jax.devices()}
     except RuntimeError:
-        platforms = {"cpu"}
+        platforms = set()
+    # the host CPU backend always exists even when the default platform is
+    # an accelerator (jax.devices() lists only the default backend)
+    platforms.add("cpu")
     for p in sorted(platforms):
         _registry[p] = PJRTBackend(p)
 
