@@ -10,7 +10,7 @@ from .attention import flash_attention, scaled_dot_product_attention  # noqa: F4
 from .common import (  # noqa: F401
     alpha_dropout, bilinear, cosine_similarity, dropout, dropout2d, dropout3d,
     embedding, interpolate, label_smooth, linear, normalize, one_hot, pad,
-    pixel_shuffle, pixel_unshuffle, sequence_mask, unfold, upsample,
+    fold, pixel_shuffle, pixel_unshuffle, sequence_mask, unfold, upsample,
 )
 from .conv import (  # noqa: F401
     conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d, conv3d_transpose,

@@ -343,3 +343,38 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     return dispatch.apply_nondiff(
         lambda a: (jnp.arange(m)[None, :] < a[..., None]).astype(jd), x
     )
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """reference phi fold (col2im): inverse of unfold — scatter-add
+    sliding-block columns [N, C*kh*kw, L] back onto [N, C, H, W]."""
+    x = ensure_tensor(x)
+
+    def pair(v):
+        return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+    oh, ow = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    ph, pw = pair(paddings)
+    dh, dw = pair(dilations)
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (kh * kw)
+        n_h = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        n_w = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        assert n_h * n_w == L, (
+            f"fold: L={L} inconsistent with output_sizes (expect {n_h * n_w})")
+        cols = a.reshape(n, c, kh, kw, n_h, n_w)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                ys = i * dh + sh * jnp.arange(n_h)
+                xs = j * dw + sw * jnp.arange(n_w)
+                out = out.at[:, :, ys[:, None], xs[None, :]].add(
+                    cols[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+
+    return dispatch.apply(fn, x, op_name="fold")

@@ -77,9 +77,11 @@ from .manipulation import (  # noqa: F401
     index_select,
     moveaxis,
     diag_embed,
+    fill_,
     fill_diagonal_,
     fill_diagonal_tensor,
     gather_tree,
+    zero_,
     numel,
     put_along_axis,
     repeat_interleave,

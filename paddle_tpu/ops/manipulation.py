@@ -610,3 +610,15 @@ def gather_tree(ids, parents, name=None):
         return toks[::-1]
 
     return dispatch.apply(fn, ids_t, par_t, op_name="gather_tree")
+
+
+def fill_(x, value, name=None):
+    """reference Tensor.fill_: in-place fill with a scalar."""
+    t = ensure_tensor(x)
+    t._set_value(jnp.full_like(t._value, value))
+    return t
+
+
+def zero_(x, name=None):
+    """reference Tensor.zero_."""
+    return fill_(x, 0.0)

@@ -84,6 +84,27 @@ def test_channel_shuffle_matches_torch():
     np.testing.assert_allclose(ours, ref)
 
 
+def test_fold_unfold_roundtrip_matches_torch():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 6, 8).astype(np.float32)
+    cols = F.unfold(pt.to_tensor(x), [2, 2], strides=2)
+    ref_cols = TF.unfold(torch.tensor(x), (2, 2), stride=2).numpy()
+    np.testing.assert_allclose(cols.numpy(), ref_cols, rtol=1e-6)
+    back = F.fold(cols, [6, 8], [2, 2], strides=2)
+    ref_back = TF.fold(torch.tensor(ref_cols), (6, 8), (2, 2),
+                       stride=2).numpy()
+    np.testing.assert_allclose(back.numpy(), ref_back, rtol=1e-6)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)  # stride=kernel
+
+
+def test_fill_and_zero_inplace():
+    x = pt.to_tensor(np.ones((2, 3), np.float32))
+    pt.ops.fill_(x, 4.0)
+    np.testing.assert_allclose(x.numpy(), np.full((2, 3), 4.0))
+    pt.ops.zero_(x)
+    np.testing.assert_allclose(x.numpy(), np.zeros((2, 3)))
+
+
 def test_exponential_inplace():
     pt.seed(0)
     x = pt.to_tensor(np.zeros(5000, np.float32))

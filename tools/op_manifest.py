@@ -38,6 +38,10 @@ ALIASES = {
     "kldiv_loss": "kl_div",
     "logsigmoid": "log_sigmoid",
     "frobenius_norm": "norm",
+    "fill": "fill_",
+    "assign_out_": "assign",
+    "assign_value_": "assign",
+    "copy_to": "clone",
     "linear_interp": "interpolate", "bilinear_interp": "interpolate",
     "trilinear_interp": "interpolate", "nearest_interp": "interpolate",
     "bicubic_interp": "interpolate",
