@@ -14,6 +14,6 @@ export JAX_COMPILATION_CACHE_DIR=/tmp/paddle_tpu_jax_cache
 
 if [ "$1" = "--all" ]; then
     shift
-    exec python -m pytest "$@"
+    exec python -m pytest -m "slow or not slow" "$@"
 fi
-exec python -m pytest -m "not slow" "$@"
+exec python -m pytest "$@"
