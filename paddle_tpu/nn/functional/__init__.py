@@ -22,7 +22,7 @@ from .loss import (  # noqa: F401
     nll_loss, smooth_l1_loss, softmax_with_cross_entropy, square_error_cost,
     triplet_margin_loss,
 )
-from .vision import affine_grid, channel_shuffle, grid_sample  # noqa: F401
+from .vision import affine_grid, channel_shuffle, grid_sample, temporal_shift  # noqa: F401
 from .norm import (  # noqa: F401
     batch_norm, group_norm, instance_norm, layer_norm, local_response_norm,
     rms_norm,
@@ -30,5 +30,5 @@ from .norm import (  # noqa: F401
 from .pooling import (  # noqa: F401
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_max_pool1d,
     adaptive_max_pool2d, avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d,
-    max_pool2d, max_pool3d,
+    max_pool2d, max_pool3d, max_unpool2d,
 )

@@ -67,12 +67,13 @@ def _max_pool(x, kernel_size, stride, padding, return_mask, ceil_mode, data_form
 
     out = dispatch.apply(fn, x, op_name="max_pool")
     if return_mask:
-        idx = dispatch.apply_nondiff(lambda a: _argmax_pool(a, dims, strides, pairs, off), x)
+        idx = dispatch.apply_nondiff(
+            lambda a: _argmax_pool(a, dims, strides, pairs, off, nsp), x)
         return out, idx
     return out
 
 
-def _argmax_pool(a, dims, strides, pairs, off):
+def _argmax_pool(a, dims, strides, pairs, off, nsp=None):
     flat_idx = jnp.arange(a.size, dtype=jnp.float64).reshape(a.shape)
     # pack (value, index): use a reduce over tuples via argmax trick
     def select(x1, x2):
@@ -93,7 +94,14 @@ def _argmax_pool(a, dims, strides, pairs, off):
         strides,
         pad_arg,
     )
-    return idx.astype(jnp.int64)
+    idx = idx.astype(jnp.int64)
+    if nsp is not None and off == 2:
+        # NC-leading layouts: paddle's mask is the index WITHIN each
+        # (N, C) plane (h*W + w), not the global flat index — and the
+        # spatial dims are innermost/contiguous so a modulo converts
+        plane = int(np.prod(a.shape[off:off + nsp]))
+        idx = idx % plane
+    return idx
 
 
 def avg_pool2d(
@@ -227,3 +235,35 @@ def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
         )
 
     return dispatch.apply(fn, x, op_name="adaptive_max_pool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """reference phi unpool: scatter pooled values back to the positions
+    recorded by max_pool2d(return_mask=True) (per-(N,C)-plane h*W+w
+    indices); everything else is zero."""
+    if data_format != "NCHW":
+        raise NotImplementedError("max_unpool2d supports NCHW")
+    x = ensure_tensor(x)
+    indices = ensure_tensor(indices)
+    ks = _tuple_n(kernel_size, 2)
+    st = _tuple_n(stride if stride is not None else kernel_size, 2)
+    pd = _tuple_n(padding, 2)
+    n_, c_, hh, ww = x._value.shape
+    if output_size is not None:
+        oh, ow = [int(v) for v in output_size[-2:]]
+    else:
+        oh = (hh - 1) * st[0] - 2 * pd[0] + ks[0]
+        ow = (ww - 1) * st[1] - 2 * pd[1] + ks[1]
+
+    def fn(a, idx):
+        n, c = a.shape[0], a.shape[1]
+        flat = jnp.zeros((n, c, oh * ow), a.dtype)
+        b = jnp.arange(n)[:, None, None]
+        ch = jnp.arange(c)[None, :, None]
+        vals = a.reshape(n, c, -1)
+        ii = idx.reshape(n, c, -1)
+        flat = flat.at[b, ch, ii].set(vals)
+        return flat.reshape(n, c, oh, ow)
+
+    return dispatch.apply(fn, x, indices, op_name="max_unpool2d")

@@ -108,3 +108,34 @@ def channel_shuffle(x, groups, data_format="NCHW", name=None):
         return a.reshape(n, h, w, c)
 
     return dispatch.apply(fn, x, op_name="channel_shuffle")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """reference phi temporal_shift (TSM): shift a channel slice one
+    step along the segment (time) axis in each direction."""
+    x = ensure_tensor(x)
+    if data_format not in ("NCHW", "NHWC"):
+        raise NotImplementedError(f"temporal_shift {data_format!r}")
+
+    def fn(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        pad = jnp.zeros((n, 1, c, h, w), a.dtype)
+        # reference cpu/temporal_shift_kernel.cc: channels [:c1] read
+        # t-1 (shift forward in time), [c1:c2] read t+1
+        from_prev = jnp.concatenate([pad, v[:, :-1]], axis=1)[:, :, :c1]
+        from_next = jnp.concatenate([v[:, 1:], pad], axis=1)[:, :, c1:c2]
+        keep = v[:, :, c2:]
+        out = jnp.concatenate([from_prev, from_next, keep],
+                              axis=2).reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return dispatch.apply(fn, x, op_name="temporal_shift")

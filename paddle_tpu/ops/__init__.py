@@ -135,6 +135,7 @@ from .random import (  # noqa: F401
     set_rng_state,
     standard_normal,
     uniform,
+    uniform_,
 )
 from .reduction import (  # noqa: F401
     all,

@@ -518,3 +518,14 @@ def take(x, index, mode="raise", name=None):  # noqa: A002
         return jnp.take(flat, idx.reshape(-1)).reshape(idx.shape)
 
     return dispatch.apply(fn, x, index, op_name="take")
+
+
+def squared_l2_norm(x, name=None):
+    """reference phi squared_l2_norm (grad-clip helper): sum(x*x) as a
+    scalar, accumulated at >= fp32 and returned in the accumulation
+    dtype (float64 inputs keep float64, like the kernel's MPDType)."""
+    x = ensure_tensor(x)
+    return dispatch.apply(
+        lambda a: jnp.sum(jnp.square(
+            a.astype(jnp.promote_types(a.dtype, jnp.float32)))),
+        x, op_name="squared_l2_norm")

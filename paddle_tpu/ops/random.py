@@ -225,3 +225,15 @@ def exponential_(x, lam=1.0, name=None):
     samples = jax.random.exponential(key, x._value.shape) / lam
     x._set_value(samples.astype(x._value.dtype))
     return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    """reference Tensor.uniform_ (uniform_inplace op): fill x in place
+    with U[min, max); a nonzero seed gives a deterministic fill (same
+    contract as ``uniform``)."""
+    x = ensure_tensor(x)
+    key = default_generator.split() if seed == 0 else jax.random.PRNGKey(seed)
+    samples = jax.random.uniform(key, x._value.shape, jnp.float32,
+                                 minval=min, maxval=max)
+    x._set_value(samples.astype(x._value.dtype))
+    return x

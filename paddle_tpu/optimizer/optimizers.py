@@ -11,7 +11,7 @@ from ..ops import dispatch
 from ..tensor import Tensor
 from .optimizer import Optimizer
 
-__all__ = ["SGD", "Momentum", "Adagrad", "RMSProp", "Adam", "AdamW", "Adamax", "Lamb"]
+__all__ = ["SGD", "Momentum", "Adagrad", "Adadelta", "RMSProp", "Adam", "AdamW", "Adamax", "Lamb"]
 
 
 class SGD(Optimizer):
@@ -373,3 +373,39 @@ class Lamb(Optimizer):
         m1._set_value(new_m1.astype(m1._value.dtype))
         m2._set_value(new_m2.astype(m2._value.dtype))
         self._write_param(p, (pv - lr * trust * update).astype(p._value.dtype))
+
+
+class Adadelta(Optimizer):
+    """reference python/paddle/optimizer/adadelta.py (phi adadelta
+    kernel): E[g^2] and E[dx^2] running averages; the update needs no
+    global learning rate (lr multiplies the final delta for parity)."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        self._epsilon = epsilon
+        self._rho = rho
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+
+    def _create_accumulators(self, params):
+        for p in params:
+            self._add_accumulator("avg_squared_grad", p)
+            self._add_accumulator("avg_squared_update", p)
+
+    def _apply_one(self, p, g):
+        lr = self._lr_value()
+        eg = self._get_accumulator("avg_squared_grad", p)
+        ex = self._get_accumulator("avg_squared_update", p)
+        dispatch.note_read(eg)
+        dispatch.note_read(ex)
+        gv = self._decayed_grad(p, g._value.astype(jnp.float32))
+        rho, eps = self._rho, self._epsilon
+        new_eg = rho * eg._value + (1 - rho) * gv * gv
+        delta = jnp.sqrt((ex._value + eps) / (new_eg + eps)) * gv
+        new_ex = rho * ex._value + (1 - rho) * delta * delta
+        eg._set_value(new_eg)
+        ex._set_value(new_ex)
+        self._write_param(
+            p, (p._value.astype(jnp.float32) - lr * delta)
+            .astype(p._value.dtype))

@@ -15,7 +15,7 @@ import numpy as np
 from .ops import dispatch
 from .ops._factory import ensure_tensor
 
-__all__ = ["stft", "istft"]
+__all__ = ["stft", "istft", "frame", "overlap_add"]
 
 
 def _frame(a, frame_length, hop_length):
@@ -118,3 +118,51 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
 
     args = (x, window) if window is not None else (x,)
     return dispatch.apply(fn, *args, op_name="istft")
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """reference signal.py frame op: split the time axis into overlapping
+    frames.  axis=-1 -> [..., frame_length, num_frames]; axis=0 ->
+    [num_frames, frame_length, ...]."""
+    x = ensure_tensor(x)
+    if axis not in (-1, 0):
+        raise ValueError("frame: axis must be 0 or -1")
+
+    def fn(a):
+        if axis == -1:
+            return _frame(a, frame_length, hop_length)
+        t = a.shape[0]
+        n_frames = 1 + (t - frame_length) // hop_length
+        idx = (hop_length * np.arange(n_frames)[:, None]
+               + np.arange(frame_length)[None, :])      # [nf, fl]
+        return a[idx]
+
+    return dispatch.apply(fn, x, op_name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """reference signal.py overlap_add: inverse of frame — scatter-add
+    overlapping frames back onto the time axis."""
+    x = ensure_tensor(x)
+    if axis not in (-1, 0):
+        raise ValueError("overlap_add: axis must be 0 or -1")
+
+    def fn(a):
+        # ONE scatter-add over the same index grid frame() gathers with —
+        # a python loop of .at[].add would unroll into nf sequential
+        # dynamic-update-slices under jit
+        if axis == -1:
+            fl, nf = a.shape[-2], a.shape[-1]
+            t = fl + hop_length * (nf - 1)
+            idx = (np.arange(fl)[:, None]
+                   + hop_length * np.arange(nf)[None, :])   # [fl, nf]
+            out = jnp.zeros(a.shape[:-2] + (t,), a.dtype)
+            return out.at[..., idx].add(a)
+        nf, fl = a.shape[0], a.shape[1]
+        t = fl + hop_length * (nf - 1)
+        idx = (hop_length * np.arange(nf)[:, None]
+               + np.arange(fl)[None, :])                    # [nf, fl]
+        out = jnp.zeros((t,) + a.shape[2:], a.dtype)
+        return out.at[idx].add(a)
+
+    return dispatch.apply(fn, x, op_name="overlap_add")

@@ -3,4 +3,97 @@ the text datasets).  Zero-egress build: datasets parse canonical LOCAL
 files and raise clearly when absent."""
 from .datasets import Imdb, UCIHousing  # noqa: F401
 
-__all__ = ["Imdb", "UCIHousing"]
+__all__ = ["Imdb", "UCIHousing", "viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """reference python/paddle/text/viterbi_decode.py (phi viterbi_decode
+    kernel): max-sum dynamic program over tag sequences.
+
+    potentials [B, T, N], transition [N, N], lengths [B] -> (scores [B],
+    paths [B, T_max_len]).  include_bos_eos_tag treats the last row/col
+    as START and second-to-last as STOP (reference semantics).
+
+    TPU-native: the forward max-sum is a lax.scan carrying (alpha,
+    backpointers); the backtrace is a reversed scan — one compiled
+    program, batch-parallel on the VPU."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import dispatch
+    from ..ops._factory import ensure_tensor
+
+    pot = ensure_tensor(potentials)
+    trans = ensure_tensor(transition_params)
+    lens = ensure_tensor(lengths)
+
+    def fn(p, tr, ln):
+        B, T, N = p.shape
+        ln = ln.astype(jnp.int32)
+        if include_bos_eos_tag:
+            # reference viterbi_decode_kernel.cc: ROW -1 is the start
+            # transition, ROW -2 the stop transition
+            start = tr[-1][None, :]                  # [1, N]
+            stop = tr[-2][None, :]                   # [1, N]
+            alpha0 = p[:, 0] + start
+        else:
+            alpha0 = p[:, 0]
+            stop = jnp.zeros((1, N), p.dtype)
+
+        def step(carry, xs):
+            alpha, t = carry
+            emit = xs                                 # [B, N]
+            # scores[b, i, j] = alpha[b, i] + trans[i, j]
+            scores = alpha[:, :, None] + tr[None, :, :]
+            best = jnp.max(scores, axis=1) + emit     # [B, N]
+            bp = jnp.argmax(scores, axis=1)           # [B, N]
+            # positions past each sequence's length keep alpha frozen and
+            # their backpointers are the IDENTITY so the backtrace carries
+            # the final tag through the padding unchanged
+            active = (t < ln)[:, None]
+            new_alpha = jnp.where(active, best, alpha)
+            ident = jnp.broadcast_to(jnp.arange(N), bp.shape)
+            bp = jnp.where(active, bp, ident)
+            return (new_alpha, t + 1), bp
+
+        (alpha, _), bps = jax.lax.scan(
+            step, (alpha0, jnp.asarray(1, jnp.int32)),
+            jnp.swapaxes(p[:, 1:], 0, 1))            # [T-1, B, N]
+        final = alpha + (stop if include_bos_eos_tag else 0.0)
+        scores = jnp.max(final, axis=-1)
+        last_tag = jnp.argmax(final, axis=-1)        # [B]
+
+        def back(carry, bp_t):
+            tag = carry                               # [B]
+            prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        _, tags_rev = jax.lax.scan(back, last_tag, bps, reverse=True)
+        # tags_rev[t] is the tag at position t+1; position 0's tag is the
+        # backpointer of step t=1 selected by the tag at t=1
+        if T > 1:
+            tag0 = jnp.take_along_axis(bps[0], tags_rev[0][:, None],
+                                       axis=1)[:, 0]
+            path = jnp.concatenate([tag0[:, None],
+                                    jnp.swapaxes(tags_rev, 0, 1)], axis=1)
+        else:
+            path = last_tag[:, None]
+        # padded positions report 0 (reference zero-fills beyond length)
+        path = jnp.where(jnp.arange(path.shape[1])[None, :] < ln[:, None],
+                         path, 0)
+        return scores, path.astype(jnp.int64)
+
+    return dispatch.apply(fn, pot, trans, lens, op_name="viterbi_decode")
+
+
+class ViterbiDecoder:
+    """reference text/viterbi_decode.py ViterbiDecoder layer wrapper."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

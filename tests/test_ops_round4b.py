@@ -1,0 +1,128 @@
+"""Round-4 op batch B: signal frame/overlap_add, temporal_shift,
+max-pool masks + unpool, uniform_, squared_l2_norm, viterbi_decode."""
+import itertools
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+def test_frame_overlap_add_roundtrip():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 16).astype(np.float32)
+    fr = pt.signal.frame(pt.to_tensor(x), frame_length=4, hop_length=4)
+    assert fr.shape == [2, 4, 4]
+    back = pt.signal.overlap_add(fr, hop_length=4)
+    np.testing.assert_allclose(back.numpy(), x, rtol=1e-6)
+    # overlapping windows sum in the overlap
+    fr2 = pt.signal.frame(pt.to_tensor(x), frame_length=4, hop_length=2)
+    ola = pt.signal.overlap_add(fr2, hop_length=2).numpy()
+    # interior samples counted twice
+    np.testing.assert_allclose(ola[:, 4], 2 * x[:, 4], rtol=1e-6)
+
+
+def test_temporal_shift_matches_reference_semantics():
+    nt, c, h, w = 4, 8, 2, 2  # n=2 segments of 2
+    x = np.arange(nt * c * h * w, dtype=np.float32).reshape(nt, c, h, w)
+    out = F.temporal_shift(pt.to_tensor(x), seg_num=2,
+                           shift_ratio=0.25).numpy()
+    v = x.reshape(2, 2, c, h, w)
+    # reference semantics (temporal_shift_kernel.cc): first quarter reads
+    # t-1 (zero at t=0), second quarter reads t+1 (zero at the last t)
+    np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[:, 1, :2],
+                               v[:, 0, :2])
+    np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[:, 0, :2], 0.0)
+    np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[:, 0, 2:4],
+                               v[:, 1, 2:4])
+    np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[:, 1, 2:4], 0.0)
+    # rest untouched
+    np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[:, :, 4:],
+                               v[:, :, 4:])
+
+
+def test_max_pool_mask_matches_torch_and_unpool_roundtrip():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    out, mask = F.max_pool2d(pt.to_tensor(x), 2, stride=2, return_mask=True)
+    t_out, t_idx = torch.nn.functional.max_pool2d(
+        torch.tensor(x), 2, stride=2, return_indices=True)
+    np.testing.assert_allclose(out.numpy(), t_out.numpy())
+    np.testing.assert_array_equal(mask.numpy(), t_idx.numpy())
+
+    un = F.max_unpool2d(out, mask, 2, stride=2)
+    t_un = torch.nn.functional.max_unpool2d(t_out, t_idx, 2, stride=2)
+    np.testing.assert_allclose(un.numpy(), t_un.numpy())
+
+
+def test_uniform_and_squared_l2_norm():
+    pt.seed(4)
+    x = pt.to_tensor(np.zeros(4000, np.float32))
+    pt.ops.uniform_(x, min=2.0, max=4.0)
+    a = x.numpy()
+    assert a.min() >= 2.0 and a.max() < 4.0 and abs(a.mean() - 3.0) < 0.1
+    s = float(pt.ops.squared_l2_norm(x))
+    np.testing.assert_allclose(s, (a.astype(np.float64) ** 2).sum(),
+                               rtol=1e-5)
+
+
+def test_viterbi_decode_matches_brute_force():
+    from paddle_tpu.text import ViterbiDecoder, viterbi_decode
+
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 5, 4
+    pot = rng.rand(B, T, N).astype(np.float32)
+    tr = rng.rand(N, N).astype(np.float32)
+    lens = np.array([5, 3, 1], np.int64)
+    scores, paths = viterbi_decode(
+        pt.to_tensor(pot), pt.to_tensor(tr), pt.to_tensor(lens),
+        include_bos_eos_tag=False)
+    scores, paths = scores.numpy(), paths.numpy()
+    for b in range(B):
+        L = int(lens[b])
+        best, best_path = -1e9, None
+        for seq in itertools.product(range(N), repeat=L):
+            s = pot[b, 0, seq[0]] + sum(
+                tr[seq[i - 1], seq[i]] + pot[b, i, seq[i]]
+                for i in range(1, L))
+            if s > best:
+                best, best_path = s, seq
+        np.testing.assert_allclose(scores[b], best, rtol=1e-5)
+        assert tuple(paths[b][:L]) == best_path
+
+    dec = ViterbiDecoder(pt.to_tensor(tr), include_bos_eos_tag=False)
+    s2, p2 = dec(pt.to_tensor(pot), pt.to_tensor(lens))
+    np.testing.assert_allclose(s2.numpy(), scores, rtol=1e-6)
+
+
+def test_viterbi_decode_bos_eos_and_padding():
+    """include_bos_eos_tag=True: ROW -1 = start, ROW -2 = stop transition
+    (reference viterbi_decode kernel); short sequences backtrace from the
+    stop-adjusted final tag and pad with 0."""
+    from paddle_tpu.text import viterbi_decode
+
+    rng = np.random.RandomState(7)
+    B, T, N = 3, 4, 5  # tags 0..2 real, 3 = stop-ish, 4 = start-ish rows
+    pot = rng.rand(B, T, N).astype(np.float32)
+    tr = rng.rand(N, N).astype(np.float32) * 3.0  # asymmetric, impactful
+    lens = np.array([4, 2, 3], np.int64)
+    scores, paths = viterbi_decode(
+        pt.to_tensor(pot), pt.to_tensor(tr), pt.to_tensor(lens),
+        include_bos_eos_tag=True)
+    scores, paths = scores.numpy(), paths.numpy()
+    start, stop = tr[-1], tr[-2]
+    for b in range(B):
+        L = int(lens[b])
+        best, best_path = -1e9, None
+        for seq in itertools.product(range(N), repeat=L):
+            s = start[seq[0]] + pot[b, 0, seq[0]] + sum(
+                tr[seq[i - 1], seq[i]] + pot[b, i, seq[i]]
+                for i in range(1, L)) + stop[seq[-1]]
+            if s > best:
+                best, best_path = s, seq
+        np.testing.assert_allclose(scores[b], best, rtol=1e-5)
+        assert tuple(paths[b][:L]) == best_path, (b, paths[b], best_path)
+        assert (paths[b][L:] == 0).all()

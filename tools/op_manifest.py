@@ -39,6 +39,17 @@ ALIASES = {
     "logsigmoid": "log_sigmoid",
     "frobenius_norm": "norm",
     "fill": "fill_",
+    "uniform_inplace": "uniform_",
+    "mean_all": "mean",
+    "p_norm": "norm",
+    "pad3d": "pad",
+    "pool2d": "avg_pool2d",
+    "pool3d": "avg_pool3d",
+    "split_with_num": "split",
+    "trans_layout": "transpose",
+    "max_pool2d_with_index": "max_pool2d",
+    "max_pool3d_with_index": "max_pool3d",
+    "flash_attn_unpadded": "flash_attention",
     "assign_out_": "assign",
     "assign_value_": "assign",
     "copy_to": "clone",
@@ -70,6 +81,19 @@ CLASS_COVERAGE = {
     "graph_send_recv": "geometric.send_u_recv",
     "segment_pool": "geometric.segment_sum",
     "dirichlet": "distribution.Dirichlet",
+    "rnn": "nn.RNN",
+    "sync_batch_norm_": "nn.SyncBatchNorm",
+    "truncated_gaussian_random": "nn.initializer.TruncatedNormal",
+    "viterbi_decode": "text.viterbi_decode",
+    "temporal_shift": "nn.functional.temporal_shift",
+    "unpool": "nn.functional.max_unpool2d",
+    "matrix_rank_tol": "ops.linalg.matrix_rank",
+    "warpctc": "nn.functional.ctc_loss",
+    "memory_efficient_attention": "nn.functional.scaled_dot_product_attention",
+    "merged_adam_": "optimizer.Adam",
+    "merged_momentum_": "optimizer.Momentum",
+    "adadelta_": "optimizer.Adadelta",
+    "tanh_shrink": "nn.functional.tanhshrink",
     "grid_sample": "nn.functional.grid_sample",
     "affine_grid": "nn.functional.affine_grid",
     "channel_shuffle": "nn.functional.channel_shuffle",
