@@ -198,8 +198,22 @@ def _run_child(env, timeout):
 def parent():
     tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
     cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
+    # the axon terminal can be transiently unavailable for many minutes
+    # (session-claim recovery); retry the cheap probe before abandoning
+    # the on-TPU measurement for the CPU cliff
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "480"))
+    probe_retries = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
+    probed = False
+    for attempt in range(1 + probe_retries):
+        if _probe_backend(timeout=probe_timeout):
+            probed = True
+            break
+        if attempt < probe_retries:
+            sys.stderr.write(f"bench: probe attempt {attempt + 1} failed; "
+                             "retrying in 60s\n")
+            time.sleep(60)
     line = None
-    if _probe_backend():
+    if probed:
         hbm = _probe_hbm()
         sys.stderr.write(f"bench: HBM capacity probe: "
                          f"{hbm:.0f} GiB usable\n" if hbm >= 0 else

@@ -28,7 +28,7 @@ from .modules.loss import (  # noqa: F401
 from .modules.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm, InstanceNorm1D,
     InstanceNorm2D, InstanceNorm3D, LayerNorm, LocalResponseNorm, RMSNorm,
-    SyncBatchNorm,
+    SpectralNorm, SyncBatchNorm,
 )
 from .modules.pooling import (  # noqa: F401
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool1D, AdaptiveMaxPool2D,

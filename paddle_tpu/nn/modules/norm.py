@@ -1,6 +1,7 @@
 """Norm layers (reference: python/paddle/nn/layer/norm.py)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ...tensor import Tensor
@@ -196,6 +197,68 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, *args, **kwargs):
+    """reference nn/layer/norm.py SpectralNorm (phi spectral_norm kernel):
+    normalize a weight by its largest singular value, estimated with
+    ``power_iters`` rounds of power iteration on persistent u/v vectors.
+
+    TPU-native: the u/v state are buffers mutated via ``_set_value`` so the
+    power iteration functionalizes into the compiled step like optimizer
+    state; the matmuls are tiny MXU calls."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32", name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm lands with the GAN stack")
+        import numpy as _np
+
+        from ...ops.random import derive_numpy_rng
+
+        self.dim = int(dim)
+        self.power_iters = int(power_iters)
+        self.eps = float(eps)
+        self._shape = list(weight_shape)
+        h = self._shape[self.dim]
+        w = int(_np.prod(self._shape)) // h
+        rng = derive_numpy_rng()
+        u = rng.randn(h).astype(_np.float32)
+        v = rng.randn(w).astype(_np.float32)
+        from ...tensor import Tensor as _T
+
+        # registered buffers: checkpointed in state_dict and moved with
+        # the layer, like the reference's weight_u/weight_v parameters
+        self.register_buffer(
+            "weight_u", _T(jnp.asarray(u / (_np.linalg.norm(u) + eps))))
+        self.register_buffer(
+            "weight_v", _T(jnp.asarray(v / (_np.linalg.norm(v) + eps))))
+
+    def forward(self, weight):
+        from ...ops import dispatch as _dispatch
+        from ...ops._factory import ensure_tensor
+
+        weight = ensure_tensor(weight)
+        u_t, v_t = self.weight_u, self.weight_v
+        _dispatch.note_read(u_t)
+        _dispatch.note_read(v_t)
+        dim, iters, eps = self.dim, self.power_iters, self.eps
+
+        def fn(w_raw, u, v):
+            perm = [dim] + [d for d in range(w_raw.ndim) if d != dim]
+            mat = jnp.transpose(w_raw, perm).reshape(w_raw.shape[dim], -1)
+            # power iteration runs on a gradient-stopped copy: the
+            # reference kernel treats the converged u/v as CONSTANTS in
+            # the backward pass (only sigma = u^T W v carries gradient)
+            mat_ng = jax.lax.stop_gradient(mat)
+
+            def l2n(x):
+                return x / (jnp.linalg.norm(x) + eps)
+
+            for _ in range(iters):
+                v = l2n(mat_ng.T @ u)
+                u = l2n(mat_ng @ v)
+            sigma = u @ mat @ v
+            return w_raw / sigma, u, v
+
+        out, new_u, new_v = _dispatch.apply(
+            fn, weight, u_t, v_t, op_name="spectral_norm")
+        u_t._set_value(jax.lax.stop_gradient(new_u._value))
+        v_t._set_value(jax.lax.stop_gradient(new_v._value))
+        return out

@@ -1,0 +1,264 @@
+"""Detection ops (reference: python/paddle/vision/ops.py — prior_box,
+box_coder, roi_align, nms over phi kernels).
+
+TPU-native split: box/anchor arithmetic and ROI sampling are pure jnp
+(differentiable, MXU/VPU-friendly); hard NMS is data-dependent
+(variable-length output) and runs EAGERLY on host indices like the
+reference's CPU kernel — inference-time post-processing, not a training
+hot path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import dispatch
+from ..ops._factory import ensure_tensor
+from ..tensor import Tensor
+
+__all__ = ["nms", "box_coder", "roi_align", "prior_box", "edit_distance"]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """reference vision/ops.py:1853 — hard NMS; returns kept indices
+    (int64), score-descending.  Eager/host computation (variable-length
+    output cannot trace)."""
+    b = np.asarray(ensure_tensor(boxes)._value, np.float32)
+    n = b.shape[0]
+    s = (np.arange(n)[::-1].astype(np.float32) if scores is None
+         else np.asarray(ensure_tensor(scores)._value, np.float32))
+    cats = (None if category_idxs is None
+            else np.asarray(ensure_tensor(category_idxs)._value))
+
+    def iou(a, rest):
+        x1 = np.maximum(a[0], rest[:, 0])
+        y1 = np.maximum(a[1], rest[:, 1])
+        x2 = np.minimum(a[2], rest[:, 2])
+        y2 = np.minimum(a[3], rest[:, 3])
+        inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+        area_a = (a[2] - a[0]) * (a[3] - a[1])
+        area_r = (rest[:, 2] - rest[:, 0]) * (rest[:, 3] - rest[:, 1])
+        return inter / np.maximum(area_a + area_r - inter, 1e-10)
+
+    order = np.argsort(-s, kind="stable")
+    keep = []
+    suppressed = np.zeros(n, bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        rest = ~suppressed
+        rest[i] = False
+        idxs = np.where(rest)[0]
+        if idxs.size:
+            ious = iou(b[i], b[idxs])
+            same_cat = (np.ones(idxs.size, bool) if cats is None
+                        else cats[idxs] == cats[i])
+            suppressed[idxs[(ious > iou_threshold) & same_cat]] = True
+    keep = np.asarray(keep, np.int64)
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(jnp.asarray(keep))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """reference vision/ops.py:572 (phi box_coder kernel): encode boxes
+    against priors or decode deltas back to boxes."""
+    pb = ensure_tensor(prior_box)
+    tb = ensure_tensor(target_box)
+    pbv = None if prior_box_var is None else ensure_tensor(prior_box_var)
+    norm = 0.0 if box_normalized else 1.0
+
+    def prior_cxcywh(p):
+        pw = p[:, 2] - p[:, 0] + norm
+        ph = p[:, 3] - p[:, 1] + norm
+        pcx = p[:, 0] + pw / 2
+        pcy = p[:, 1] + ph / 2
+        return pcx, pcy, pw, ph
+
+    if code_type == "encode_center_size":
+        def fn(p, t, *var):
+            pcx, pcy, pw, ph = prior_cxcywh(p)
+            tw = t[:, 2] - t[:, 0] + norm
+            th = t[:, 3] - t[:, 1] + norm
+            tcx = t[:, 0] + tw / 2
+            tcy = t[:, 1] + th / 2
+            # every target against every prior: [T, P, 4]
+            dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            dw = jnp.log(tw[:, None] / pw[None, :])
+            dh = jnp.log(th[:, None] / ph[None, :])
+            out = jnp.stack([dx, dy, dw, dh], axis=-1)
+            if var:
+                out = out / var[0][None, :, :]
+            return out
+
+    elif code_type == "decode_center_size":
+        if axis != 0:
+            raise NotImplementedError(
+                "box_coder decode supports axis=0 (priors paired per row); "
+                "axis=1 broadcasting is not implemented")
+
+        def fn(p, t, *var):
+            pcx, pcy, pw, ph = prior_cxcywh(p)
+            d = t * var[0] if var else t          # [N, 4] deltas
+            cx = d[:, 0] * pw + pcx
+            cy = d[:, 1] * ph + pcy
+            w = jnp.exp(d[:, 2]) * pw
+            h = jnp.exp(d[:, 3]) * ph
+            return jnp.stack([cx - w / 2, cy - h / 2,
+                              cx + w / 2 - norm, cy + h / 2 - norm], axis=1)
+    else:
+        raise ValueError(f"box_coder: unknown code_type {code_type!r}")
+
+    args = (pb, tb) + ((pbv,) if pbv is not None else ())
+    return dispatch.apply(fn, *args, op_name="box_coder")
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """reference vision/ops.py:1628 (phi roi_align kernel): average of
+    bilinear samples on a regular grid inside each ROI."""
+    import jax
+
+    x = ensure_tensor(x)
+    boxes = ensure_tensor(boxes)
+    bn = np.asarray(ensure_tensor(boxes_num)._value, np.int64)
+    oh, ow = (output_size if isinstance(output_size, (list, tuple))
+              else (output_size, output_size))
+    # batch index per roi from boxes_num (host-known, like the reference)
+    batch_idx = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+    if sampling_ratio > 0:
+        sr = int(sampling_ratio)
+    elif not isinstance(boxes._value, jax.core.Tracer):
+        # reference adaptive rule ceil(roi_size/pooled_size): the grid
+        # must be static, so use the max over this call's concrete rois
+        rb = np.asarray(boxes._value, np.float32) * spatial_scale
+        sr = int(max(1, np.ceil(
+            np.concatenate([(rb[:, 3] - rb[:, 1]) / oh,
+                            (rb[:, 2] - rb[:, 0]) / ow]).max())))
+        sr = min(sr, 64)
+    else:
+        sr = 2  # traced boxes: fixed grid (static shapes)
+
+    def fn(a, rois):
+        n, c, h, w = a.shape
+        off = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - off
+        y1 = rois[:, 1] * spatial_scale - off
+        x2 = rois[:, 2] * spatial_scale - off
+        y2 = rois[:, 3] * spatial_scale - off
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        # sample grid: [R, oh*sr] x [R, ow*sr]
+        ys = (y1[:, None] + (jnp.arange(oh * sr) + 0.5) / sr
+              * (rh[:, None] / oh))
+        xs = (x1[:, None] + (jnp.arange(ow * sr) + 0.5) / sr
+              * (rw[:, None] / ow))
+
+        def bilinear(img, yy, xx):
+            # img [C, H, W]; yy [P], xx [Q] -> [C, P, Q].  Samples outside
+            # [-1, size) contribute ZERO (reference kernel), inside ones
+            # clamp to the border for the sub-pixel lerp.
+            ok = ((yy >= -1.0) & (yy <= h))[:, None] \
+                & ((xx >= -1.0) & (xx <= w))[None, :]
+            yc = jnp.clip(yy, 0, h - 1)
+            xc = jnp.clip(xx, 0, w - 1)
+            y0 = jnp.floor(yc).astype(jnp.int32)
+            x0 = jnp.floor(xc).astype(jnp.int32)
+            y1_ = jnp.minimum(y0 + 1, h - 1)
+            x1_ = jnp.minimum(x0 + 1, w - 1)
+            wy = yc - y0
+            wx = xc - x0
+            v00 = img[:, y0][:, :, x0]
+            v01 = img[:, y0][:, :, x1_]
+            v10 = img[:, y1_][:, :, x0]
+            v11 = img[:, y1_][:, :, x1_]
+            top = v00 * (1 - wx)[None, None, :] + v01 * wx[None, None, :]
+            bot = v10 * (1 - wx)[None, None, :] + v11 * wx[None, None, :]
+            out = top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+            return out * ok[None].astype(out.dtype)
+
+        def per_roi(bi, yy, xx):
+            samp = bilinear(a[bi], yy, xx)               # [C, oh*sr, ow*sr]
+            return samp.reshape(c, oh, sr, ow, sr).mean(axis=(2, 4))
+
+        return jax.vmap(per_roi)(batch_idx, ys, xs)
+
+    return dispatch.apply(fn, x, boxes, op_name="roi_align")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,  # noqa: A002
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip=False, clip=False, steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """reference vision/ops.py:425 (SSD prior boxes): deterministic anchor
+    generation from the feature-map geometry — host numpy, no gradients."""
+    fh, fw = ensure_tensor(input)._value.shape[2:4]
+    ih, iw = ensure_tensor(image)._value.shape[2:4]
+    sw = steps[0] or iw / fw
+    sh = steps[1] or ih / fh
+    # ExpandAspectRatios (reference prior_box op): dedup within epsilon,
+    # flip adds reciprocals only when not already present
+    ars = [1.0]
+    for ar in aspect_ratios:
+        for cand in ((ar, 1.0 / ar) if flip else (ar,)):
+            if not any(abs(cand - e) < 1e-6 for e in ars):
+                ars.append(cand)
+    boxes = []
+    for ms_i, ms in enumerate(min_sizes):
+        ar_sizes = [(ms * np.sqrt(ar), ms / np.sqrt(ar))
+                    for ar in ars if ar != 1.0]
+        mx_sizes = []
+        if max_sizes:
+            mx = max_sizes[ms_i]
+            mx_sizes = [(np.sqrt(ms * mx), np.sqrt(ms * mx))]
+        if min_max_aspect_ratios_order:
+            sizes = [(ms, ms)] + mx_sizes + ar_sizes
+        else:
+            # reference default: [min, aspect-ratio variants, max]
+            sizes = [(ms, ms)] + ar_sizes + mx_sizes
+        boxes.append(sizes)
+    per_cell = sum(len(s) for s in boxes)
+    out = np.zeros((fh, fw, per_cell, 4), np.float32)
+    for i in range(fh):
+        for j in range(fw):
+            cx = (j + offset) * sw
+            cy = (i + offset) * sh
+            k = 0
+            for sizes in boxes:
+                for (bw, bh) in sizes:
+                    out[i, j, k] = [(cx - bw / 2) / iw, (cy - bh / 2) / ih,
+                                    (cx + bw / 2) / iw, (cy + bh / 2) / ih]
+                    k += 1
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def edit_distance(hyps, refs, normalized=True, name=None):
+    """reference fluid edit_distance op: Levenshtein distance per pair —
+    host dynamic program (data-dependent, eager like the CPU kernel)."""
+    out = []
+    for hyp, ref in zip(hyps, refs):
+        a = list(np.asarray(ensure_tensor(hyp)._value).ravel())
+        b = list(np.asarray(ensure_tensor(ref)._value).ravel())
+        m, n = len(a), len(b)
+        dp = np.arange(n + 1, dtype=np.float32)
+        for i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, n + 1):
+                cost = 0 if a[i - 1] == b[j - 1] else 1
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1, prev[j - 1] + cost)
+        d = dp[n] / max(n, 1) if normalized else dp[n]
+        out.append(d)
+    return Tensor(jnp.asarray(np.asarray(out, np.float32)[:, None]))

@@ -1,0 +1,96 @@
+"""Detection ops + SpectralNorm (reference: python/paddle/vision/ops.py,
+nn/layer/norm.py SpectralNorm)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import ops as V
+
+
+def test_nms_suppresses_overlaps_and_respects_categories():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [20, 20, 30, 30],
+                      [2, 2, 12, 12]], np.float32)
+    scores = np.array([0.9, 0.8, 0.7, 0.95], np.float32)
+    keep = V.nms(pt.to_tensor(boxes), 0.5, pt.to_tensor(scores)).numpy()
+    # 3 suppresses 1 (IoU .68) but not 0 (IoU .47 < .5); 2 is disjoint
+    assert keep.tolist() == [3, 0, 2]
+    keep = V.nms(pt.to_tensor(boxes), 0.3, pt.to_tensor(scores)).numpy()
+    assert keep.tolist() == [3, 2]  # tighter threshold kills 0 too
+    # category-aware: overlapping boxes in DIFFERENT categories survive
+    cats = np.array([0, 1, 0, 0], np.int64)
+    keep = V.nms(pt.to_tensor(boxes), 0.5, pt.to_tensor(scores),
+                 category_idxs=pt.to_tensor(cats),
+                 categories=[0, 1]).numpy()
+    assert 1 in keep.tolist()
+
+
+def test_roi_align_gradient_and_values():
+    # linear ramp image: roi_align over a region = value at region center
+    h = w = 8
+    ramp = np.tile(np.arange(w, dtype=np.float32), (h, 1))[None, None]
+    x = pt.to_tensor(ramp, stop_gradient=False)
+    rois = pt.to_tensor(np.array([[0.0, 0.0, 8.0, 8.0]], np.float32))
+    out = V.roi_align(x, rois, pt.to_tensor(np.array([1], np.int64)),
+                      output_size=4, aligned=False)
+    assert out.shape == [1, 1, 4, 4]
+    # each output column ~ center x-coordinate of its bin
+    np.testing.assert_allclose(out.numpy()[0, 0, 0],
+                               [0.5, 2.5, 4.5, 6.5], atol=0.6)
+    pt.ops.sum(out).backward()
+    assert np.isfinite(x.grad.numpy()).all()
+    assert x.grad.numpy().sum() > 0
+
+
+def test_box_coder_decode_matches_formula():
+    prior = np.array([[0.0, 0.0, 10.0, 10.0]], np.float32)
+    var = np.full((1, 4), 0.5, np.float32)
+    deltas = np.array([[0.2, -0.2, 0.0, 0.2]], np.float32)
+    dec = V.box_coder(pt.to_tensor(prior), pt.to_tensor(var),
+                      pt.to_tensor(deltas), "decode_center_size").numpy()[0]
+    # scaled deltas: dx=0.1, dy=-0.1, dw=0, dh=0.1 on a 10x10 prior @ (5,5)
+    cx, cy = 5 + 0.1 * 10, 5 - 0.1 * 10
+    w, h = 10 * np.exp(0.0), 10 * np.exp(0.1)
+    np.testing.assert_allclose(
+        dec, [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], rtol=1e-5)
+
+
+def test_prior_box_geometry():
+    feat = pt.to_tensor(np.zeros((1, 8, 4, 4), np.float32))
+    img = pt.to_tensor(np.zeros((1, 3, 32, 32), np.float32))
+    boxes, var = V.prior_box(feat, img, min_sizes=[8.0],
+                             aspect_ratios=(1.0, 2.0), clip=True)
+    assert boxes.shape == [4, 4, 2, 4]  # min_size + one ar=2 variant
+    b = boxes.numpy()
+    assert (b >= 0).all() and (b <= 1).all()
+    # center cell (1,1): cx = 1.5*8/32
+    np.testing.assert_allclose((b[1, 1, 0, 0] + b[1, 1, 0, 2]) / 2,
+                               1.5 * 8 / 32, atol=1e-6)
+    assert var.shape == list(boxes.shape)
+
+
+def test_edit_distance():
+    h = [pt.to_tensor(np.array([1, 2, 3], np.int64)),
+         pt.to_tensor(np.array([4, 5], np.int64))]
+    r = [pt.to_tensor(np.array([1, 3], np.int64)),
+         pt.to_tensor(np.array([4, 5], np.int64))]
+    d = V.edit_distance(h, r, normalized=False).numpy().ravel()
+    np.testing.assert_allclose(d, [1.0, 0.0])
+    dn = V.edit_distance(h, r, normalized=True).numpy().ravel()
+    np.testing.assert_allclose(dn, [0.5, 0.0])
+
+
+def test_spectral_norm_normalizes_top_singular_value():
+    pt.seed(0)
+    sn = pt.nn.SpectralNorm([8, 6], dim=0, power_iters=20)
+    w = pt.to_tensor(np.random.RandomState(0).randn(8, 6).astype(np.float32),
+                     stop_gradient=False)
+    out = sn(w)
+    sv = np.linalg.svd(out.numpy(), compute_uv=False)
+    np.testing.assert_allclose(sv[0], 1.0, rtol=1e-4)
+    # differentiable w.r.t. the weight
+    pt.ops.sum(out * out).backward()
+    assert np.isfinite(w.grad.numpy()).all()
+    # u/v state persists and converges across calls
+    out2 = sn(w)
+    np.testing.assert_allclose(
+        np.linalg.svd(out2.numpy(), compute_uv=False)[0], 1.0, rtol=1e-5)

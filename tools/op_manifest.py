@@ -81,6 +81,12 @@ CLASS_COVERAGE = {
     "graph_send_recv": "geometric.send_u_recv",
     "segment_pool": "geometric.segment_sum",
     "dirichlet": "distribution.Dirichlet",
+    "nms": "vision.ops.nms",
+    "box_coder": "vision.ops.box_coder",
+    "roi_align": "vision.ops.roi_align",
+    "prior_box": "vision.ops.prior_box",
+    "edit_distance": "vision.ops.edit_distance",
+    "spectral_norm": "nn.SpectralNorm",
     "rnn": "nn.RNN",
     "sync_batch_norm_": "nn.SyncBatchNorm",
     "truncated_gaussian_random": "nn.initializer.TruncatedNormal",
