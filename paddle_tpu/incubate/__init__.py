@@ -1,3 +1,4 @@
 """incubate: experimental features (reference: python/paddle/incubate/)."""
 from . import asp  # noqa: F401
 from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401

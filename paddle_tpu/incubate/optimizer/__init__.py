@@ -1,0 +1,70 @@
+"""incubate.optimizer (reference: python/paddle/incubate/optimizer/
+lookahead.py LookAhead, distributed_fused_lamb.py).
+
+LookAhead (Zhang et al. 2019): fast weights step with the inner
+optimizer; every k steps the slow weights interpolate toward the fast
+ones and are copied back.  TPU-native: slow weights are plain device
+tensors updated with jnp expressions; the k-step gate is a traced
+predicate on device-side step state so the whole thing functionalizes
+into a compiled train step (like DGC's rampup).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops import dispatch
+from ...tensor import Tensor
+
+__all__ = ["LookAhead"]
+
+
+class LookAhead:
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._parameter_list = inner_optimizer._parameter_list
+        self._accumulators = inner_optimizer._accumulators
+        self._aux_state = inner_optimizer._aux_state
+        self._grad_clip = None
+        # COPY the initial values: sharing the param's buffer would donate
+        # the same buffer twice in the compiled step
+        self._slow = {id(p): Tensor(jnp.array(p._value, copy=True))
+                      for p in self._parameter_list}
+        self._step_t = Tensor(jnp.zeros((), jnp.int32))
+
+    @dispatch.no_grad()
+    def step(self):
+        self.inner_optimizer.step()
+        dispatch.note_read(self._step_t)
+        new_step = self._step_t._value + 1
+        self._step_t._set_value(new_step)
+        sync = (new_step % self.k) == 0
+        for p in self._parameter_list:
+            slow = self._slow[id(p)]
+            dispatch.note_read(slow)
+            fast = p._value.astype(jnp.float32)
+            merged = (slow._value.astype(jnp.float32)
+                      + self.alpha * (fast - slow._value.astype(jnp.float32)))
+            new_slow = jnp.where(sync, merged, slow._value)
+            new_fast = jnp.where(sync, merged, fast)
+            slow._set_value(new_slow.astype(slow._value.dtype))
+            p._set_value(new_fast.astype(p._value.dtype))
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        return self.inner_optimizer.state_dict()
+
+    def set_state_dict(self, sd):
+        return self.inner_optimizer.set_state_dict(sd)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()

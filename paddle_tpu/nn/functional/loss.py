@@ -144,6 +144,41 @@ def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
     return dispatch.apply(fn, input, label, op_name="log_loss")
 
 
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """reference phi margin_cross_entropy (ArcFace/CosFace margins):
+    the target-class cosine logit is replaced by
+    cos(margin1*theta + margin2) - margin3, everything scaled by
+    ``scale`` before softmax cross-entropy.  Single-group path (the
+    reference's model-parallel class split rides the mp sharding of the
+    logits instead)."""
+    logits, label = ensure_tensor(logits), ensure_tensor(label)
+
+    def fn(z, y):
+        if y.ndim == z.ndim:  # [N, 1] labels (paddle convention)
+            y = jnp.squeeze(y, axis=-1)
+        onehot = jax.nn.one_hot(y, z.shape[-1], dtype=z.dtype)
+        # clip strictly inside (-1, 1): d(arccos) blows up at the
+        # boundary and a converged class hits exactly 1.0 in fp32
+        eps = 1e-6
+        cos_t = jnp.clip(jnp.sum(onehot * z, axis=-1),
+                         -1.0 + eps, 1.0 - eps)
+        theta = jnp.arccos(cos_t)
+        target = jnp.cos(margin1 * theta + margin2) - margin3
+        mod = z + onehot * (target - cos_t)[:, None]
+        mod = mod * scale
+        logp = jax.nn.log_softmax(mod, axis=-1)
+        loss = -jnp.sum(onehot * logp, axis=-1)
+        return _reduce(loss, reduction), jnp.exp(logp)
+
+    loss, sm = dispatch.apply(fn, logits, label,
+                              op_name="margin_cross_entropy")
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):  # noqa: A002
     input, label = ensure_tensor(input), ensure_tensor(label)
     tensors = [input, label]

@@ -30,6 +30,12 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
          else np.asarray(ensure_tensor(scores)._value, np.float32))
     cats = (None if category_idxs is None
             else np.asarray(ensure_tensor(category_idxs)._value))
+    if cats is not None and categories is not None:
+        # reference semantics: only the listed categories participate
+        allowed = np.isin(cats, np.asarray(list(categories)))
+        suppressed0 = ~allowed
+    else:
+        suppressed0 = np.zeros(n, bool)
 
     def iou(a, rest):
         x1 = np.maximum(a[0], rest[:, 0])
@@ -43,7 +49,7 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
 
     order = np.argsort(-s, kind="stable")
     keep = []
-    suppressed = np.zeros(n, bool)
+    suppressed = suppressed0
     for i in order:
         if suppressed[i]:
             continue
@@ -93,7 +99,11 @@ def box_coder(prior_box, prior_box_var, target_box,
             dh = jnp.log(th[:, None] / ph[None, :])
             out = jnp.stack([dx, dy, dw, dh], axis=-1)
             if var:
-                out = out / var[0][None, :, :]
+                v = var[0]
+                # accept [4] (per-coordinate, the SSD convention) or
+                # [P, 4] (per-prior) variance
+                v = v[None, None, :] if v.ndim == 1 else v[None, :, :]
+                out = out / v
             return out
 
     elif code_type == "decode_center_size":
@@ -126,7 +136,13 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
     x = ensure_tensor(x)
     boxes = ensure_tensor(boxes)
-    bn = np.asarray(ensure_tensor(boxes_num)._value, np.int64)
+    bn_raw = ensure_tensor(boxes_num)._value
+    if isinstance(bn_raw, jax.core.Tracer):
+        raise ValueError(
+            "roi_align needs a static boxes_num (it fixes the per-roi "
+            "batch mapping and output shape); pass it as a host value, "
+            "not a traced tensor")
+    bn = np.asarray(bn_raw, np.int64)
     oh, ow = (output_size if isinstance(output_size, (list, tuple))
               else (output_size, output_size))
     # batch index per roi from boxes_num (host-known, like the reference)
@@ -225,18 +241,19 @@ def prior_box(input, image, min_sizes, max_sizes=None,  # noqa: A002
             # reference default: [min, aspect-ratio variants, max]
             sizes = [(ms, ms)] + ar_sizes + mx_sizes
         boxes.append(sizes)
-    per_cell = sum(len(s) for s in boxes)
-    out = np.zeros((fh, fw, per_cell, 4), np.float32)
-    for i in range(fh):
-        for j in range(fw):
-            cx = (j + offset) * sw
-            cy = (i + offset) * sh
-            k = 0
-            for sizes in boxes:
-                for (bw, bh) in sizes:
-                    out[i, j, k] = [(cx - bw / 2) / iw, (cy - bh / 2) / ih,
-                                    (cx + bw / 2) / iw, (cy + bh / 2) / ih]
-                    k += 1
+    all_sizes = np.asarray([wh for sizes in boxes for wh in sizes],
+                           np.float32)                     # [K, 2]
+    cx = ((np.arange(fw) + offset) * sw)[None, :, None]    # [1, fw, 1]
+    cy = ((np.arange(fh) + offset) * sh)[:, None, None]    # [fh, 1, 1]
+    half_w = all_sizes[None, None, :, 0] / 2
+    half_h = all_sizes[None, None, :, 1] / 2
+    K = all_sizes.shape[0]
+    full = (fh, fw, K)
+    out = np.stack([np.broadcast_to((cx - half_w) / iw, full),
+                    np.broadcast_to((cy - half_h) / ih, full),
+                    np.broadcast_to((cx + half_w) / iw, full),
+                    np.broadcast_to((cy + half_h) / ih, full)],
+                   axis=-1).astype(np.float32)             # [fh, fw, K, 4]
     if clip:
         out = np.clip(out, 0.0, 1.0)
     var = np.broadcast_to(np.asarray(variance, np.float32),

@@ -87,6 +87,8 @@ CLASS_COVERAGE = {
     "prior_box": "vision.ops.prior_box",
     "edit_distance": "vision.ops.edit_distance",
     "spectral_norm": "nn.SpectralNorm",
+    "margin_cross_entropy": "nn.functional.margin_cross_entropy",
+    "lookahead": "incubate.optimizer.LookAhead",
     "rnn": "nn.RNN",
     "sync_batch_norm_": "nn.SyncBatchNorm",
     "truncated_gaussian_random": "nn.initializer.TruncatedNormal",
