@@ -16,7 +16,7 @@ from ..ops import dispatch
 from ..ops._factory import ensure_tensor
 from ..tensor import Tensor
 
-__all__ = ["nms", "box_coder", "roi_align", "prior_box", "edit_distance"]
+__all__ = ["nms", "box_coder", "roi_align", "prior_box", "edit_distance", "decode_jpeg", "roi_pool"]
 
 
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
@@ -279,3 +279,77 @@ def edit_distance(hyps, refs, normalized=True, name=None):
         d = dp[n] / max(n, 1) if normalized else dp[n]
         out.append(d)
     return Tensor(jnp.asarray(np.asarray(out, np.float32)[:, None]))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """reference vision/ops.py decode_jpeg (phi decode_jpeg / nvjpeg):
+    decode an encoded JPEG byte tensor to CHW uint8.  Host decode via
+    PIL (no nvjpeg on TPU; the reference's CPU path is libjpeg)."""
+    import io as _io
+
+    from PIL import Image
+
+    raw = bytes(np.asarray(ensure_tensor(x)._value, np.uint8).tobytes())
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode in ("rgb", "unchanged"):
+        img = img.convert("RGB") if mode == "rgb" else img
+    arr = np.asarray(img, np.uint8)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """reference vision/ops.py roi_pool (phi roi_pool kernel): quantized
+    bins + max pooling (the pre-roi_align Fast R-CNN op)."""
+    import jax
+
+    x = ensure_tensor(x)
+    boxes = ensure_tensor(boxes)
+    bn_raw = ensure_tensor(boxes_num)._value
+    if isinstance(bn_raw, jax.core.Tracer):
+        raise ValueError("roi_pool needs a static boxes_num")
+    bn = np.asarray(bn_raw, np.int64)
+    oh, ow = (output_size if isinstance(output_size, (list, tuple))
+              else (output_size, output_size))
+    batch_idx = jnp.asarray(np.repeat(np.arange(len(bn)), bn), jnp.int32)
+
+    def fn(a, rois):
+        n, c, h, w = a.shape
+        x1 = jnp.round(rois[:, 0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(rois[:, 1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(rois[:, 2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(rois[:, 3] * spatial_scale).astype(jnp.int32)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+
+        def per_roi(bi, px1, py1, pw_, ph_):
+            img = a[bi]                               # [C, H, W]
+            # bin edges (quantized floor/ceil like the reference)
+            ys = py1 + (jnp.arange(oh + 1) * ph_) // oh
+            xs = px1 + (jnp.arange(ow + 1) * pw_) // ow
+            # dense mask-based max per bin (static shapes; h/w are small
+            # feature maps)
+            yy = jnp.arange(h)[None, :]
+            xx = jnp.arange(w)[None, :]
+            ymask = (yy >= ys[:-1, None]) & (yy < jnp.maximum(
+                ys[1:, None], ys[:-1, None] + 1))     # [oh, H]
+            xmask = (xx >= xs[:-1, None]) & (xx < jnp.maximum(
+                xs[1:, None], xs[:-1, None] + 1))     # [ow, W]
+            inb = (yy >= 0) & (yy < h)
+            ymask = ymask & inb
+            xmask = xmask & ((xx >= 0) & (xx < w))
+            neg = jnp.asarray(-jnp.inf, a.dtype)
+            m = (ymask[None, :, :, None, None] &
+                 xmask[None, None, None, :, :])       # [1, oh, H, ow, W]
+            vals = jnp.where(m, img[:, None, :, None, :], neg)
+            out = vals.max(axis=(2, 4))               # [C, oh, ow]
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+
+        return jax.vmap(per_roi)(batch_idx, x1, y1, rw, rh)
+
+    return dispatch.apply(fn, x, boxes, op_name="roi_pool")

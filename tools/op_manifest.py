@@ -39,6 +39,8 @@ ALIASES = {
     "logsigmoid": "log_sigmoid",
     "frobenius_norm": "norm",
     "fill": "fill_",
+    "full_batch_size_like": "full",
+    "full_int_array": "full",
     "uniform_inplace": "uniform_",
     "mean_all": "mean",
     "p_norm": "norm",
@@ -89,6 +91,12 @@ CLASS_COVERAGE = {
     "spectral_norm": "nn.SpectralNorm",
     "margin_cross_entropy": "nn.functional.margin_cross_entropy",
     "lookahead": "incubate.optimizer.LookAhead",
+    "decode_jpeg": "vision.ops.decode_jpeg",
+    "roi_pool": "vision.ops.roi_pool",
+    "fill_diagonal": "fill_diagonal_",
+    "depthwise_conv2d_transpose": "nn.functional.conv2d_transpose",
+    "repeat_interleave_with_tensor_index": "ops.repeat_interleave",
+    "npu_identity": "ops.clone",
     "rnn": "nn.RNN",
     "sync_batch_norm_": "nn.SyncBatchNorm",
     "truncated_gaussian_random": "nn.initializer.TruncatedNormal",
