@@ -113,6 +113,26 @@ class Engine:
 
         return to_static(step)
 
+    def _note_inert_strategy(self):
+        """One-time notice for enabled strategy passes the Engine maps to
+        GSPMD rather than executing itself — nothing enabled is silently
+        ignored (round-3 weak #6)."""
+        if getattr(self, "_inert_noted", False):
+            return
+        self._inert_noted = True
+        import sys
+
+        notes = []
+        if self._strategy.pipeline.enable:
+            notes.append("pipeline (use fleet PipelineParallel / the pp "
+                         "mesh axis; Engine delegates placement to GSPMD)")
+        if self._strategy.mp.enable:
+            notes.append("mp (shard params via Engine.plan()/shard_tensor;"
+                         " GSPMD inserts the collectives)")
+        for n in notes:
+            sys.stderr.write(
+                f"[paddle_tpu.auto_parallel] Strategy.{n}\n")
+
     # -- public API --------------------------------------------------------
     def fit(self, train_data, epochs=1, batch_size=None, steps_per_epoch=None,
             valid_data=None, collate_fn=None, callbacks=None, verbose=1,
@@ -127,6 +147,16 @@ class Engine:
             self._model, self._optimizer, _ = group_sharded_parallel(
                 self._model, self._optimizer, level)
             self._sharding_applied = True
+        gm = self._strategy.gradient_merge
+        if gm.enable and gm.k_steps > 1 and not getattr(
+                self, "_gm_applied", False):
+            from ..fleet.meta_optimizers import GradientMerge
+
+            self._optimizer = GradientMerge(self._optimizer,
+                                            k_steps=gm.k_steps, avg=gm.avg)
+            self._gm_applied = True
+            self._train_step = None  # rebuild over the wrapped optimizer
+        self._note_inert_strategy()
         if callbacks:
             import warnings
 

@@ -23,7 +23,7 @@ from ....ops import dispatch
 from ....optimizer.optimizer import Optimizer
 from ....tensor import Tensor
 
-__all__ = ["LarsMomentum", "DGCMomentum", "LocalSGD",
+__all__ = ["LarsMomentum", "DGCMomentum", "LocalSGD", "GradientMerge",
            "apply_strategy_meta_optimizers"]
 
 
@@ -230,4 +230,119 @@ def apply_strategy_meta_optimizers(optimizer, strategy):
     if getattr(strategy, "localsgd", False):
         cfg = getattr(strategy, "localsgd_configs", {}) or {}
         return LocalSGD(optimizer, k_steps=cfg.get("k_steps", 4))
+    if getattr(strategy, "gradient_merge", False):
+        cfg = getattr(strategy, "gradient_merge_configs", {}) or {}
+        k = int(cfg.get("k_steps", 1))
+        if k > 1:
+            return GradientMerge(optimizer, k_steps=k,
+                                 avg=cfg.get("avg", True))
     return optimizer
+
+
+class GradientMerge:
+    """Gradient merging / accumulation (reference
+    fleet/meta_optimizers/gradient_merge_optimizer.py + the
+    GradientMergePass): accumulate k micro-steps of gradients, apply the
+    inner optimizer once per k.
+
+    TPU-native: the k-step gate is a traced predicate on a device-side
+    counter; on non-apply steps EVERY state tensor the inner step mutated
+    (params, moments, aux powers, master weights) is rolled back via
+    jnp.where, so the whole wrapper functionalizes into one compiled
+    train step with no python-side control flow."""
+
+    def __init__(self, inner_optimizer, k_steps=1, avg=True):
+        self._inner = inner_optimizer
+        self._k = int(k_steps)
+        self._avg = avg
+        self._parameter_list = inner_optimizer._parameter_list
+        self._accumulators = inner_optimizer._accumulators
+        self._aux_state = inner_optimizer._aux_state
+        self._grad_clip = None
+        self._step_t = Tensor(jnp.zeros((), jnp.int32))
+        self._acc = {id(p): self._make_acc(p) for p in self._parameter_list}
+
+    @staticmethod
+    def _make_acc(p):
+        import jax
+
+        raw = jnp.zeros(p._value.shape, jnp.float32)
+        # inherit the param's MESH layout (like _add_accumulator): a
+        # ZeRO/TP-sharded param keeps its gradient accumulator sharded
+        sh = getattr(p._value, "sharding", None)
+        if isinstance(sh, jax.sharding.NamedSharding):
+            raw = jax.device_put(raw, sh)
+        return Tensor(raw)
+
+    def _state_tensors(self):
+        out = list(self._parameter_list)
+        for store in self._inner._accumulators.values():
+            out.extend(store.values())
+        out.extend(t for t in self._inner._aux_state.values()
+                   if isinstance(t, Tensor))
+        out.extend(getattr(self._inner, "_master", {}).values())
+        # any other device-side state the inner optimizer keeps as a plain
+        # attribute (e.g. DGC's _step_t) must roll back too
+        seen = {id(t) for t in out}
+        for v in vars(self._inner).values():
+            if isinstance(v, Tensor) and id(v) not in seen:
+                out.append(v)
+                seen.add(id(v))
+        return out
+
+    @dispatch.no_grad()
+    def step(self):
+        k = self._k
+        if k <= 1:
+            self._inner.step()
+            return
+        dispatch.note_read(self._step_t)
+        new_step = self._step_t._value + 1
+        self._step_t._set_value(new_step)
+        apply = (new_step % k) == 0
+        # accumulate this micro-step's grads; feed the merged grad in
+        from ....tensor import Tensor as _T
+
+        for p in self._parameter_list:
+            if p.grad is None:
+                continue
+            acc = self._acc[id(p)]
+            dispatch.note_read(acc)
+            acc._set_value(acc._value + p.grad._value.astype(jnp.float32))
+            merged = acc._value / k if self._avg else acc._value
+            p.grad = _T(merged.astype(p.grad._value.dtype))
+        snapshot = [(t, t._value) for t in self._state_tensors()]
+        self._inner.step()
+        # non-apply steps: roll back every mutated state tensor
+        for t, old in snapshot:
+            t._set_value(jnp.where(apply, t._value, old))
+        for acc in self._acc.values():
+            acc._set_value(jnp.where(apply, jnp.zeros_like(acc._value),
+                                     acc._value))
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+    def get_lr(self):
+        return self._inner.get_lr()
+
+    def state_dict(self):
+        # in-window accumulation state checkpoints too: resuming
+        # mid-window must not discard partial gradient sums or misalign
+        # the k gate
+        sd = dict(self._inner.state_dict())
+        sd["gradient_merge"] = {
+            "step": self._step_t.numpy(),
+            "acc": [self._acc[id(p)].numpy()
+                    for p in self._parameter_list],
+        }
+        return sd
+
+    def set_state_dict(self, sd):
+        sd = dict(sd)
+        gm = sd.pop("gradient_merge", None)
+        self._inner.set_state_dict(sd)
+        if gm is not None:
+            self._step_t._set_value(jnp.asarray(gm["step"]))
+            for p, a in zip(self._parameter_list, gm["acc"]):
+                self._acc[id(p)]._set_value(jnp.asarray(a))

@@ -289,12 +289,16 @@ def decode_jpeg(x, mode="unchanged", name=None):
 
     from PIL import Image
 
+    if mode not in ("unchanged", "gray", "rgb"):
+        raise ValueError(
+            f"decode_jpeg: mode must be 'unchanged'/'gray'/'rgb', "
+            f"got {mode!r}")
     raw = bytes(np.asarray(ensure_tensor(x)._value, np.uint8).tobytes())
     img = Image.open(_io.BytesIO(raw))
     if mode == "gray":
         img = img.convert("L")
-    elif mode in ("rgb", "unchanged"):
-        img = img.convert("RGB") if mode == "rgb" else img
+    elif mode == "rgb":
+        img = img.convert("RGB")
     arr = np.asarray(img, np.uint8)
     if arr.ndim == 2:
         arr = arr[None]
