@@ -124,7 +124,10 @@ class DGCMomentum(Optimizer):
         warm = self._step_t._value <= self._rampup_begin
         mask = jnp.where(warm, jnp.ones_like(mask), mask)
         sent = jnp.where(warm, new_u, acc * mask)
-        u._set_value(new_u * (1.0 - mask))   # selected entries reset
+        # warmup keeps the full momentum buffer (mask is all-ones there, so
+        # new_u * (1 - mask) would zero it and degenerate warmup to SGD);
+        # only the compressed phase resets the selected entries
+        u._set_value(jnp.where(warm, new_u, new_u * (1.0 - mask)))
         v._set_value(jnp.where(warm, v._value, acc * (1.0 - mask)))
         self._write_param(
             p, (p._value.astype(jnp.float32) - lr * sent)

@@ -58,10 +58,25 @@ class LookAhead:
         return self.inner_optimizer.get_lr()
 
     def state_dict(self):
-        return self.inner_optimizer.state_dict()
+        # slow weights + the k-step counter checkpoint too (reference
+        # persists slow params as accumulators): resuming must not reset
+        # the LookAhead phase or the slow-weight state
+        sd = dict(self.inner_optimizer.state_dict())
+        sd["lookahead"] = {
+            "step": self._step_t.numpy(),
+            "slow": [self._slow[id(p)].numpy()
+                     for p in self._parameter_list],
+        }
+        return sd
 
     def set_state_dict(self, sd):
-        return self.inner_optimizer.set_state_dict(sd)
+        sd = dict(sd)
+        la = sd.pop("lookahead", None)
+        self.inner_optimizer.set_state_dict(sd)
+        if la is not None:
+            self._step_t._set_value(jnp.asarray(la["step"]))
+            for p, s in zip(self._parameter_list, la["slow"]):
+                self._slow[id(p)]._set_value(jnp.asarray(s))
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):

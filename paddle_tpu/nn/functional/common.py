@@ -158,6 +158,7 @@ def interpolate(
         if not isinstance(size, (list, tuple)):
             size = [size]
         out_sizes = [int(s) for s in size]
+        scales = [None] * len(out_sizes)
     else:
         if scale_factor is None:
             raise ValueError(
@@ -165,6 +166,7 @@ def interpolate(
         sf = (list(scale_factor) if isinstance(scale_factor, (list, tuple))
               else [scale_factor] * len(axes))
         out_sizes = [int(d * f) for d, f in zip(in_sizes, sf)]
+        scales = list(sf)
     if len(out_sizes) != len(axes):
         raise ValueError(
             f"interpolate: {len(axes)} spatial dims but size has "
@@ -174,16 +176,29 @@ def interpolate(
     if mode not in linear_family | {"nearest", "bicubic"}:
         raise NotImplementedError(f"interpolate mode {mode!r}")
 
-    def _axis_lerp(a, axis, n_out, nearest):
+    def _axis_lerp(a, axis, n_out, nearest, scale=None):
         """Resize ONE axis by gather+lerp — supports align_corners
         exactly, any rank (the reference's separable kernels)."""
         n_in = a.shape[axis]
         if n_out == n_in and not nearest:
             return a
+        if nearest and not align_corners:
+            # reference nearest default (align_corners=False, legacy
+            # align_mode=0) is floor(i / scale) — with the ratio taken
+            # from the explicit scale_factor when given (out may round),
+            # not the half-pixel round() used by the linear family
+            ratio = (1.0 / scale) if scale else (n_in / n_out)
+            idx = jnp.clip((jnp.arange(n_out) * ratio)
+                           .astype(jnp.int32), 0, n_in - 1)
+            return jnp.take(a, idx, axis=axis)
         if align_corners and n_out > 1:
             pos = jnp.linspace(0.0, n_in - 1, n_out)
         else:
-            pos = (jnp.arange(n_out) + 0.5) * (n_in / n_out) - 0.5
+            # same explicit-scale convention as nearest: the reference
+            # kernels use ratio = 1/scale when scale_factor is given
+            # (out size may have rounded), else in/out
+            ratio = (1.0 / scale) if scale else (n_in / n_out)
+            pos = (jnp.arange(n_out) + 0.5) * ratio - 0.5
             pos = jnp.clip(pos, 0, n_in - 1)
         if nearest:
             idx = jnp.clip(jnp.round(pos).astype(jnp.int32), 0, n_in - 1)
@@ -207,11 +222,9 @@ def interpolate(
                 shape[ax] = n_out
             return jax.image.resize(a, shape, method="cubic")
         out = a
-        # 'nearest' in paddle defaults to the legacy floor behavior when
-        # align_corners is False and align_mode is 0; round() matches the
-        # half-pixel convention used for the linear family
-        for ax, n_out in zip(axes, out_sizes):
-            out = _axis_lerp(out, ax, n_out, nearest=(mode == "nearest"))
+        for ax, n_out, sc in zip(axes, out_sizes, scales):
+            out = _axis_lerp(out, ax, n_out, nearest=(mode == "nearest"),
+                             scale=sc)
         return out
 
     return dispatch.apply(fn, x, op_name="interpolate")

@@ -74,7 +74,29 @@ def _max_pool(x, kernel_size, stride, padding, return_mask, ceil_mode, data_form
 
 
 def _argmax_pool(a, dims, strides, pairs, off, nsp=None):
-    flat_idx = jnp.arange(a.size, dtype=jnp.float64).reshape(a.shape)
+    # int32 indices carried through a variadic reduce_window: a float
+    # carrier (old scheme) silently downcasts to f32 without x64 and
+    # loses exactness past 2^24 elements.  NC-leading layouts only need
+    # plane-local indices, so the guard bounds the largest index actually
+    # carried, not the global size.
+    per_plane = nsp is not None and off == 2
+    plane = int(np.prod(a.shape[off:off + nsp])) if per_plane else None
+    max_index = (plane if per_plane else a.size) - 1
+    if max_index > np.iinfo(np.int32).max:
+        raise ValueError(
+            "max_pool return_mask: mask indices up to "
+            f"{max_index} do not fit int32")
+    if per_plane:
+        # paddle's mask is the index WITHIN each (N, C) plane (h*W + w),
+        # not the global flat index — and the spatial dims are
+        # innermost/contiguous.  Built with broadcast_to so no index ever
+        # exceeds the plane size (taken BEFORE the reduce).
+        flat_idx = jnp.broadcast_to(
+            jnp.arange(plane, dtype=jnp.int32).reshape(a.shape[off:]),
+            a.shape)
+    else:
+        flat_idx = jnp.arange(a.size, dtype=jnp.int32).reshape(a.shape)
+
     # pack (value, index): use a reduce over tuples via argmax trick
     def select(x1, x2):
         v1, i1 = x1
@@ -88,20 +110,13 @@ def _argmax_pool(a, dims, strides, pairs, off, nsp=None):
     neg = jnp.finfo(a.dtype).min if _dtype_mod.is_float_raw(a.dtype) else np.iinfo(np.dtype(a.dtype)).min
     vals, idx = jax.lax.reduce_window(
         (a, flat_idx),
-        (jnp.asarray(neg, a.dtype), jnp.asarray(-1.0, jnp.float64)),
+        (jnp.asarray(neg, a.dtype), jnp.asarray(-1, jnp.int32)),
         select,
         dims,
         strides,
         pad_arg,
     )
-    idx = idx.astype(jnp.int64)
-    if nsp is not None and off == 2:
-        # NC-leading layouts: paddle's mask is the index WITHIN each
-        # (N, C) plane (h*W + w), not the global flat index — and the
-        # spatial dims are innermost/contiguous so a modulo converts
-        plane = int(np.prod(a.shape[off:off + nsp]))
-        idx = idx % plane
-    return idx
+    return idx.astype(jnp.int64)
 
 
 def avg_pool2d(

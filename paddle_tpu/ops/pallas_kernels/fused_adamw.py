@@ -54,12 +54,19 @@ def _kernel(lr_ref, b1p_ref, b2p_ref, p_ref, g_ref, m1_ref, m2_ref,
 
 
 @functools.partial(jax.jit, static_argnames=("beta1", "beta2", "eps", "wd",
-                                             "interpret"))
+                                             "interpret"),
+                   donate_argnums=(0, 2, 3))
 def fused_adamw_update(p, g, m1, m2, lr, b1p, b2p, *,
                        beta1=0.9, beta2=0.999, eps=1e-8, wd=0.01,
                        interpret=False):
-    """Return (new_p, new_m1, new_m2); p/m1/m2 buffers are donated into
-    their outputs (aliased) so the update is in place.
+    """Return (new_p, new_m1, new_m2).
+
+    Standalone (eager) calls donate p/m1/m2 into the outputs via
+    ``donate_argnums`` so XLA may reuse their buffers; when n is
+    lane-aligned the ravel/reshape folds to a bitcast and the kernel's
+    ``input_output_aliases`` make the update truly in place.  When traced
+    inside an outer jit (the compiled train step), the OUTER donation of
+    the captured optimizer state is what guarantees single residency.
 
     ``lr``/``b1p``/``b2p`` are runtime scalars (traced), the rest of the
     hyperparameters are compile-time constants.

@@ -225,3 +225,55 @@ def test_asp_prune_and_guarantee():
         opt.clear_grad()
     # masks re-applied after every step: still exactly 2:4
     assert asp.check_sparsity(lin.weight, n=2, m=4)
+
+
+def test_dgc_rampup_keeps_momentum_during_warmup():
+    """Round-5 advisor fix: with momentum>0 the warmup phase must do real
+    momentum updates — with a constant gradient the step-2 delta is
+    (1+m)x the step-1 delta, not equal (which would mean the momentum
+    buffer was zeroed every warmup step and warmup degenerated to SGD)."""
+    pt.seed(7)
+    w = pt.to_tensor(np.zeros((4, 256), np.float32), stop_gradient=False)
+    opt = DGCMomentum(learning_rate=1.0, momentum=0.9, parameters=[w],
+                      sparsity=0.9, rampup_begin_step=3)
+    g = np.random.RandomState(0).randn(4, 256).astype(np.float32)
+    deltas = []
+    prev = np.zeros((4, 256), np.float32)
+    for _ in range(3):
+        w.grad = pt.to_tensor(g)
+        opt.step()
+        opt.clear_grad()
+        cur = np.asarray(w._value)
+        deltas.append(cur - prev)
+        prev = cur
+    # u1 = g, u2 = 0.9 g + g = 1.9 g, u3 = 0.9*1.9 g + g = 2.71 g
+    np.testing.assert_allclose(deltas[0], -g, rtol=1e-5)
+    np.testing.assert_allclose(deltas[1], -1.9 * g, rtol=1e-5)
+    np.testing.assert_allclose(deltas[2], -2.71 * g, rtol=1e-4)
+
+
+def test_lookahead_state_dict_roundtrip():
+    """Round-5 advisor fix: LookAhead checkpoints must persist the slow
+    weights and the k-step counter, so a resumed optimizer continues the
+    phase instead of resetting it."""
+    from paddle_tpu.incubate.optimizer import LookAhead
+
+    m, x, y = _toy(seed=11)
+    inner = pt.optimizer.SGD(learning_rate=0.2, parameters=m.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=3)
+    for _ in range(4):   # mid-window: step counter at 4 (phase 1 of 3)
+        loss = pt.ops.mean((m(x) - y) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    sd = opt.state_dict()
+    assert "lookahead" in sd
+
+    m2, _, _ = _toy(seed=11)
+    inner2 = pt.optimizer.SGD(learning_rate=0.2, parameters=m2.parameters())
+    opt2 = LookAhead(inner2, alpha=0.5, k=3)
+    opt2.set_state_dict(sd)
+    assert int(np.asarray(opt2._step_t._value)) == 4
+    p0, q0 = m.parameters()[0], m2.parameters()[0]
+    np.testing.assert_allclose(np.asarray(opt._slow[id(p0)]._value),
+                               np.asarray(opt2._slow[id(q0)]._value))

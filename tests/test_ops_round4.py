@@ -138,3 +138,37 @@ def test_interpolate_parity_3d_4d_5d(ac):
     ref = TF.interpolate(torch.tensor(x5), size=(8, 10, 3),
                          mode="trilinear", align_corners=ac).numpy()
     np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_interpolate_nearest_floor_convention():
+    """Round-5 advisor fix: nearest with align_corners=False must use the
+    legacy floor(i * in/out) convention (paddle default align_mode=0 ==
+    torch 'nearest'), which differs from half-pixel round() for
+    non-integer scale factors."""
+    rng = np.random.RandomState(3)
+    x4 = rng.randn(2, 3, 5, 7).astype(np.float32)
+    ours = F.interpolate(pt.to_tensor(x4), size=[8, 11],
+                         mode="nearest").numpy()
+    ref = TF.interpolate(torch.tensor(x4), size=(8, 11),
+                         mode="nearest").numpy()
+    np.testing.assert_allclose(ours, ref)
+
+    x3 = rng.randn(2, 3, 9).astype(np.float32)
+    ours = F.interpolate(pt.to_tensor(x3), scale_factor=1.7,
+                         mode="nearest", data_format="NCW").numpy()
+    ref = TF.interpolate(torch.tensor(x3), scale_factor=1.7,
+                         mode="nearest").numpy()
+    np.testing.assert_allclose(ours, ref)
+
+
+def test_interpolate_linear_explicit_scale_ratio():
+    """Linear family must also use ratio=1/scale when an explicit
+    scale_factor is given (reference kernels), not the in/out size ratio
+    the rounded output size implies."""
+    rng = np.random.RandomState(5)
+    x4 = rng.randn(1, 2, 9, 9).astype(np.float32)
+    ours = F.interpolate(pt.to_tensor(x4), scale_factor=1.7,
+                         mode="bilinear").numpy()
+    ref = TF.interpolate(torch.tensor(x4), scale_factor=1.7,
+                         mode="bilinear").numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
