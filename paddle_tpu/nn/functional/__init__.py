@@ -6,7 +6,7 @@ from .activation import (  # noqa: F401
     relu6, rrelu, selu, sigmoid, silu, softmax, softplus, softshrink, softsign,
     swish, tanh, tanhshrink, thresholded_relu,
 )
-from .attention import flash_attention, scaled_dot_product_attention  # noqa: F401
+from .attention import flash_attention, flash_attn_unpadded, scaled_dot_product_attention  # noqa: F401
 from .common import (  # noqa: F401
     alpha_dropout, bilinear, cosine_similarity, dropout, dropout2d, dropout3d,
     embedding, interpolate, label_smooth, linear, normalize, one_hot, pad,

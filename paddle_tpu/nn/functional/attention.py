@@ -110,5 +110,66 @@ def flash_attention(
     return out, None
 
 
-def flash_attn_unpadded(*args, **kwargs):
-    raise NotImplementedError("varlen flash attention: use dense batches on TPU")
+def flash_attn_unpadded(
+    query, key, value, cu_seqlens_q, cu_seqlens_k, max_seqlen_q=None,
+    max_seqlen_k=None, scale=None, dropout=0.0, causal=False,
+    return_softmax=False, fixed_seed_offset=None, rng_name="",
+    training=True, name=None,
+):
+    """Varlen (packed) attention over ragged sequences.
+
+    Reference: python/paddle/nn/functional/flash_attention.py
+    flash_attn_unpadded (varlen CUDA kernel over cu_seqlens).  TPU-native:
+    ragged batches are expressed as ONE packed token axis with a
+    segment-id mask — token i attends to token j iff they belong to the
+    same cu_seqlens bucket (and j <= i under ``causal``).  XLA fuses the
+    masked softmax into the MXU matmuls; there is no serialized per-
+    sequence loop and no dynamic shape.
+
+    q/k/v: [total_tokens, num_heads, head_dim]; cu_seqlens: int [B+1]
+    prefix offsets (cu_seqlens[0] == 0).
+    """
+    query, key, value = ensure_tensor(query), ensure_tensor(key), ensure_tensor(value)
+    cu_q = ensure_tensor(cu_seqlens_q)
+    cu_k = ensure_tensor(cu_seqlens_k)
+    if dropout > 0.0 and training:
+        from ...ops.random import default_generator
+
+        rng_key = default_generator.split()
+    else:
+        rng_key = None
+        dropout = 0.0
+
+    def fn(q, k, v, cq, ck):
+        sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+        nq, nk = q.shape[0], k.shape[0]
+        nbuckets_q = cq.shape[0] - 1
+        nbuckets_k = ck.shape[0] - 1
+        # searchsorted('right') - 1: bucket index per packed position;
+        # positions past cu[-1] (padding in a padded-buffer layout) land
+        # in bucket nbuckets and must not attend anywhere
+        seg_q = jnp.searchsorted(cq, jnp.arange(nq), side="right") - 1
+        seg_k = jnp.searchsorted(ck, jnp.arange(nk), side="right") - 1
+        same = ((seg_q[:, None] == seg_k[None, :])
+                & (seg_q < nbuckets_q)[:, None]
+                & (seg_k < nbuckets_k)[None, :])
+        if causal:
+            # positions are contiguous within a bucket, so the in-segment
+            # causal order is the packed order offset by the bucket start
+            pos_q = jnp.arange(nq) - jnp.take(cq, seg_q)
+            pos_k = jnp.arange(nk) - jnp.take(ck, seg_k)
+            same = same & (pos_q[:, None] >= pos_k[None, :])
+        scores = jnp.einsum("qhd,khd->hqk", q, k) * sc
+        scores = jnp.where(same[None], scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores, axis=-1)
+        # fully-masked rows (padding past cu_seqlens[-1]) become uniform
+        # after softmax-of-min; zero them so padded outputs are zero
+        probs = jnp.where(same[None], probs, 0.0)
+        if dropout > 0.0 and rng_key is not None:
+            keep = jax.random.bernoulli(rng_key, 1.0 - dropout, probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
+        return jnp.einsum("hqk,khd->qhd", probs, v)
+
+    out = dispatch.apply(fn, query, key, value, cu_q, cu_k,
+                         op_name="flash_attn_unpadded")
+    return out, None

@@ -33,7 +33,6 @@ ALIASES = {
     "cross_entropy_with_softmax": "cross_entropy",
     "sigmoid_cross_entropy_with_logits": "binary_cross_entropy_with_logits",
     "flash_attn": "flash_attention",
-    "fused_adam_": "fused_adamw",
     "bce_loss": "binary_cross_entropy",
     "kldiv_loss": "kl_div",
     "logsigmoid": "log_sigmoid",
@@ -51,7 +50,6 @@ ALIASES = {
     "trans_layout": "transpose",
     "max_pool2d_with_index": "max_pool2d",
     "max_pool3d_with_index": "max_pool3d",
-    "flash_attn_unpadded": "flash_attention",
     "assign_out_": "assign",
     "assign_value_": "assign",
     "copy_to": "clone",
@@ -115,6 +113,7 @@ CLASS_COVERAGE = {
     "channel_shuffle": "nn.functional.channel_shuffle",
     "huber_loss": "nn.functional.huber_loss",
     "log_loss": "nn.functional.log_loss",
+    "fused_adam_": "ops.pallas_kernels.fused_adamw.fused_adamw_update",
 }
 
 
@@ -164,14 +163,433 @@ def our_surface():
 
 
 def _resolve_dotted(path):
+    import importlib
+
     import paddle_tpu as pt
 
     obj = pt
-    for part in path.split("."):
-        obj = getattr(obj, part, None)
-        if obj is None:
-            return None
+    parts = path.split(".")
+    for i, part in enumerate(parts):
+        nxt = getattr(obj, part, None)
+        if nxt is None:
+            # attribute chains can cross not-yet-imported submodules;
+            # resolution must not depend on import side effects elsewhere
+            try:
+                nxt = importlib.import_module(
+                    "paddle_tpu." + ".".join(parts[:i + 1]))
+            except ImportError:
+                return None
+        obj = nxt
     return obj
+
+
+def _resolve_flat(name):
+    """Find the callable behind a flat surface name on the op namespaces."""
+    import paddle_tpu as pt
+
+    spaces = [pt, pt.ops, pt.nn.functional,
+              getattr(pt, "linalg", pt.ops), pt.fft, pt.signal, pt.sparse,
+              pt.geometric]
+    for sp in spaces:
+        obj = getattr(sp, name, None)
+        if callable(obj):
+            return obj
+    try:
+        from paddle_tpu.ops import pallas_kernels as pk
+        obj = getattr(pk, name, None)
+        if callable(obj):
+            return obj
+    except Exception:
+        pass
+    return None
+
+
+def _is_source_stub(fn):
+    """True when the implementation is an unconditional NotImplementedError
+    raise (a coverage-gaming stub), judged from the AST — catches stubs that
+    hide behind signature TypeErrors during the smoke call, regardless of
+    docstring shape."""
+    import ast
+    import inspect
+    import textwrap
+
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return False
+    defs = [n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    if not defs:
+        return False
+    body = defs[0].body
+    # drop a leading docstring expression
+    if body and isinstance(body[0], ast.Expr) and isinstance(
+            body[0].value, ast.Constant):
+        body = body[1:]
+    if len(body) != 1 or not isinstance(body[0], ast.Raise):
+        return False
+    exc = body[0].exc
+    name = getattr(exc, "id", None) or getattr(
+        getattr(exc, "func", None), "id", None)
+    return name == "NotImplementedError"
+
+
+def _smoke_fixtures():
+    import numpy as np
+    import paddle_tpu as pt
+
+    rng = np.random.RandomState(0)
+    f = pt.to_tensor(rng.rand(2, 3).astype(np.float32) + 0.1)   # positive
+    fs = pt.to_tensor(rng.randn(2, 3).astype(np.float32))       # signed
+    sq = pt.to_tensor(rng.randn(3, 3).astype(np.float32))       # square
+    spd = pt.to_tensor((np.eye(3) * 3 + rng.rand(3, 3) * 0.1
+                        + (rng.rand(3, 3) * 0.1).T).astype(np.float32))
+    i = pt.to_tensor(np.array([[1, 0, 2], [2, 1, 0]], np.int64))
+    b = pt.to_tensor(np.array([[True, False, True],
+                               [False, True, True]]))
+    frac = pt.to_tensor(rng.rand(2, 3).astype(np.float32) * 0.8 + 0.1)
+    vec = pt.to_tensor(rng.randn(6).astype(np.float32))
+    return {"f": f, "fs": fs, "sq": sq, "spd": spd, "i": i, "b": b,
+            "frac": frac, "vec": vec}
+
+
+def _generic_attempts(fx):
+    """Argument tuples tried in order for ops without an explicit smoke."""
+    f, fs, sq, i, b = fx["f"], fx["fs"], fx["sq"], fx["i"], fx["b"]
+    return [
+        (fx["frac"],), (f,), (fs,), (sq,), (fx["vec"],), (i,), (b,),
+        (f, f), (fs, fs), (sq, sq), (i, i), (b, b),
+        (f, 1.0), (f, 2), (fs, 0), (f, [2, 3]), (i, 3),
+        (f, f, f), (b, f, f),
+    ]
+
+
+def _explicit_smokes():
+    """Per-op invocations for surfaces whose signatures the generic
+    attempts can't satisfy.  Keyed by the COVERED TARGET name."""
+    import numpy as np
+    import paddle_tpu as pt
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    t = lambda a, **k: pt.to_tensor(np.asarray(a), **k)
+    img = t(rng.randn(1, 3, 8, 8).astype(np.float32))
+    img1 = t(rng.randn(1, 3, 8).astype(np.float32))
+    img3 = t(rng.randn(1, 3, 4, 8, 8).astype(np.float32))
+    w2 = t(rng.randn(4, 3, 3, 3).astype(np.float32))
+    lab = t(np.array([1, 0], np.int64))
+    logits = t(rng.randn(2, 4).astype(np.float32))
+    probs = t(np.abs(rng.rand(2, 4).astype(np.float32)) + 0.1)
+    bsh = t(rng.randn(2, 8, 2, 4).astype(np.float32))  # [b,s,h,d]
+    seq = t(rng.randn(4, 2, 6).astype(np.float32))     # [T,B,C]
+    emb_w = t(rng.randn(10, 4).astype(np.float32))
+
+    return {
+        "conv2d": lambda: F.conv2d(img, w2, padding=1),
+        "conv1d": lambda: F.conv1d(img1, t(rng.randn(4, 3, 3).astype(np.float32)), padding=1),
+        "conv3d": lambda: F.conv3d(img3, t(rng.randn(4, 3, 2, 3, 3).astype(np.float32))),
+        "conv2d_transpose": lambda: F.conv2d_transpose(img, t(rng.randn(4, 3, 3, 3).astype(np.float32))),
+        "conv1d_transpose": lambda: F.conv1d_transpose(img1, t(rng.randn(4, 3, 3).astype(np.float32))),
+        "conv3d_transpose": lambda: F.conv3d_transpose(img3, t(rng.randn(4, 3, 2, 3, 3).astype(np.float32))),
+        "avg_pool2d": lambda: F.avg_pool2d(img, 2),
+        "avg_pool3d": lambda: F.avg_pool3d(img3, 2),
+        "max_pool2d": lambda: F.max_pool2d(img, 2, return_mask=True),
+        "max_pool3d": lambda: F.max_pool3d(img3, 2, return_mask=True),
+        "max_unpool2d": lambda: F.max_unpool2d(
+            *F.max_pool2d(img, 2, return_mask=True), kernel_size=2),
+        "interpolate": lambda: F.interpolate(img, scale_factor=2, mode="nearest"),
+        "cross_entropy": lambda: F.cross_entropy(logits, lab),
+        "binary_cross_entropy": lambda: F.binary_cross_entropy(
+            t(rng.rand(2, 4).astype(np.float32)), t(rng.rand(2, 4).astype(np.float32))),
+        "binary_cross_entropy_with_logits": lambda: F.binary_cross_entropy_with_logits(
+            logits, t(rng.rand(2, 4).astype(np.float32))),
+        "ctc_loss": lambda: F.ctc_loss(seq, t(np.array([[1, 2], [2, 1]], np.int64)),
+                                       t(np.array([4, 4], np.int64)),
+                                       t(np.array([2, 2], np.int64))),
+        "flash_attention": lambda: F.flash_attention(bsh, bsh, bsh),
+        "scaled_dot_product_attention": lambda: F.scaled_dot_product_attention(bsh, bsh, bsh),
+        "embedding": lambda: F.embedding(t(np.array([[1, 2]], np.int64)), emb_w),
+        "one_hot": lambda: F.one_hot(lab, 4),
+        "kl_div": lambda: F.kl_div(F.log_softmax(logits), F.softmax(logits)),
+        "nll_loss": lambda: F.nll_loss(F.log_softmax(logits), lab),
+        "margin_cross_entropy": lambda: F.margin_cross_entropy(
+            F.normalize(logits), lab),
+        "softmax_with_cross_entropy": lambda: F.cross_entropy(logits, lab),
+        "gather": lambda: pt.ops.gather(logits, t(np.array([0, 1], np.int64))),
+        "gather_nd": lambda: pt.ops.gather_nd(logits, t(np.array([[0, 1]], np.int64))),
+        "scatter": lambda: pt.ops.scatter(logits, t(np.array([0, 1], np.int64)), logits),
+        "scatter_nd": lambda: pt.ops.scatter_nd(
+            t(np.array([[1], [2]], np.int64)), t(rng.randn(2, 4).astype(np.float32)), [4, 4]),
+        "scatter_nd_add": lambda: pt.ops.scatter_nd_add(
+            logits, t(np.array([[0], [1]], np.int64)), logits),
+        "index_select": lambda: pt.ops.index_select(logits, t(np.array([0, 1], np.int64))),
+        "index_add": lambda: pt.ops.index_add(
+            logits, t(np.array([0, 1], np.int64)), 0, logits),
+        "index_put": lambda: pt.ops.index_put(
+            logits, (t(np.array([0], np.int64)),), t(rng.randn(1, 4).astype(np.float32))),
+        "put_along_axis": lambda: pt.ops.put_along_axis(
+            logits, t(np.array([[0], [1]], np.int64)), 1.0, 1),
+        "take_along_axis": lambda: pt.ops.take_along_axis(
+            logits, t(np.array([[0], [1]], np.int64)), 1),
+        "topk": lambda: pt.ops.topk(logits, 2),
+        "pad": lambda: F.pad(img, [1, 1, 1, 1]),
+        "dropout": lambda: F.dropout(logits, 0.5),
+        "batch_norm": lambda: pt.nn.BatchNorm2D(3)(img),
+        "layer_norm": lambda: F.layer_norm(logits, 4,
+                                           t(np.ones(4, np.float32)), t(np.zeros(4, np.float32))),
+        "instance_norm": lambda: pt.nn.InstanceNorm2D(3)(img),
+        "group_norm": lambda: pt.nn.GroupNorm(1, 3)(img),
+        "local_response_norm": lambda: F.local_response_norm(img, 3),
+        "prelu": lambda: F.prelu(logits, t(np.array([0.2], np.float32))),
+        "pixel_shuffle": lambda: F.pixel_shuffle(t(rng.randn(1, 4, 3, 3).astype(np.float32)), 2),
+        "pixel_unshuffle": lambda: F.pixel_unshuffle(img, 2),
+        "linear": lambda: F.linear(logits, t(rng.randn(4, 5).astype(np.float32))),
+        "bilinear": lambda: F.bilinear(logits, logits,
+                                       t(rng.randn(3, 4, 4).astype(np.float32))),
+        "bincount": lambda: pt.ops.bincount(t(np.array([0, 1, 1], np.int64))),
+        "multinomial": lambda: pt.ops.multinomial(probs, 1),
+        "bernoulli": lambda: pt.ops.bernoulli(t(np.full((2, 2), 0.5, np.float32))),
+        "full": lambda: pt.ops.full([2, 2], 1.0),
+        "arange": lambda: pt.ops.arange(0, 5),
+        "linspace": lambda: pt.ops.linspace(0, 1, 5),
+        "logspace": lambda: pt.ops.logspace(0, 1, 5),
+        "eye": lambda: pt.ops.eye(3),
+        "tril_indices": lambda: pt.ops.tril_indices(3, 3, 0),
+        "triu_indices": lambda: pt.ops.triu_indices(3, 3, 0),
+        "randint": lambda: pt.ops.randint(0, 5, [2, 2]),
+        "randperm": lambda: pt.ops.randperm(5),
+        "rand": lambda: pt.ops.rand([2, 2]),
+        "randn": lambda: pt.ops.randn([2, 2]),
+        "normal": lambda: pt.ops.normal(0.0, 1.0, [2, 2]),
+        "uniform": lambda: pt.ops.uniform([2, 2]),
+        "uniform_": lambda: pt.ops.uniform_(t(rng.randn(2, 2).astype(np.float32)), 0, 1),
+        "exponential_": lambda: pt.ops.exponential_(t(np.ones((2, 2), np.float32))),
+        "poisson": lambda: pt.ops.poisson(t(np.ones((2, 2), np.float32))),
+        "standard_gamma": lambda: pt.ops.standard_gamma(t(np.ones((2, 2), np.float32))),
+        "reshape": lambda: pt.ops.reshape(logits, [4, 2]),
+        "transpose": lambda: pt.ops.transpose(logits, [1, 0]),
+        "squeeze": lambda: pt.ops.squeeze(t(rng.randn(1, 2).astype(np.float32))),
+        "unsqueeze": lambda: pt.ops.unsqueeze(logits, 0),
+        "concat": lambda: pt.ops.concat([logits, logits]),
+        "stack": lambda: pt.ops.stack([logits, logits]),
+        "split": lambda: pt.ops.split(logits, 2),
+        "chunk": lambda: pt.ops.chunk(logits, 2),
+        "tile": lambda: pt.ops.tile(logits, [2, 1]),
+        "expand": lambda: pt.ops.expand(t(rng.randn(1, 4).astype(np.float32)), [3, 4]),
+        "expand_as": lambda: pt.ops.expand_as(
+            t(rng.randn(1, 4).astype(np.float32)), logits),
+        "broadcast_to": lambda: pt.ops.broadcast_to(
+            t(rng.randn(1, 4).astype(np.float32)), [3, 4]),
+        "flip": lambda: pt.ops.flip(logits, [0]),
+        "roll": lambda: pt.ops.roll(logits, 1),
+        "cumsum": lambda: pt.ops.cumsum(logits, 0),
+        "cumprod": lambda: pt.ops.cumprod(logits, 0),
+        "cummax": lambda: pt.ops.cummax(logits, 0),
+        "cummin": lambda: pt.ops.cummin(logits, 0),
+        "logcumsumexp": lambda: pt.ops.logcumsumexp(logits, 0),
+        "unbind": lambda: pt.ops.unbind(logits),
+        "unstack": lambda: pt.ops.unstack(logits),
+        "strided_slice": lambda: pt.ops.strided_slice(logits, [0], [0], [2], [1]),
+        "slice": lambda: pt.ops.slice(logits, [0], [0], [1]),
+        "crop": lambda: pt.ops.crop(logits, [1, 2]),
+        "argsort": lambda: pt.ops.argsort(logits),
+        "sort": lambda: pt.ops.sort(logits),
+        "searchsorted": lambda: pt.ops.searchsorted(
+            t(np.array([1.0, 2.0, 3.0], np.float32)), t(np.array([1.5], np.float32))),
+        "unique": lambda: pt.ops.unique(t(np.array([1, 1, 2], np.int64))),
+        "unique_consecutive": lambda: pt.ops.unique_consecutive(
+            t(np.array([1, 1, 2], np.int64))),
+        "masked_select": lambda: pt.ops.masked_select(
+            logits, t(np.ones((2, 4), bool))),
+        "masked_fill": lambda: pt.ops.masked_fill(
+            logits, t(np.zeros((2, 4), bool)), 0.0),
+        "where": lambda: pt.ops.where(t(np.ones((2, 4), bool)), logits, logits),
+        "clip": lambda: pt.ops.clip(logits, -1.0, 1.0),
+        "matmul": lambda: pt.ops.matmul(logits, t(rng.randn(4, 2).astype(np.float32))),
+        "mm": lambda: pt.ops.mm(logits, t(rng.randn(4, 2).astype(np.float32))),
+        "bmm": lambda: pt.ops.bmm(t(rng.randn(2, 3, 4).astype(np.float32)),
+                                  t(rng.randn(2, 4, 3).astype(np.float32))),
+        "addmm": lambda: pt.ops.addmm(
+            t(rng.randn(2, 2).astype(np.float32)), logits,
+            t(rng.randn(4, 2).astype(np.float32))),
+        "einsum": lambda: pt.ops.einsum("ij,jk->ik", logits,
+                                        t(rng.randn(4, 2).astype(np.float32))),
+        "norm": lambda: pt.ops.norm(logits),
+        "dist": lambda: pt.ops.dist(logits, logits),
+        "cdist": lambda: pt.ops.cdist(logits, logits),
+        "cross": lambda: pt.ops.cross(t(rng.randn(2, 3).astype(np.float32)),
+                                      t(rng.randn(2, 3).astype(np.float32))),
+        "dot": lambda: pt.ops.dot(t(rng.randn(4).astype(np.float32)),
+                                  t(rng.randn(4).astype(np.float32))),
+        "tensordot": lambda: pt.ops.tensordot(logits, logits, axes=2),
+        "kron": lambda: pt.ops.kron(logits, logits),
+        "outer": lambda: pt.ops.outer(t(rng.randn(3).astype(np.float32)),
+                                      t(rng.randn(3).astype(np.float32))),
+        "inner": lambda: pt.ops.inner(t(rng.randn(3).astype(np.float32)),
+                                      t(rng.randn(3).astype(np.float32))),
+        "mv": lambda: pt.ops.mv(logits, t(rng.randn(4).astype(np.float32))),
+        "histogram": lambda: pt.ops.histogram(logits, 4),
+        "histogramdd": lambda: pt.ops.histogramdd(
+            t(rng.randn(5, 2).astype(np.float32)), 3),
+        "quantile": lambda: pt.ops.quantile(logits, 0.5),
+        "nanquantile": lambda: pt.ops.nanquantile(logits, 0.5),
+        "kthvalue": lambda: pt.ops.kthvalue(logits, 2),
+        "mode": lambda: pt.ops.mode(logits),
+        "median": lambda: pt.ops.median(logits),
+        "nanmedian": lambda: pt.ops.nanmedian(logits),
+        "diff": lambda: pt.ops.diff(logits),
+        "trapezoid": lambda: pt.ops.trapezoid(logits),
+        "cumulative_trapezoid": lambda: pt.ops.cumulative_trapezoid(logits),
+        "diag": lambda: pt.ops.diag(t(rng.randn(3).astype(np.float32))),
+        "diagflat": lambda: pt.ops.diagflat(t(rng.randn(3).astype(np.float32))),
+        "diagonal": lambda: pt.ops.diagonal(t(rng.randn(3, 3).astype(np.float32))),
+        "diag_embed": lambda: pt.ops.diag_embed(logits),
+        "fill_diagonal_": lambda: pt.ops.fill_diagonal_(
+            t(rng.randn(3, 3).astype(np.float32)), 0.0),
+        "fill_diagonal_tensor": lambda: pt.ops.fill_diagonal_tensor(
+            t(rng.randn(3, 3).astype(np.float32)), t(np.zeros(3, np.float32))),
+        "trace": lambda: pt.ops.trace(t(rng.randn(3, 3).astype(np.float32))),
+        "rot90": lambda: pt.ops.rot90(logits),
+        "meshgrid": lambda: pt.ops.meshgrid(t(rng.randn(2).astype(np.float32)),
+                                            t(rng.randn(3).astype(np.float32))),
+        "repeat_interleave": lambda: pt.ops.repeat_interleave(logits, 2),
+        "renorm": lambda: pt.ops.renorm(logits, 2.0, 0, 1.0),
+        "multi_dot": lambda: pt.ops.linalg.multi_dot(
+            [logits, t(rng.randn(4, 2).astype(np.float32))]),
+        "as_complex": lambda: pt.ops.as_complex(
+            t(rng.randn(3, 2).astype(np.float32))),
+        "as_real": lambda: pt.ops.as_real(pt.ops.as_complex(
+            t(rng.randn(3, 2).astype(np.float32)))),
+        "complex": lambda: pt.ops.complex(logits, logits),
+        "polar": lambda: pt.ops.polar(probs, logits),
+        "pad3d": lambda: F.pad(img3, [1, 1, 1, 1, 1, 1]),
+        "temporal_shift": lambda: F.temporal_shift(
+            t(rng.randn(4, 4, 2, 2).astype(np.float32)), 2, 0.25),
+        "affine_grid": lambda: F.affine_grid(
+            t(rng.randn(1, 2, 3).astype(np.float32)), [1, 3, 4, 4]),
+        "grid_sample": lambda: F.grid_sample(
+            img, t(rng.rand(1, 8, 8, 2).astype(np.float32) * 2 - 1)),
+        "channel_shuffle": lambda: F.channel_shuffle(
+            t(rng.randn(1, 4, 3, 3).astype(np.float32)), 2),
+        "gumbel_softmax": lambda: F.gumbel_softmax(logits),
+        "log_softmax": lambda: F.log_softmax(logits),
+        "softmax": lambda: F.softmax(logits),
+        "unfold": lambda: F.unfold(img, 3),
+        "fold": lambda: F.fold(F.unfold(img, 3), [8, 8], 3),
+        "gaussian": lambda: pt.ops.gaussian([2, 2]),
+        "gather_tree": lambda: pt.ops.gather_tree(
+            t(np.zeros((2, 1, 2), np.int64)), t(np.zeros((1, 2), np.int64))),
+        "flash_attn_unpadded": lambda: F.flash_attn_unpadded(
+            t(rng.randn(8, 2, 4).astype(np.float32)),
+            t(rng.randn(8, 2, 4).astype(np.float32)),
+            t(rng.randn(8, 2, 4).astype(np.float32)),
+            t(np.array([0, 5, 8], np.int32)), t(np.array([0, 5, 8], np.int32))),
+        "fused_adamw_update": lambda: __import__(
+            "paddle_tpu.ops.pallas_kernels.fused_adamw",
+            fromlist=["fused_adamw_update"]).fused_adamw_update(
+                *(np.zeros((2, 130), np.float32),) * 4, 1e-3, 0.9, 0.999,
+                interpret=True),
+        "cast": lambda: pt.ops.cast(logits, "int32"),
+        "zeros": lambda: pt.ops.zeros([2, 2]),
+        "ones": lambda: pt.ops.ones([2, 2]),
+        "empty": lambda: pt.ops.empty([2, 2]),
+        "frame": lambda: pt.signal.frame(
+            t(np.arange(8, dtype=np.float32)), 4, 2),
+        "matrix_power": lambda: pt.ops.matrix_power(
+            t(np.eye(3, dtype=np.float32)), 2),
+        "shard_index": lambda: pt.ops.shard_index(
+            t(np.array([[1], [5]], np.int64)), 8, 2, 0),
+        "nms": lambda: pt.vision.ops.nms(
+            t(np.array([[0, 0, 1, 1], [0, 0, 1.1, 1.1]], np.float32)), 0.5),
+        "roi_align": lambda: pt.vision.ops.roi_align(
+            img, t(np.array([[0, 0, 4, 4]], np.float32)),
+            t(np.array([1], np.int32)), 2),
+        "roi_pool": lambda: pt.vision.ops.roi_pool(
+            img, t(np.array([[0, 0, 4, 4]], np.float32)),
+            t(np.array([1], np.int32)), 2),
+        "prior_box": lambda: pt.vision.ops.prior_box(
+            img, img, min_sizes=[2.0]),
+        "box_coder": lambda: pt.vision.ops.box_coder(
+            t(np.array([[0, 0, 1, 1]], np.float32)),
+            t(np.array([0.1, 0.1, 0.2, 0.2], np.float32)),
+            t(np.array([[[0, 0, 1, 1]]], np.float32))),
+        "viterbi_decode": lambda: pt.text.viterbi_decode(
+            t(rng.randn(1, 3, 4).astype(np.float32)),
+            t(rng.randn(4, 4).astype(np.float32)),
+            t(np.array([3], np.int64))),
+        "send_u_recv": lambda: pt.geometric.send_u_recv(
+            t(rng.randn(4, 2).astype(np.float32)),
+            t(np.array([0, 1], np.int64)), t(np.array([1, 2], np.int64))),
+        "send_ue_recv": lambda: pt.geometric.send_ue_recv(
+            t(rng.randn(4, 2).astype(np.float32)),
+            t(rng.randn(2, 2).astype(np.float32)),
+            t(np.array([0, 1], np.int64)), t(np.array([1, 2], np.int64))),
+        "send_uv": lambda: pt.geometric.send_uv(
+            t(rng.randn(4, 2).astype(np.float32)),
+            t(rng.randn(4, 2).astype(np.float32)),
+            t(np.array([0, 1], np.int64)), t(np.array([1, 2], np.int64))),
+    }
+
+
+def smoke_covered(covered):
+    """Execute every covered mapping; return (executed, static_ok, stubs).
+
+    - ``executed``: the mapping's callable ran on tiny CPU inputs
+    - ``static_ok``: not executed (signature not synthesized / class
+      target) but source-verified as a real body
+    - ``stubs``: raised NotImplementedError when called, or the body IS an
+      unconditional raise — these FAIL coverage
+    """
+    explicit = _explicit_smokes()
+    executed, static_ok, stubs, unresolved = [], [], [], []
+    for op, target in sorted(covered.items()):
+        # fresh fixtures per op: in-place ops (fill_, increment, ...)
+        # mutate their inputs, and a shared fixture would leak that
+        # mutation into every later probe
+        attempts = _generic_attempts(_smoke_fixtures())
+        fn = (_resolve_dotted(target) if "." in target
+              else _resolve_flat(target))
+        if fn is None:
+            unresolved.append(op)
+            continue
+        probe = fn
+        if isinstance(fn, type):        # class target: constructor probe
+            if _is_source_stub(getattr(fn, "__init__", fn)):
+                stubs.append(op)
+            else:
+                static_ok.append(op)
+            continue
+        if _is_source_stub(probe):
+            stubs.append(op)
+            continue
+        key = target.split(".")[-1]
+        ran = False
+        if key in explicit or target in explicit:
+            try:
+                (explicit.get(target) or explicit[key])()
+                ran = True
+            except NotImplementedError:
+                stubs.append(op)
+                continue
+            except Exception:
+                pass
+        if not ran:
+            for args in attempts:
+                try:
+                    probe(*args)
+                    ran = True
+                    break
+                except NotImplementedError:
+                    stubs.append(op)
+                    ran = None
+                    break
+                except Exception:
+                    continue
+        if ran is None:
+            continue
+        (executed if ran else static_ok).append(op)
+    return executed, static_ok, stubs, unresolved
 
 
 def classify(ref_ops, ours):
@@ -202,10 +620,25 @@ def main():
     ref_ops = reference_ops(args.ref)
     ours = our_surface()
     covered, missing = classify(ref_ops, ours)
+    # integrity pass (round-4 verdict weak #2): a mapping only counts as
+    # covered if it EXECUTES on tiny CPU inputs (or is a source-verified
+    # real body when no generic signature fits); NotImplementedError
+    # stubs are failed into the missing list
+    executed, static_ok, stubs, unresolved = smoke_covered(covered)
+    for op in stubs:
+        covered.pop(op, None)
+        missing.append(op + " (stub: raises NotImplementedError)")
+    for op in unresolved:
+        covered.pop(op, None)
+        missing.append(op + " (unresolvable covered_map target)")
+    missing = sorted(missing)
     doc = {
         "reference_manifest_ops": len(ref_ops),
         "covered": len(covered),
         "coverage_pct": round(100.0 * len(covered) / max(len(ref_ops), 1), 1),
+        "covered_executed": len(executed),
+        "covered_static_only": len(static_ok),
+        "static_only_ops": static_ok,
         "our_public_callables": len(ours),
         "missing": missing,
         "covered_map": covered,
