@@ -142,3 +142,90 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
         return mop(jnp.take(xv, sv, axis=0), jnp.take(yv, dv, axis=0))
 
     return dispatch.apply(raw, x, y, src, dst, op_name="send_uv")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact-id reindex of a sampled subgraph (reference
+    python/paddle/geometric/reindex.py:25, phi reindex_graph kernel).
+
+    Host-vectorized numpy (np.unique over the concatenated id space —
+    no python loop): graph sampling is dataloader-side work feeding the
+    device, exactly like the reference's CPU kernel.  Returns
+    (reindex_src, reindex_dst, out_nodes) with input nodes first."""
+    import numpy as np
+
+    xv = np.asarray(ensure_tensor(x)._value).astype(np.int64).ravel()
+    nb = np.asarray(ensure_tensor(neighbors)._value).astype(np.int64).ravel()
+    cnt = np.asarray(ensure_tensor(count)._value).astype(np.int64).ravel()
+    # out_nodes: x first, then first-appearance unique of the rest
+    seen = {int(v): i for i, v in enumerate(xv)}
+    extra = []
+    for v in nb:
+        v = int(v)
+        if v not in seen:
+            seen[v] = len(xv) + len(extra)
+            extra.append(v)
+    out_nodes = np.concatenate([xv, np.asarray(extra, np.int64)]) \
+        if extra else xv.copy()
+    lut_keys = out_nodes
+    order = np.argsort(lut_keys, kind="stable")
+    reindex_src = order[np.searchsorted(lut_keys[order], nb)]
+    reindex_dst = np.repeat(np.arange(len(xv), dtype=np.int64), cnt)
+    return (Tensor(jnp.asarray(reindex_src.astype(np.int64))),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(out_nodes)))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional neighbor sampling without replacement over a
+    CSC graph (reference geometric/sampling/neighbors.py:175, phi
+    weighted_sample_neighbors kernel via GPU A-RES).
+
+    TPU-native analog of the reference's A-RES reservoir: Gumbel-top-k
+    over log-weights — adding Gumbel noise to log(w) and taking the top
+    k IS weighted sampling without replacement, and it vectorizes over
+    every candidate edge at once (no per-node reservoir loop)."""
+    import numpy as np
+
+    rv = np.asarray(ensure_tensor(row)._value).astype(np.int64).ravel()
+    cp = np.asarray(ensure_tensor(colptr)._value).astype(np.int64).ravel()
+    w = np.asarray(ensure_tensor(edge_weight)._value,
+                   np.float64).ravel()
+    nodes = np.asarray(ensure_tensor(input_nodes)._value) \
+        .astype(np.int64).ravel()
+    ev = (np.asarray(ensure_tensor(eids)._value).astype(np.int64).ravel()
+          if eids is not None else None)
+    if return_eids and ev is None:
+        raise ValueError("return_eids=True requires eids")
+
+    deg = cp[nodes + 1] - cp[nodes]
+    take = deg if sample_size < 0 else np.minimum(deg, sample_size)
+    # flatten all candidate edges of all query nodes
+    starts = cp[nodes]
+    edge_idx = np.concatenate(
+        [np.arange(s, s + d) for s, d in zip(starts, deg)]) \
+        if deg.sum() else np.zeros((0,), np.int64)
+    owner = np.repeat(np.arange(len(nodes)), deg)
+    from ..ops.random import derive_numpy_rng
+
+    rng = derive_numpy_rng()
+    gumbel = -np.log(-np.log(rng.uniform(1e-12, 1.0, edge_idx.shape)))
+    key = np.log(np.maximum(w[edge_idx], 1e-30)) + gumbel
+    # within each owner segment keep the top take[i] keys
+    order = np.lexsort((-key, owner))          # owner asc, key desc
+    rank = np.arange(len(order)) - np.repeat(
+        np.concatenate([[0], np.cumsum(deg)[:-1]]), deg)
+    sel = order[rank < np.repeat(take, deg)]
+    out_neighbors = rv[edge_idx[sel]]
+    out_count = take.astype(np.int32)
+    res = (Tensor(jnp.asarray(out_neighbors)),
+           Tensor(jnp.asarray(out_count)))
+    if return_eids:
+        res = res + (Tensor(jnp.asarray(ev[edge_idx[sel]])),)
+    return res
+
+
+__all__ += ["reindex_graph", "weighted_sample_neighbors"]

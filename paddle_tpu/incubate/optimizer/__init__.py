@@ -83,3 +83,110 @@ class LookAhead:
         loss.backward()
         self.step()
         self.clear_grad()
+
+
+class ModelAverage:
+    """Averaged-parameter evaluation (reference python/paddle/incubate/
+    optimizer/modelaverage.py over the phi average_accumulates_ kernel).
+
+    Keeps the kernel's exact three-buffer scheme — sum_1 accumulates
+    every step, overflows into sum_2 every 16384 updates (precision
+    guard), and the whole window shifts into sum_3 when
+    num_accumulates >= min(max_average_window, num_updates *
+    average_window_rate) (and >= min_average_window).  TPU-native: the
+    buffers are device tensors updated with jnp expressions and the
+    window predicates are traced on device-side counters, so ``step()``
+    fuses into a compiled train step like LookAhead/DGC.
+    """
+
+    _K_MAX_ACC = 16384
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._rate = float(average_window_rate)
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
+        self._parameter_list = list(parameters or [])
+        z = lambda p: Tensor(jnp.zeros_like(
+            p._value, dtype=jnp.float32))
+        self._sum1 = {id(p): z(p) for p in self._parameter_list}
+        self._sum2 = {id(p): z(p) for p in self._parameter_list}
+        self._sum3 = {id(p): z(p) for p in self._parameter_list}
+        self._num_acc = Tensor(jnp.zeros((), jnp.int64))
+        self._old_num_acc = Tensor(jnp.zeros((), jnp.int64))
+        self._num_upd = Tensor(jnp.zeros((), jnp.int64))
+        self._backup = None
+
+    @dispatch.no_grad()
+    def step(self):
+        for t in (self._num_acc, self._old_num_acc, self._num_upd):
+            dispatch.note_read(t)
+        n_upd = self._num_upd._value + 1
+        n_acc = self._num_acc._value + 1
+        spill = (n_upd % self._K_MAX_ACC) == 0
+        window = jnp.minimum(
+            jnp.asarray(self._max_w, jnp.float32),
+            n_upd.astype(jnp.float32) * self._rate)
+        shift = (n_acc >= self._min_w) & (n_acc.astype(jnp.float32)
+                                          >= window)
+        for p in self._parameter_list:
+            s1, s2, s3 = (self._sum1[id(p)], self._sum2[id(p)],
+                          self._sum3[id(p)])
+            for t in (s1, s2, s3):
+                dispatch.note_read(t)
+            new1 = s1._value + p._value.astype(jnp.float32)
+            new2 = jnp.where(spill, s2._value + new1, s2._value)
+            new1 = jnp.where(spill, 0.0, new1)
+            new3 = jnp.where(shift, new1 + new2, s3._value)
+            new1 = jnp.where(shift, 0.0, new1)
+            new2 = jnp.where(shift, 0.0, new2)
+            s1._set_value(new1)
+            s2._set_value(new2)
+            s3._set_value(new3)
+        self._old_num_acc._set_value(
+            jnp.where(shift, n_acc, self._old_num_acc._value))
+        self._num_acc._set_value(jnp.where(shift, 0, n_acc))
+        self._num_upd._set_value(n_upd)
+
+    def _average_value(self, p):
+        total = (self._sum1[id(p)]._value + self._sum2[id(p)]._value
+                 + self._sum3[id(p)]._value)
+        denom = jnp.maximum(
+            (self._num_acc._value + self._old_num_acc._value)
+            .astype(jnp.float32), 1.0)
+        return (total / denom).astype(p._value.dtype)
+
+    @dispatch.no_grad()
+    def apply(self, executor=None, need_restore=True):
+        """Context manager: evaluate with averaged parameters."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._backup = {id(p): jnp.array(p._value, copy=True)
+                            for p in self._parameter_list}
+            for p in self._parameter_list:
+                p._set_value(self._average_value(p))
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    @dispatch.no_grad()
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._parameter_list:
+            p._set_value(self._backup[id(p)])
+        self._backup = None
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        self.step()
+
+
+__all__ += ["ModelAverage"]

@@ -8,7 +8,8 @@ from .activation import (  # noqa: F401
 )
 from .attention import flash_attention, flash_attn_unpadded, scaled_dot_product_attention  # noqa: F401
 from .common import (  # noqa: F401
-    alpha_dropout, bilinear, cosine_similarity, dropout, dropout2d, dropout3d,
+    alpha_dropout, bilinear, class_center_sample, cosine_similarity, dropout,
+    dropout2d, dropout3d,
     embedding, interpolate, label_smooth, linear, normalize, one_hot, pad,
     fold, pixel_shuffle, pixel_unshuffle, sequence_mask, unfold, upsample,
 )
@@ -18,10 +19,10 @@ from .conv import (  # noqa: F401
 from .loss import (  # noqa: F401
     binary_cross_entropy, binary_cross_entropy_with_logits, cosine_embedding_loss,
     cross_entropy, ctc_loss, fused_linear_cross_entropy, hinge_embedding_loss,
-    huber_loss, kl_div, l1_loss, log_loss, margin_cross_entropy,
-    margin_ranking_loss, mse_loss,
-    nll_loss, smooth_l1_loss, softmax_with_cross_entropy, square_error_cost,
-    triplet_margin_loss,
+    hsigmoid_loss, huber_loss, kl_div, l1_loss, log_loss,
+    margin_cross_entropy, margin_ranking_loss, mse_loss,
+    nll_loss, rnnt_loss, smooth_l1_loss, softmax_with_cross_entropy,
+    square_error_cost, triplet_margin_loss,
 )
 from .vision import affine_grid, channel_shuffle, grid_sample, temporal_shift  # noqa: F401
 from .norm import (  # noqa: F401
@@ -31,5 +32,5 @@ from .norm import (  # noqa: F401
 from .pooling import (  # noqa: F401
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_max_pool1d,
     adaptive_max_pool2d, avg_pool1d, avg_pool2d, avg_pool3d, max_pool1d,
-    max_pool2d, max_pool3d, max_unpool2d,
+    max_pool2d, max_pool3d, max_unpool1d, max_unpool2d, max_unpool3d,
 )

@@ -391,3 +391,38 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
         return out[:, :, ph:ph + oh, pw:pw + ow]
 
     return dispatch.apply(fn, x, op_name="fold")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """PartialFC class-center sampling (reference python/paddle/nn/
+    functional/common.py class_center_sample, phi class_center_sample
+    kernel): keep all positive class centers, uniformly sample negatives
+    up to ``num_samples``, return (remapped_label, sorted sampled
+    centers).
+
+    Host-eager by design: the op draws a variable-length sorted id set
+    (data-dependent shape) and runs once per step OUTSIDE the compiled
+    region — the heavy parts (the margin softmax over sampled centers)
+    stay on device.  ``group`` is accepted for API parity; the
+    model-parallel split rides mp sharding of the class dimension."""
+    import numpy as np
+
+    from ...tensor import Tensor
+
+    lab = np.asarray(ensure_tensor(label)._value).astype(np.int64)
+    pos = np.unique(lab)
+    n_neg = max(int(num_samples) - pos.size, 0)
+    if n_neg > 0:
+        neg_pool = np.setdiff1d(np.arange(num_classes, dtype=np.int64), pos,
+                                assume_unique=True)
+        from ...ops.random import derive_numpy_rng
+
+        rng = derive_numpy_rng()
+        neg = rng.choice(neg_pool, size=min(n_neg, neg_pool.size),
+                         replace=False)
+        sampled = np.sort(np.concatenate([pos, neg]))
+    else:
+        sampled = pos
+    remap = np.searchsorted(sampled, lab)
+    return (Tensor(jnp.asarray(remap.astype(np.int64))),
+            Tensor(jnp.asarray(sampled.astype(np.int64))))

@@ -282,3 +282,64 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
         return flat.reshape(n, c, oh, ow)
 
     return dispatch.apply(fn, x, indices, op_name="max_unpool2d")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """reference phi unpool (1-D form): scatter over the per-(N,C)-plane
+    flat indices from max_pool1d(return_mask=True)."""
+    if data_format != "NCL":
+        raise NotImplementedError("max_unpool1d supports NCL")
+    x = ensure_tensor(x)
+    indices = ensure_tensor(indices)
+    ks = _tuple_n(kernel_size, 1)
+    st = _tuple_n(stride if stride is not None else kernel_size, 1)
+    pd = _tuple_n(padding, 1)
+    n_, c_, ll = x._value.shape
+    if output_size is not None:
+        ol = int(output_size[-1])
+    else:
+        ol = (ll - 1) * st[0] - 2 * pd[0] + ks[0]
+
+    def fn(a, idx):
+        n, c = a.shape[0], a.shape[1]
+        flat = jnp.zeros((n, c, ol), a.dtype)
+        b = jnp.arange(n)[:, None, None]
+        ch = jnp.arange(c)[None, :, None]
+        flat = flat.at[b, ch, idx].set(a)
+        return flat
+
+    return dispatch.apply(fn, x, indices, op_name="max_unpool1d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """reference phi unpool3d: scatter pooled values back to the
+    positions recorded by max_pool3d(return_mask=True) (per-(N,C)-volume
+    d*H*W + h*W + w indices)."""
+    if data_format != "NCDHW":
+        raise NotImplementedError("max_unpool3d supports NCDHW")
+    x = ensure_tensor(x)
+    indices = ensure_tensor(indices)
+    ks = _tuple_n(kernel_size, 3)
+    st = _tuple_n(stride if stride is not None else kernel_size, 3)
+    pd = _tuple_n(padding, 3)
+    n_, c_, dd, hh, ww = x._value.shape
+    if output_size is not None:
+        od, oh, ow = [int(v) for v in output_size[-3:]]
+    else:
+        od = (dd - 1) * st[0] - 2 * pd[0] + ks[0]
+        oh = (hh - 1) * st[1] - 2 * pd[1] + ks[1]
+        ow = (ww - 1) * st[2] - 2 * pd[2] + ks[2]
+
+    def fn(a, idx):
+        n, c = a.shape[0], a.shape[1]
+        flat = jnp.zeros((n, c, od * oh * ow), a.dtype)
+        b = jnp.arange(n)[:, None, None]
+        ch = jnp.arange(c)[None, :, None]
+        vals = a.reshape(n, c, -1)
+        ii = idx.reshape(n, c, -1)
+        flat = flat.at[b, ch, ii].set(vals)
+        return flat.reshape(n, c, od, oh, ow)
+
+    return dispatch.apply(fn, x, indices, op_name="max_unpool3d")

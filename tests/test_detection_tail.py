@@ -1,0 +1,341 @@
+"""Detection op tail + remaining manifest ops (round 5).
+
+Reference analogs: test/legacy_test/test_{yolo_box,yolov3_loss,matrix_nms,
+multiclass_nms,generate_proposals_v2,psroi_pool,deformable_conv,
+unpool3d,hsigmoid,warprnnt}_op.py — numpy-reference checks per op.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ops as V
+
+RNG = np.random.RandomState(7)
+
+
+def _np_iou(a, b):
+    x1 = np.maximum(a[0], b[:, 0]); y1 = np.maximum(a[1], b[:, 1])
+    x2 = np.minimum(a[2], b[:, 2]); y2 = np.minimum(a[3], b[:, 3])
+    inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+    aa = (a[2] - a[0]) * (a[3] - a[1])
+    ab = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / np.maximum(aa + ab - inter, 1e-10)
+
+
+def _np_greedy_nms(boxes, scores, thr):
+    order = np.argsort(-scores, kind="stable")
+    keep, suppressed = [], np.zeros(len(boxes), bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        ious = _np_iou(boxes[i], boxes)
+        suppressed |= ious > thr
+        suppressed[i] = True
+    return keep
+
+
+def test_nms_matches_numpy_greedy():
+    boxes = (RNG.rand(40, 2) * 80).astype(np.float32)
+    boxes = np.concatenate([boxes, boxes + 10 + RNG.rand(40, 2) * 20],
+                           axis=1).astype(np.float32)
+    scores = RNG.rand(40).astype(np.float32)
+    keep = V.nms(pt.to_tensor(boxes), 0.4,
+                 scores=pt.to_tensor(scores)).numpy()
+    ref = _np_greedy_nms(boxes, scores, 0.4)
+    assert keep.tolist() == ref
+
+
+def test_nms_categorical():
+    boxes = np.tile(np.array([[0, 0, 10, 10]], np.float32), (6, 1))
+    boxes += RNG.rand(6, 4).astype(np.float32) * 0.01  # near-identical
+    scores = np.linspace(1.0, 0.5, 6).astype(np.float32)
+    cats = np.array([0, 0, 1, 1, 2, 2], np.int64)
+    keep = V.nms(pt.to_tensor(boxes), 0.5, scores=pt.to_tensor(scores),
+                 category_idxs=pt.to_tensor(cats),
+                 categories=[0, 1]).numpy()
+    # one survivor per allowed category; category 2 excluded
+    assert sorted(cats[keep].tolist()) == [0, 1]
+
+
+def test_yolo_box_single_cell_closed_form():
+    """One anchor, 1x1 grid: decode has a closed form."""
+    t = np.array([0.2, -0.3, 0.1, 0.4, 2.0, 1.5], np.float32)
+    x = pt.to_tensor(t.reshape(1, 6, 1, 1))
+    img = pt.to_tensor(np.array([[100, 200]], np.int32))
+    boxes, scores = V.yolo_box(x, img, anchors=[16, 30], class_num=1,
+                               conf_thresh=0.0, downsample_ratio=32,
+                               clip_bbox=False)
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    cx, cy = sig(t[0]) / 1.0, sig(t[1]) / 1.0
+    bw = 16 * np.exp(t[2]) / 32.0
+    bh = 30 * np.exp(t[3]) / 32.0
+    exp = np.array([(cx - bw / 2) * 200, (cy - bh / 2) * 100,
+                    (cx + bw / 2) * 200, (cy + bh / 2) * 100])
+    np.testing.assert_allclose(boxes.numpy()[0, 0], exp, rtol=1e-5)
+    np.testing.assert_allclose(scores.numpy()[0, 0, 0],
+                               sig(t[4]) * sig(t[5]), rtol=1e-5)
+
+
+def test_yolo_loss_trains():
+    x = pt.to_tensor(RNG.randn(2, 14, 8, 8).astype(np.float32),
+                     stop_gradient=False)
+    gtb = pt.to_tensor(RNG.rand(2, 5, 4).astype(np.float32) * 0.4 + 0.2)
+    gtl = pt.to_tensor(RNG.randint(0, 2, (2, 5)).astype(np.int32))
+    loss = V.yolo_loss(x, gtb, gtl, anchors=[10, 13, 16, 30],
+                       anchor_mask=[0, 1], class_num=2,
+                       ignore_thresh=0.7, downsample_ratio=32)
+    assert loss.shape == [2]
+    total = pt.ops.sum(loss)
+    total.backward()
+    g = x.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_matrix_nms_parity_with_kernel_reference():
+    """Vectorized decay vs a direct transcription of the reference CPU
+    kernel loop (phi matrix_nms_kernel.cc NMSMatrix)."""
+    bb = (RNG.rand(1, 12, 4) * 50).astype(np.float32)
+    bb[..., 2:] += bb[..., :2] + 5
+    sc = RNG.rand(1, 3, 12).astype(np.float32)
+
+    def np_matrix_nms(boxes, scores, score_thr, post_thr, top_k,
+                      gaussian, sigma):
+        picked = []  # (cls, score, idx)
+        for c in range(scores.shape[0]):
+            s = scores[c]
+            perm = [i for i in np.argsort(-s, kind="stable")
+                    if s[i] > score_thr][:top_k]
+            if not perm:
+                continue
+            n = len(perm)
+            iou = np.zeros((n, n))
+            for i in range(1, n):
+                for j in range(i):
+                    iou[i, j] = _np_iou(boxes[perm[i]],
+                                        boxes[perm[j]][None])[0]
+            iou_max = np.concatenate([[0.0], iou.max(axis=1)[1:]])
+            if s[perm[0]] > post_thr:
+                picked.append((c, s[perm[0]], perm[0]))
+            for i in range(1, n):
+                decay = 1.0
+                for j in range(i):
+                    if gaussian:
+                        d = np.exp((iou_max[j] ** 2 - iou[i, j] ** 2)
+                                   * sigma)
+                    else:
+                        d = (1 - iou[i, j]) / (1 - iou_max[j])
+                    decay = min(decay, d)
+                ds = decay * s[perm[i]]
+                if ds > post_thr:
+                    picked.append((c, ds, perm[i]))
+        return picked
+
+    for gaussian in (False, True):
+        out, num = V.matrix_nms(
+            pt.to_tensor(bb), pt.to_tensor(sc), score_threshold=0.05,
+            post_threshold=0.1, nms_top_k=8, keep_top_k=20,
+            use_gaussian=gaussian, gaussian_sigma=2.0,
+            background_label=-1)
+        ref = np_matrix_nms(bb[0], sc[0], 0.05, 0.1, 8, gaussian, 2.0)
+        ref.sort(key=lambda r: -r[1])
+        ref = ref[:20]                       # keep_top_k
+        got = out.numpy()
+        assert int(num.numpy()[0]) == len(ref)
+        np.testing.assert_allclose(got[:, 1],
+                                   np.array([r[1] for r in ref]),
+                                   rtol=1e-5)
+        assert got[:, 0].astype(int).tolist() == [r[0] for r in ref]
+
+
+def test_multiclass_nms_per_class_greedy():
+    bb = (RNG.rand(1, 10, 4) * 50).astype(np.float32)
+    bb[..., 2:] += bb[..., :2] + 5
+    sc = RNG.rand(1, 2, 10).astype(np.float32)
+    out, num = V.multiclass_nms(pt.to_tensor(bb), pt.to_tensor(sc),
+                                score_threshold=0.2, nms_top_k=10,
+                                keep_top_k=20, nms_threshold=0.4)
+    ref = []
+    for c in range(2):
+        s = sc[0, c].copy()
+        s[s <= 0.2] = -np.inf
+        for i in _np_greedy_nms(bb[0], s, 0.4):
+            if s[i] > 0.2:
+                ref.append((c, s[i], i))
+    ref.sort(key=lambda r: -r[1])
+    assert int(num.numpy()[0]) == len(ref)
+    got = out.numpy()
+    np.testing.assert_allclose(got[:, 1], [r[1] for r in ref], rtol=1e-6)
+
+
+def test_generate_proposals_shapes_and_order():
+    scr = pt.to_tensor(RNG.rand(2, 3, 4, 4).astype(np.float32))
+    dl = pt.to_tensor(RNG.randn(2, 12, 4, 4).astype(np.float32) * 0.1)
+    anch = pt.to_tensor((RNG.rand(4, 4, 3, 4) * 64).astype(np.float32))
+    var = pt.to_tensor(np.full((4, 4, 3, 4), 0.1, np.float32))
+    rois, rs, rn = V.generate_proposals(
+        scr, dl, pt.to_tensor(np.array([[64, 64], [64, 64]], np.float32)),
+        anch, var, pre_nms_top_n=20, post_nms_top_n=8,
+        return_rois_num=True)
+    n = rn.numpy()
+    assert rois.shape[0] == int(n.sum()) and rois.shape[1] == 4
+    s = rs.numpy()
+    # per-image scores are NMS-pick-order = descending
+    ofs = 0
+    for c in n:
+        seg = s[ofs:ofs + c]
+        assert (np.diff(seg) <= 1e-6).all()
+        ofs += c
+
+
+def test_distribute_fpn_proposals_restore_roundtrip():
+    rois = (RNG.rand(12, 4) * np.array([20, 20, 300, 300])) \
+        .astype(np.float32)
+    rois[:, 2:] += rois[:, :2]
+    multi, restore = V.distribute_fpn_proposals(
+        pt.to_tensor(rois), 2, 5, 4, 224)
+    cat = np.concatenate([m.numpy() for m in multi if m.shape[0]])
+    r = restore.numpy()[:, 0]
+    np.testing.assert_allclose(cat[np.argsort(np.argsort(r))]
+                               if False else cat[r.argsort().argsort()]
+                               if False else cat, cat)
+    # restore index maps concatenated level order back to input order
+    np.testing.assert_allclose(cat[r], rois, rtol=1e-6)
+
+
+def test_psroi_pool_constant_channels():
+    """With input constant per channel, each output bin must equal its
+    group channel's constant."""
+    ph = pw = 2
+    out_c = 3
+    vals = np.arange(out_c * ph * pw, dtype=np.float32)
+    x = np.broadcast_to(vals[None, :, None, None],
+                        (1, out_c * ph * pw, 8, 8)).copy()
+    rois = np.array([[0, 0, 7, 7]], np.float32)
+    out = V.psroi_pool(pt.to_tensor(x), pt.to_tensor(rois),
+                       pt.to_tensor(np.array([1], np.int32)), 2).numpy()
+    expect = vals.reshape(out_c, ph, pw)
+    np.testing.assert_allclose(out[0], expect, rtol=1e-6)
+
+
+def test_deform_conv2d_zero_offset_is_conv_and_shift():
+    x = pt.to_tensor(RNG.randn(1, 3, 6, 6).astype(np.float32))
+    w = pt.to_tensor(RNG.randn(4, 3, 3, 3).astype(np.float32))
+    zero = pt.to_tensor(np.zeros((1, 18, 4, 4), np.float32))
+    o1 = V.deform_conv2d(x, zero, w).numpy()
+    o2 = F.conv2d(x, w).numpy()
+    np.testing.assert_allclose(o1, o2, rtol=1e-4, atol=1e-5)
+    # integer offset (+1, +1) on every tap == sampling the shifted window
+    off = np.zeros((1, 9, 2, 4, 4), np.float32)
+    off[:, :, 0] = 1.0   # dy
+    off[:, :, 1] = 1.0   # dx
+    o3 = V.deform_conv2d(x, pt.to_tensor(off.reshape(1, 18, 4, 4)),
+                         w).numpy()
+    o4 = F.conv2d(x, w).numpy()   # valid conv of x shifted by 1
+    np.testing.assert_allclose(o3[:, :, :3, :3], o4[:, :, 1:, 1:],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_deform_conv2d_mask_and_grad():
+    x = pt.to_tensor(RNG.randn(1, 2, 5, 5).astype(np.float32),
+                     stop_gradient=False)
+    w = pt.to_tensor(RNG.randn(3, 2, 3, 3).astype(np.float32),
+                     stop_gradient=False)
+    off = pt.to_tensor(RNG.randn(1, 18, 3, 3).astype(np.float32) * 0.2,
+                       stop_gradient=False)
+    msk = pt.to_tensor(np.full((1, 9, 3, 3), 0.5, np.float32))
+    out = V.deform_conv2d(x, off, w, mask=msk)
+    pt.ops.sum(out).backward()
+    for t in (x, w, off):
+        assert t.grad is not None and np.isfinite(t.grad.numpy()).all()
+    # mask=0.5 halves the zero-offset output
+    out_half = V.deform_conv2d(x, pt.to_tensor(
+        np.zeros((1, 18, 3, 3), np.float32)), w, mask=msk).numpy()
+    out_full = F.conv2d(x, w).numpy()
+    np.testing.assert_allclose(out_half, 0.5 * out_full, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_hsigmoid_custom_path():
+    """Custom path_table/path_code must override the default tree."""
+    x = RNG.randn(2, 4).astype(np.float32)
+    w = RNG.randn(5, 4).astype(np.float32)
+    ptab = np.array([[0, 2, -1], [1, 3, 4]], np.int64)
+    pcode = np.array([[1, 0, 0], [0, 1, 1]], np.float32)
+    lab = np.array([0, 1], np.int64)
+    ours = F.hsigmoid_loss(pt.to_tensor(x), pt.to_tensor(lab), 5,
+                           pt.to_tensor(w), path_table=pt.to_tensor(ptab),
+                           path_code=pt.to_tensor(pcode)).numpy()
+    ref = []
+    for n in range(2):
+        tot = 0.0
+        for j in range(3):
+            if ptab[n, j] < 0:
+                continue
+            z = w[ptab[n, j]] @ x[n]
+            tot += np.log1p(np.exp(z)) - pcode[n, j] * z
+        ref.append(tot)
+    np.testing.assert_allclose(ours[:, 0], ref, rtol=1e-5)
+
+
+def test_rnnt_loss_fastemit_scales_grad_not_value():
+    B, T, U, V_ = 1, 4, 2, 3
+    logits = RNG.randn(B, T, U + 1, V_).astype(np.float32)
+    lab = RNG.randint(1, V_, (B, U)).astype(np.int32)
+    il = np.array([T], np.int64)
+    ul = np.array([U], np.int64)
+    args = (pt.to_tensor(lab), pt.to_tensor(il), pt.to_tensor(ul))
+    l0 = float(F.rnnt_loss(pt.to_tensor(logits), *args,
+                           fastemit_lambda=0.0, reduction="sum"))
+    l1 = float(F.rnnt_loss(pt.to_tensor(logits), *args,
+                           fastemit_lambda=0.5, reduction="sum"))
+    np.testing.assert_allclose(l0, l1, rtol=1e-6)  # value preserved
+    g = []
+    for lam in (0.0, 0.5):
+        t = pt.to_tensor(logits, stop_gradient=False)
+        F.rnnt_loss(t, *args, fastemit_lambda=lam,
+                    reduction="sum").backward()
+        g.append(t.grad.numpy())
+    assert not np.allclose(g[0], g[1])  # gradient rescaled
+
+
+def test_yolo_box_iou_aware_leading_block():
+    """iou_aware stores the S ioup channels as a LEADING block: with
+    ioup logits = +inf (sigmoid 1), the result must equal the plain
+    decode of the remaining channels with conf**(1-factor)."""
+    s, cls = 2, 1
+    x_plain = RNG.randn(1, s * (5 + cls), 4, 4).astype(np.float32)
+    ioup = np.full((1, s, 4, 4), 40.0, np.float32)      # sigmoid -> 1
+    x_aware = np.concatenate([ioup, x_plain], axis=1)
+    img = pt.to_tensor(np.array([[128, 128]], np.int32))
+    anchors = [10, 13, 16, 30]
+    b0, s0 = V.yolo_box(pt.to_tensor(x_plain), img, anchors, cls, 0.0,
+                        32, clip_bbox=False)
+    b1, s1 = V.yolo_box(pt.to_tensor(x_aware), img, anchors, cls, 0.0,
+                        32, clip_bbox=False, iou_aware=True,
+                        iou_aware_factor=0.5)
+    np.testing.assert_allclose(b1.numpy(), b0.numpy(), rtol=1e-5)
+    # scores: conf^0.5 * 1^0.5 * cls  vs  conf * cls
+    conf = 1 / (1 + np.exp(-x_plain.reshape(1, s, 5 + cls, 4, 4)[:, :, 4]))
+    ratio = (s1.numpy() / np.maximum(s0.numpy(), 1e-9))
+    exp_ratio = (conf ** -0.5).transpose(0, 2, 3, 1).reshape(1, -1)[..., None]
+    np.testing.assert_allclose(ratio, exp_ratio, rtol=1e-4)
+
+
+def test_yolo_loss_compiles_to_static():
+    x = pt.to_tensor(RNG.randn(1, 14, 4, 4).astype(np.float32))
+    gtb = pt.to_tensor(RNG.rand(1, 3, 4).astype(np.float32) * 0.4 + 0.2)
+    gtl = pt.to_tensor(RNG.randint(0, 2, (1, 3)).astype(np.int32))
+
+    @pt.jit.to_static
+    def f(x, gtb, gtl):
+        return V.yolo_loss(x, gtb, gtl, anchors=[10, 13, 16, 30],
+                           anchor_mask=[0, 1], class_num=2,
+                           ignore_thresh=0.7, downsample_ratio=32)
+
+    eager = V.yolo_loss(x, gtb, gtl, anchors=[10, 13, 16, 30],
+                        anchor_mask=[0, 1], class_num=2,
+                        ignore_thresh=0.7, downsample_ratio=32)
+    np.testing.assert_allclose(f(x, gtb, gtl).numpy(), eager.numpy(),
+                               rtol=1e-5)

@@ -114,6 +114,28 @@ CLASS_COVERAGE = {
     "huber_loss": "nn.functional.huber_loss",
     "log_loss": "nn.functional.log_loss",
     "fused_adam_": "ops.pallas_kernels.fused_adamw.fused_adamw_update",
+    "yolo_box": "vision.ops.yolo_box",
+    "yolo_loss": "vision.ops.yolo_loss",
+    "generate_proposals": "vision.ops.generate_proposals",
+    "distribute_fpn_proposals": "vision.ops.distribute_fpn_proposals",
+    "matrix_nms": "vision.ops.matrix_nms",
+    "multiclass_nms3": "vision.ops.multiclass_nms",
+    "psroi_pool": "vision.ops.psroi_pool",
+    "deformable_conv": "vision.ops.deform_conv2d",
+    "warprnnt": "nn.functional.rnnt_loss",
+    "unpool3d": "nn.functional.max_unpool3d",
+    "average_accumulates_": "incubate.optimizer.ModelAverage",
+}
+
+# reference ops deliberately NOT implemented, with the architectural
+# reason — reported separately so `missing` stays an honest work list
+DESCOPED = {
+    "coalesce_tensor": "grad-buffer fusion feeding fused allreduce; XLA "
+                       "buffer assignment + SPMD collectives make the "
+                       "user-facing op surface meaningless on TPU",
+    "merge_selected_rows": "SelectedRows sparse-gradient container op; "
+                           "sparse grads lower to XLA scatter-add — no "
+                           "SelectedRows tensor variant exists here",
 }
 
 
@@ -479,7 +501,8 @@ def _explicit_smokes():
         "fold": lambda: F.fold(F.unfold(img, 3), [8, 8], 3),
         "gaussian": lambda: pt.ops.gaussian([2, 2]),
         "gather_tree": lambda: pt.ops.gather_tree(
-            t(np.zeros((2, 1, 2), np.int64)), t(np.zeros((1, 2), np.int64))),
+            t(np.zeros((2, 1, 2), np.int64)),
+            t(np.zeros((2, 1, 2), np.int64))),
         "flash_attn_unpadded": lambda: F.flash_attn_unpadded(
             t(rng.randn(8, 2, 4).astype(np.float32)),
             t(rng.randn(8, 2, 4).astype(np.float32)),
@@ -529,6 +552,68 @@ def _explicit_smokes():
             t(rng.randn(4, 2).astype(np.float32)),
             t(rng.randn(4, 2).astype(np.float32)),
             t(np.array([0, 1], np.int64)), t(np.array([1, 2], np.int64))),
+        "yolo_box": lambda: pt.vision.ops.yolo_box(
+            t(rng.randn(1, 14, 4, 4).astype(np.float32)),
+            t(np.array([[128, 128]], np.int32)),
+            anchors=[10, 13, 16, 30], class_num=2, conf_thresh=0.01,
+            downsample_ratio=32),
+        "yolo_loss": lambda: pt.vision.ops.yolo_loss(
+            t(rng.randn(1, 14, 4, 4).astype(np.float32)),
+            t(rng.rand(1, 3, 4).astype(np.float32) * 0.5 + 0.2),
+            t(rng.randint(0, 2, (1, 3)).astype(np.int32)),
+            anchors=[10, 13, 16, 30], anchor_mask=[0, 1], class_num=2,
+            ignore_thresh=0.7, downsample_ratio=32),
+        "generate_proposals": lambda: pt.vision.ops.generate_proposals(
+            t(rng.rand(1, 3, 4, 4).astype(np.float32)),
+            t(rng.randn(1, 12, 4, 4).astype(np.float32) * 0.1),
+            t(np.array([[64, 64]], np.float32)),
+            t((rng.rand(4, 4, 3, 4) * 64).astype(np.float32)),
+            t(np.ones((4, 4, 3, 4), np.float32) * 0.1),
+            pre_nms_top_n=10, post_nms_top_n=5),
+        "distribute_fpn_proposals":
+            lambda: pt.vision.ops.distribute_fpn_proposals(
+                t((rng.rand(6, 4) * np.array([10, 10, 200, 200]))
+                  .astype(np.float32)), 2, 5, 4, 224),
+        "matrix_nms": lambda: pt.vision.ops.matrix_nms(
+            t(rng.rand(1, 6, 4).astype(np.float32)),
+            t(rng.rand(1, 2, 6).astype(np.float32)),
+            score_threshold=0.1, post_threshold=0.1, nms_top_k=4,
+            keep_top_k=4),
+        "multiclass_nms": lambda: pt.vision.ops.multiclass_nms(
+            t(rng.rand(1, 6, 4).astype(np.float32)),
+            t(rng.rand(1, 2, 6).astype(np.float32)),
+            score_threshold=0.1, nms_top_k=4, keep_top_k=4),
+        "psroi_pool": lambda: pt.vision.ops.psroi_pool(
+            t(rng.randn(1, 8, 8, 8).astype(np.float32)),
+            t(np.array([[0, 0, 4, 4]], np.float32)),
+            t(np.array([1], np.int32)), 2),
+        "deform_conv2d": lambda: pt.vision.ops.deform_conv2d(
+            t(rng.randn(1, 3, 6, 6).astype(np.float32)),
+            t(np.zeros((1, 18, 4, 4), np.float32)),
+            t(rng.randn(4, 3, 3, 3).astype(np.float32))),
+        "rnnt_loss": lambda: F.rnnt_loss(
+            t(rng.randn(1, 4, 3, 4).astype(np.float32)),
+            t(rng.randint(1, 4, (1, 2)).astype(np.int32)),
+            t(np.array([4], np.int64)), t(np.array([2], np.int64))),
+        "hsigmoid_loss": lambda: F.hsigmoid_loss(
+            t(rng.randn(3, 4).astype(np.float32)),
+            t(rng.randint(0, 6, (3,)).astype(np.int64)), 6,
+            t(rng.randn(5, 4).astype(np.float32))),
+        "class_center_sample": lambda: F.class_center_sample(
+            t(np.array([1, 3], np.int64)), 10, 4),
+        "max_unpool3d": lambda: F.max_unpool3d(
+            *F.max_pool3d(t(rng.randn(1, 2, 4, 4, 4).astype(np.float32)),
+                          2, return_mask=True), kernel_size=2),
+        "reindex_graph": lambda: pt.geometric.reindex_graph(
+            t(np.array([0, 1], np.int64)),
+            t(np.array([3, 0, 2], np.int64)),
+            t(np.array([2, 1], np.int32))),
+        "weighted_sample_neighbors":
+            lambda: pt.geometric.weighted_sample_neighbors(
+                t(np.array([1, 2, 0], np.int64)),
+                t(np.array([0, 2, 3, 3], np.int64)),
+                t(np.array([0.5, 0.2, 0.9], np.float32)),
+                t(np.array([0, 1], np.int64)), sample_size=1),
     }
 
 
@@ -543,6 +628,7 @@ def smoke_covered(covered):
     """
     explicit = _explicit_smokes()
     executed, static_ok, stubs, unresolved = [], [], [], []
+    broken = {}
     for op, target in sorted(covered.items()):
         # fresh fixtures per op: in-place ops (fill_, increment, ...)
         # mutate their inputs, and a shared fixture would leak that
@@ -572,8 +658,14 @@ def smoke_covered(covered):
             except NotImplementedError:
                 stubs.append(op)
                 continue
-            except Exception:
-                pass
+            except Exception as exc:
+                # the dedicated fixture is the contract for this op: a
+                # crash means either the op or its smoke regressed, and
+                # silently falling back to generic attempts would let a
+                # broken op keep counting as covered
+                broken[op] = (f"{type(exc).__name__}: "
+                              f"{str(exc)[:100]}")
+                continue
         if not ran:
             for args in attempts:
                 try:
@@ -589,7 +681,7 @@ def smoke_covered(covered):
         if ran is None:
             continue
         (executed if ran else static_ok).append(op)
-    return executed, static_ok, stubs, unresolved
+    return executed, static_ok, stubs, unresolved, broken
 
 
 def classify(ref_ops, ours):
@@ -624,18 +716,23 @@ def main():
     # covered if it EXECUTES on tiny CPU inputs (or is a source-verified
     # real body when no generic signature fits); NotImplementedError
     # stubs are failed into the missing list
-    executed, static_ok, stubs, unresolved = smoke_covered(covered)
+    executed, static_ok, stubs, unresolved, broken = smoke_covered(covered)
     for op in stubs:
         covered.pop(op, None)
         missing.append(op + " (stub: raises NotImplementedError)")
     for op in unresolved:
         covered.pop(op, None)
         missing.append(op + " (unresolvable covered_map target)")
-    missing = sorted(missing)
+    for op, why in broken.items():
+        covered.pop(op, None)
+        missing.append(f"{op} (smoke failed: {why})")
+    descoped = {op: why for op, why in DESCOPED.items() if op in missing}
+    missing = sorted(m for m in missing if m not in descoped)
     doc = {
         "reference_manifest_ops": len(ref_ops),
         "covered": len(covered),
         "coverage_pct": round(100.0 * len(covered) / max(len(ref_ops), 1), 1),
+        "descoped": descoped,
         "covered_executed": len(executed),
         "covered_static_only": len(static_ok),
         "static_only_ops": static_ok,
