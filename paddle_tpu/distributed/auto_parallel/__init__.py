@@ -8,3 +8,7 @@ from .converter import (  # noqa: F401
 from .engine import Engine  # noqa: F401
 from .process_mesh import ProcessMesh  # noqa: F401
 from .strategy import Strategy  # noqa: F401
+from .propagation import (  # noqa: F401
+    DistSpec, PropagationResult, apply_propagation, capture_jaxpr,
+    graph_cost, propagate_jaxpr,
+)

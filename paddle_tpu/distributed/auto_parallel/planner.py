@@ -154,36 +154,87 @@ def plan(model: ModelSpec, cluster: ClusterSpec) -> List[Candidate]:
     return sorted(cands, key=lambda c: (not c.feasible, c.step_time))
 
 
-def apply_placement_rules(model, mesh_axes: Dict[str, int]) -> int:
-    """Megatron-style parameter placement for the chosen mesh (the analog
-    of the reference's dist_matmul/dist_embedding rules applied by the
-    Completer): embeddings vocab-parallel, linear weights alternately
-    column/row parallel over 'mp'.  Returns the number of params sharded."""
-    from ...nn.modules.common import Embedding, Linear
-    from ...ops.sharding_ops import shard_param
-    from .. import mesh as _mesh
+def _score_measured(fwd_flops: float, act_bytes: float, param_bytes: float,
+                    c: ClusterSpec, dp: int, mp: int, pp: int,
+                    comm_bytes: float = 0.0) -> Candidate:
+    """Generic roofline over MEASURED graph numbers (propagation.
+    graph_cost) — the non-transformer path: no hidden/layers/vocab
+    inference, just FLOPs, activation bytes and parameter bytes read off
+    the captured equations."""
+    mesh = {"dp": dp, "mp": mp, "pp": pp}
+    n = dp * mp * pp
+    # fwd measured; bwd ~ 2x fwd
+    compute = 3.0 * fwd_flops / (n * c.flops * c.mfu)
+    # optimizer state: p + g + 2 moments (fp32-ish) per shard
+    state = param_bytes * 4.0 / (mp * pp)
+    act = act_bytes / (dp * mp)
+    mem = state + act
+    feasible = mem < 0.9 * c.hbm_bytes
+    reason = "" if feasible else (
+        f"per-device residency {mem/1e9:.1f} GB > 90% HBM")
+    # measured reshard bytes from the propagation pass ride the ICI too
+    tp_comm = ((2.0 * act_bytes / dp * (mp - 1) / mp + comm_bytes / dp)
+               / c.ici_bw if mp > 1 else 0.0)
+    dp_comm = (0.5 * 2.0 * param_bytes / (mp * pp) * (dp - 1) / dp
+               / c.ici_bw if dp > 1 else 0.0)
+    bubble = (pp - 1) / 4.0 if pp > 1 else 0.0
+    step_time = (compute + tp_comm) * (1 + bubble) + dp_comm
+    return Candidate(mesh=mesh, step_time=step_time, compute_time=compute,
+                     tp_comm_time=tp_comm, dp_comm_time=dp_comm,
+                     bubble_frac=bubble, mem_bytes=mem, feasible=feasible,
+                     reason=reason)
 
-    if not _mesh.has_mesh() or mesh_axes.get("mp", 1) <= 1:
-        return 0
-    mp = mesh_axes["mp"]
-    count = 0
+
+def plan_measured(fwd_flops: float, act_bytes: float, param_bytes: float,
+                  cluster: ClusterSpec,
+                  comm_bytes: float = 0.0) -> List[Candidate]:
+    """Rank factorizations for an arbitrary captured graph."""
+    cands = [_score_measured(fwd_flops, act_bytes, param_bytes, cluster,
+                             dp, mp, pp, comm_bytes)
+             for dp, mp, pp in _factorizations(cluster.n_devices)]
+    return sorted(cands, key=lambda c: (not c.feasible, c.step_time))
+
+
+def placement_decisions(model, mp: int):
+    """Yield (param, per-dim axis tuple) Megatron placement decisions —
+    the ONE source of truth consumed by both apply_placement_rules
+    (installs shardings on parameters) and Engine._param_specs (feeds
+    the propagation pass): embeddings vocab-parallel, linear weights
+    alternately column/row parallel over 'mp'."""
+    from ...nn.modules.common import Embedding, Linear
+
+    if mp <= 1:
+        return
     col_next = True
     for layer in model.sublayers(include_self=True):
         if isinstance(layer, Embedding):
             w = layer.weight
             if w.shape[0] % mp == 0:
-                shard_param(w, "mp", None)      # vocab-parallel rows
-                count += 1
+                yield w, ("mp",) + (None,) * (len(w.shape) - 1)
         elif isinstance(layer, Linear):
             w = layer.weight                      # [in, out]
             if col_next and w.shape[1] % mp == 0:
-                shard_param(w, None, "mp")      # column parallel
+                yield w, (None, "mp")             # column parallel
                 b = getattr(layer, "bias", None)
                 if b is not None and b.shape[0] % mp == 0:
-                    shard_param(b, "mp")
-                count += 1
+                    yield b, ("mp",)
             elif (not col_next) and w.shape[0] % mp == 0:
-                shard_param(w, "mp", None)      # row parallel
-                count += 1
+                yield w, ("mp", None)             # row parallel
             col_next = not col_next
+
+
+def apply_placement_rules(model, mesh_axes: Dict[str, int]) -> int:
+    """Install the placement_decisions shardings on the model's
+    parameters (the analog of the reference's dist_matmul/dist_embedding
+    rules applied by the Completer).  Returns the number of params
+    sharded."""
+    from ...ops.sharding_ops import shard_param
+    from .. import mesh as _mesh
+
+    if not _mesh.has_mesh() or mesh_axes.get("mp", 1) <= 1:
+        return 0
+    count = 0
+    for p, dims in placement_decisions(model, mesh_axes["mp"]):
+        shard_param(p, *dims)
+        count += 1
     return count
