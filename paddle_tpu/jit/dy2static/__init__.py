@@ -29,6 +29,7 @@ import textwrap
 from typing import List, Optional, Set, Tuple
 
 import jax
+import numpy as np
 
 from ...tensor import Tensor
 
@@ -109,6 +110,79 @@ def unsupported(lineno, reason):
         f"cannot be functionalized: {reason}. Restructure with "
         "paddle_tpu.static.nn.cond / while_loop, or keep the predicate "
         "un-traced.")
+
+
+# -- for-loop helpers (reference jit/dy2static/loop_transformer.py:
+#    For -> While conversion over range/iterable forms) -------------------
+
+_builtin_range = range
+
+
+def normalize_range(args):
+    """range(stop) / range(start, stop[, step]) -> (start, stop, step);
+    each may be a python int or a (possibly traced) scalar Tensor."""
+    if len(args) == 1:
+        out = (0, args[0], 1)
+    elif len(args) == 2:
+        out = (args[0], args[1], 1)
+    else:
+        out = (args[0], args[1], args[2])
+    step = out[2]
+    if isinstance(step, Tensor) and not _is_traced_pred(step):
+        step = int(np.asarray(step._value))
+    if isinstance(step, int) and step == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    return out
+
+
+def seed_target(getter, start, step):
+    """Initial carry for the loop variable: its PRIOR binding when one
+    exists (python leaves the target untouched on a zero-trip range),
+    else the would-be first value.  The prior value is cast to the loop
+    value's dtype — lax.while_loop requires a type-stable carry."""
+    import jax.numpy as jnp
+
+    first = range_value(start, step, 0)
+    v = _get(getter)
+    if v is _MISSING:
+        return first
+    return Tensor(jnp.asarray(_raw(v)).astype(first._value.dtype))
+
+
+def any_traced(*vals) -> bool:
+    return any(_is_traced_pred(v) if isinstance(v, Tensor) else False
+               for v in vals)
+
+
+def _raw(v):
+    return v._value if isinstance(v, Tensor) else v
+
+
+def range_trip_count(start, stop, step):
+    """Trip count of range(start, stop, step) as a device value:
+    max(0, ceil((stop-start)/step)) via the floor-div identity
+    ceil(a/b) == -((-a)//b) (works for negative steps too)."""
+    import jax.numpy as jnp
+
+    s, e, st = _raw(start), _raw(stop), _raw(step)
+    n = -((s - e) // st)
+    return Tensor(jnp.maximum(jnp.asarray(n), 0))
+
+
+def range_value(start, step, i):
+    """The loop variable's value at iteration i (traced arithmetic)."""
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(_raw(start)) + jnp.asarray(_raw(i))
+                  * jnp.asarray(_raw(step)))
+
+
+def int_tensor(v: int) -> Tensor:
+    # default integer dtype (int64 under the repo's x64 regime) so the
+    # counter, range_value and seed_target carries all agree
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(v))
 
 
 # -- AST analysis ----------------------------------------------------------
@@ -413,6 +487,112 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.changed = True
         return ast.parse(block).body
 
+    def visit_For(self, node: ast.For):
+        """For -> bounded-while conversion (reference
+        loop_transformer.py For handling).  Two rewritten shapes:
+
+        - ``for v in range(...)`` with a TRACED bound: the counter/value
+          arithmetic moves into the while machinery (lax-compatible);
+          python bounds keep the original python loop.
+        - ``for v in <tensor>``: iterate indices pythonly (the length is
+          static under tracing, so the unrolled loop is a valid trace).
+
+        Anything else (python iterables) is left untouched."""
+        self.generic_visit(node)
+        n = self._n()
+        lineno = getattr(node, "lineno", 0)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords
+                    and 1 <= len(node.iter.args) <= 3
+                    and not any(isinstance(a, ast.Starred)
+                                for a in node.iter.args))
+        py_arm = (f"for {ast.unparse(node.target)} in __pt_itv{n}:\n"
+                  f"{_src(node.body, '    ')}\n"
+                  + (f"else:\n{_src(node.orelse, '    ')}"
+                     if node.orelse else ""))
+
+        if not is_range:
+            # non-range iterables (incl. Tensors, which iterate via
+            # Tensor.__iter__ with a static length) keep native python
+            # control flow — a valid trace
+            return node
+
+        reason = None
+        if not isinstance(node.target, ast.Name):
+            reason = ("the loop target unpacks a tuple (use a single "
+                      "name over range)")
+        elif node.orelse:
+            reason = "for/else is not supported for tensor bounds"
+        elif _has_node(node.body, (ast.Break, ast.Continue),
+                       stop_at_loops=True):
+            reason = "break/continue in a tensor-bound for loop"
+        elif _has_node(node.body, (ast.Return,)):
+            reason = "return inside a tensor-bound for loop"
+        elif _non_name_bindings(node.body):
+            reason = ("the loop body assigns to an attribute/subscript "
+                      "(python-object mutation)")
+
+        args_src = ", ".join(ast.unparse(a) for a in node.iter.args)
+        # `range` may be shadowed by a user function: capture whatever
+        # the name resolves to and only engage the machinery for the
+        # builtin (a shadowed range keeps its original call + python for)
+        shadow_guard = (
+            f"__pt_rng{n} = range\n"
+            f"if __pt_rng{n} is not __pt_d2s._builtin_range:\n"
+            f"    __pt_itv{n} = __pt_rng{n}({args_src})\n"
+            + textwrap.indent(py_arm, "    ") + "\n"
+            f"else:\n"
+        )
+        if reason is not None:
+            inner = (
+                f"__pt_ra{n} = ({args_src},)\n"
+                f"__pt_s{n}, __pt_e{n}, __pt_st{n} = "
+                f"__pt_d2s.normalize_range(__pt_ra{n})\n"
+                f"if __pt_d2s.any_traced(__pt_s{n}, __pt_e{n}, "
+                f"__pt_st{n}):\n"
+                f"    __pt_d2s.unsupported({lineno}, {reason!r})\n"
+                f"__pt_itv{n} = range(__pt_s{n}, __pt_e{n}, __pt_st{n})\n"
+                + py_arm
+            )
+            self.changed = True
+            return ast.parse(shadow_guard
+                             + textwrap.indent(inner, "    ")).body
+
+        tgt = node.target.id
+        assigned = sorted(_assigned_names(node.body) - {tgt})
+        vars_sig = ", ".join([f"__pt_i{n}", tgt] + assigned)
+        inits = ", ".join(
+            [f"__pt_d2s.int_tensor(0)",
+             f"__pt_d2s.seed_target(lambda: {tgt}, __pt_s{n}, __pt_st{n})"]
+            + [f"__pt_d2s._get(lambda: {v})" for v in assigned])
+        ret_vars = ", ".join([f"__pt_i{n} + 1", tgt] + assigned)
+        out_vars = ", ".join([f"__pt_i{n}", tgt] + assigned)
+        inner = (
+            f"__pt_ra{n} = ({args_src},)\n"
+            f"__pt_s{n}, __pt_e{n}, __pt_st{n} = "
+            f"__pt_d2s.normalize_range(__pt_ra{n})\n"
+            f"if __pt_d2s.any_traced(__pt_s{n}, __pt_e{n}, __pt_st{n}):\n"
+            f"    __pt_n{n} = __pt_d2s.range_trip_count("
+            f"__pt_s{n}, __pt_e{n}, __pt_st{n})\n"
+            f"    def __pt_fc{n}({vars_sig}):\n"
+            f"        return __pt_i{n} < __pt_n{n}\n"
+            f"    def __pt_fb{n}({vars_sig}):\n"
+            f"        {tgt} = __pt_d2s.range_value("
+            f"__pt_s{n}, __pt_st{n}, __pt_i{n})\n"
+            f"{_src(node.body, '        ')}\n"
+            f"        return ({ret_vars},)\n"
+            f"    ({out_vars},) = __pt_d2s.run_while("
+            f"__pt_fc{n}, __pt_fb{n}, ({inits},), "
+            f"max_iter=__pt_d2s.DEFAULT_MAX_ITER)\n"
+            f"else:\n"
+            f"    __pt_itv{n} = range(__pt_s{n}, __pt_e{n}, __pt_st{n})\n"
+            + textwrap.indent(py_arm, "    ")
+        )
+        self.changed = True
+        return ast.parse(shadow_guard + textwrap.indent(inner, "    ")).body
+
     def visit_While(self, node: ast.While):
         self.generic_visit(node)
         n = self._n()
@@ -517,7 +697,8 @@ def convert_to_static(fn):
     fdef = tree.body[0]
     if not isinstance(fdef, ast.FunctionDef):
         return fn
-    if not any(isinstance(n, (ast.If, ast.While)) for n in ast.walk(fdef)):
+    if not any(isinstance(n, (ast.If, ast.While, ast.For))
+               for n in ast.walk(fdef)):
         return fn
     if any(isinstance(n, (ast.Global, ast.Nonlocal)) for n in ast.walk(fdef)):
         return fn  # name-scope rewrites would break global/nonlocal decls
