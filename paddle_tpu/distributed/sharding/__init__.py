@@ -45,15 +45,52 @@ def _pick_axis():
     return None
 
 
-def _shard_spec_for(value, axis):
-    """Shard along the first dim divisible by the axis size; else replicate."""
+def _current_spec(value):
+    sh = getattr(value, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        return tuple(sh.spec)
+    return ()
+
+
+def _composed_spec(shape, cur, axis):
+    """COMPOSE the ZeRO axis onto an existing layout instead of
+    replacing it (round-5 fix: on a hybrid dp x sp x mp mesh the old
+    first-divisible-dim rule silently DROPPED the model's mp/pp
+    shardings, making stage 3 grow per-device residency).  Prefers the
+    first unsharded divisible dim; else nests onto an already-sharded
+    dim whose size divides by the combined factor; else leaves the
+    layout unchanged (replicated over the ZeRO axis)."""
+    import numpy as _np
+
     n = _mesh.axis_size(axis)
-    if n <= 1:
-        return PartitionSpec()
-    for d, s in enumerate(value.shape):
-        if s % n == 0 and s >= n:
-            return PartitionSpec(*([None] * d + [axis]))
-    return PartitionSpec()
+    spec = list(cur) + [None] * (len(shape) - len(cur))
+    used = set()
+    for e in spec:
+        if e is None:
+            continue
+        used.update(e if isinstance(e, (tuple, list)) else (e,))
+    if axis in used or n <= 1:
+        return PartitionSpec(*spec)
+    for d, s in enumerate(shape):
+        if spec[d] is None and s % n == 0 and s >= n:
+            spec[d] = axis
+            return PartitionSpec(*spec)
+    mesh = _mesh.get_mesh()
+    for d, s in enumerate(shape):
+        if spec[d] is not None:
+            axes = (list(spec[d]) if isinstance(spec[d], (tuple, list))
+                    else [spec[d]])
+            combined = n * int(_np.prod([mesh.shape[a] for a in axes]))
+            if s % combined == 0:
+                spec[d] = tuple(axes + [axis])
+                return PartitionSpec(*spec)
+    return PartitionSpec(*spec)
+
+
+def _shard_spec_for(value, axis):
+    """ZeRO layout for ``value``: its existing spec with ``axis``
+    composed in."""
+    return _composed_spec(value.shape, _current_spec(value), axis)
 
 
 def _apply_sharding(t, axis):
@@ -63,17 +100,17 @@ def _apply_sharding(t, axis):
     return t
 
 
-def _grad_reshard_hook(axis):
-    """Tensor grad hook: constrain the incoming grad to the sharded layout
-    (stage 2's reduce-scatter; runs inside the traced backward too)."""
+def _grad_reshard_hook(axis, target_spec):
+    """Tensor grad hook: constrain the incoming grad to the sharded
+    layout (stage 2's reduce-scatter; runs inside the traced backward
+    too).  The target spec is computed at SETUP time from the param's
+    layout — a traced grad has no readable sharding."""
     from ...ops.sharding_ops import shard_constraint
-    from ...tensor import Tensor
 
     def hook(g: "Tensor"):
-        spec = _shard_spec_for(g._value, axis)
-        if not len(spec):
+        if not len(target_spec):
             return g
-        return shard_constraint(g, *spec)
+        return shard_constraint(g, *target_spec)
 
     return hook
 
@@ -105,10 +142,11 @@ def group_sharded_parallel(model: Layer, optimizer: Optimizer, level: str,
 
     if level in ("os_g", "p_g_os"):
         # stage 2: gradients reduce-scattered into the sharded layout
-        hook = _grad_reshard_hook(axis)
+        # (the param's layout + the ZeRO axis, fixed at setup)
         for p in model.parameters():
             if not p.stop_gradient:
-                p.register_hook(hook)
+                spec = tuple(_shard_spec_for(p._value, axis))
+                p.register_hook(_grad_reshard_hook(axis, spec))
 
     if level == "p_g_os":
         # stage 3: shard parameters too; XLA all-gathers around use

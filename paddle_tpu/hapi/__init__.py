@@ -1,3 +1,6 @@
 """High-level API (reference: python/paddle/hapi/)."""
 from .model import Model  # noqa: F401
-from .callbacks import Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger,
+    ReduceLROnPlateau,
+)

@@ -104,3 +104,59 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait >= self.patience:
                 self.stop_training = True
+
+
+class ReduceLROnPlateau(Callback):
+    """Multiply the optimizer's lr by ``factor`` after ``patience``
+    evaluations without ``monitor`` improving (reference
+    hapi/callbacks.py ReduceLROnPlateau; the scheduler-object form lives
+    in optimizer.lr.ReduceOnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        # auto mode: accuracy-like monitors maximize, losses minimize
+        # (reference callbacks.py ReduceLROnPlateau mode inference)
+        if mode == "auto":
+            mode = ("max" if any(k in monitor for k in ("acc", "auc"))
+                    else "min")
+        self.mode = mode
+        self.best = -np.inf if mode == "max" else np.inf
+        self.wait = 0
+        self._cool = 0
+
+    def _improved(self, cur):
+        if self.mode == "max":
+            return cur > self.best + self.min_delta
+        return cur < self.best - self.min_delta
+
+    def on_eval_end(self, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        if self._cool > 0:
+            self._cool -= 1
+        if self._improved(cur):
+            self.best = cur
+            self.wait = 0
+            return
+        if self._cool > 0:
+            return
+        self.wait += 1
+        if self.wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None and hasattr(opt, "get_lr"):
+                new_lr = max(float(opt.get_lr()) * self.factor,
+                             self.min_lr)
+                opt.set_lr(new_lr)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr -> {new_lr:.2e}")
+            self.wait = 0
+            self._cool = self.cooldown

@@ -42,15 +42,52 @@ class Model:
         self._loss = loss
         self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
             [metrics] if metrics else [])
+        # amp_configs: "O1"/"O2" or {"level": ..., "dtype": ...}
+        # (reference hapi/model.py _check_amp_configs)
+        if isinstance(amp_configs, str):
+            amp_configs = {"level": amp_configs}
         self._amp = amp_configs or None
 
         net, opt, loss_fn = self.network, optimizer, loss
 
+        def _shard_batch(inputs, labels):
+            # distributed-aware fit: with a mesh carrying a dp axis > 1,
+            # pin the batch dim so GSPMD data-parallelizes the compiled
+            # step (the reference integrates fleet into fit)
+            from ..distributed import mesh as _mesh
+
+            if _mesh.has_mesh():
+                mesh = _mesh.get_mesh()
+                if "dp" in mesh.axis_names and mesh.shape["dp"] > 1:
+                    from ..ops.sharding_ops import shard_constraint
+
+                    def dp0(t):
+                        # spec rank must match the tensor rank (1-D
+                        # class labels included)
+                        spec = ("dp",) + (None,) * (t.ndim - 1)
+                        return shard_constraint(t, *spec)
+
+                    inputs = tuple(dp0(t) for t in inputs)
+                    labels = tuple(dp0(t) for t in labels)
+            return inputs, labels
+
+        def _forward_loss(inputs, labels):
+            if self._amp:
+                from ..amp.auto_cast import auto_cast
+
+                with auto_cast(enable=True,
+                               level=self._amp.get("level", "O1"),
+                               dtype=self._amp.get("dtype", "bfloat16")):
+                    out = net(*inputs)
+                    l = loss_fn(out, *labels) if loss_fn else out
+                return out, l
+            out = net(*inputs)
+            return out, (loss_fn(out, *labels) if loss_fn else out)
+
         def train_step(*batch):
             n_in = 1 if self._labels_spec is None else len(batch) - len(self._labels_spec)
-            inputs, labels = batch[:n_in], batch[n_in:]
-            out = net(*inputs)
-            l = loss_fn(out, *labels) if loss_fn else out
+            inputs, labels = _shard_batch(batch[:n_in], batch[n_in:])
+            out, l = _forward_loss(inputs, labels)
             l.backward()
             opt.step()
             opt.clear_grad()
@@ -60,8 +97,7 @@ class Model:
             n_in = 1 if self._labels_spec is None else len(batch) - len(self._labels_spec)
             inputs, labels = batch[:n_in], batch[n_in:]
             with _ops.no_grad():
-                out = net(*inputs)
-                l = loss_fn(out, *labels) if loss_fn else out
+                out, l = _forward_loss(inputs, labels)
             return l, out
 
         self._train_step = to_static(train_step) if optimizer else None
@@ -122,13 +158,27 @@ class Model:
                  num_workers=0, callbacks=None, num_samples=None):
         was_training = getattr(self.network, "training", True)
         self.network.eval()
+        for m in self._metrics:
+            m.reset()
         losses = []
         for batch in eval_data:
-            l, out = self._eval_step(*_to_tensors(batch))
+            tensors = _to_tensors(batch)
+            l, out = self._eval_step(*tensors)
             losses.append(float(l))
+            # metric protocol (reference metric/metrics.py):
+            # update(*compute(pred, *labels)) — compute may return a
+            # tuple (the base class passes through) or a single value
+            n_in = (1 if self._labels_spec is None
+                    else len(tensors) - len(self._labels_spec))
+            labels = tensors[n_in:]
+            for m in self._metrics:
+                r = m.compute(out, *labels)
+                m.update(*r) if isinstance(r, tuple) else m.update(r)
         if was_training:
             self.network.train()
         res = {"eval_loss": float(np.mean(losses)) if losses else float("nan")}
+        for m in self._metrics:
+            res[f"eval_{m.name()}"] = m.accumulate()
         return res
 
     def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,

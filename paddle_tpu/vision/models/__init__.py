@@ -1,4 +1,18 @@
-from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152, wide_resnet50_2, resnext50_32x4d  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    wide_resnet50_2, wide_resnet101_2, resnext50_32x4d, resnext50_64x4d,
+    resnext101_32x4d, resnext101_64x4d, resnext152_32x4d,
+    resnext152_64x4d,
+)
 from .lenet import LeNet  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
+from .extra import (  # noqa: F401
+    AlexNet, alexnet, SqueezeNet, squeezenet1_0, squeezenet1_1,
+    DenseNet, densenet121, densenet161, densenet169, densenet201,
+    densenet264, ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+    shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0, shufflenet_v2_swish, MobileNetV1, mobilenet_v1,
+    MobileNetV3Small, MobileNetV3Large, mobilenet_v3_small,
+    mobilenet_v3_large, GoogLeNet, googlenet, InceptionV3, inception_v3,
+)

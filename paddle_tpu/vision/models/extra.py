@@ -1,0 +1,624 @@
+"""Remaining torchvision-era model families (reference:
+python/paddle/vision/models/{alexnet,squeezenet,densenet,googlenet,
+inceptionv3,shufflenetv2,mobilenetv1,mobilenetv3}.py) — same
+architectures over the TPU-native layer set.  ``pretrained=True`` is
+rejected everywhere (no weight hosting in this environment), matching
+the other families."""
+from __future__ import annotations
+
+from ... import ops
+from ...nn import (
+    AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D, Dropout, Hardsigmoid,
+    Hardswish, Layer, Linear, MaxPool2D, ReLU, Sequential, Sigmoid,
+)
+
+__all__ = [
+    "AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+    "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "densenet264", "ShuffleNetV2", "shufflenet_v2_x0_25",
+    "shufflenet_v2_x0_33", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+    "shufflenet_v2_x1_5", "shufflenet_v2_x2_0", "shufflenet_v2_swish",
+    "MobileNetV1", "mobilenet_v1", "MobileNetV3Small", "MobileNetV3Large",
+    "mobilenet_v3_small", "mobilenet_v3_large", "GoogLeNet", "googlenet",
+    "InceptionV3", "inception_v3",
+]
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise NotImplementedError("no pretrained weights in this environment")
+
+
+def _conv_bn(cin, cout, k, stride=1, padding=0, groups=1, act=ReLU):
+    layers = [Conv2D(cin, cout, k, stride=stride, padding=padding,
+                     groups=groups, bias_attr=False), BatchNorm2D(cout)]
+    if act is not None:
+        layers.append(act())
+    return Sequential(*layers)
+
+
+# ---------------------------------------------------------------------------
+# AlexNet (reference models/alexnet.py)
+# ---------------------------------------------------------------------------
+
+class AlexNet(Layer):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(),
+            MaxPool2D(3, 2),
+            Conv2D(64, 192, 5, padding=2), ReLU(),
+            MaxPool2D(3, 2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(),
+            MaxPool2D(3, 2),
+        )
+        self.avgpool = AdaptiveAvgPool2D((6, 6))
+        self.classifier = Sequential(
+            Dropout(0.5), Linear(256 * 36, 4096), ReLU(),
+            Dropout(0.5), Linear(4096, 4096), ReLU(),
+            Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(ops.flatten(x, 1))
+
+
+def alexnet(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return AlexNet(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet (reference models/squeezenet.py)
+# ---------------------------------------------------------------------------
+
+class _Fire(Layer):
+    def __init__(self, cin, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Sequential(Conv2D(cin, squeeze, 1), ReLU())
+        self.e1 = Sequential(Conv2D(squeeze, e1, 1), ReLU())
+        self.e3 = Sequential(Conv2D(squeeze, e3, 3, padding=1), ReLU())
+
+    def forward(self, x):
+        s = self.squeeze(x)
+        return ops.concat([self.e1(s), self.e3(s)], axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                MaxPool2D(3, 2), _Fire(512, 64, 256, 256),
+            )
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            )
+        self.classifier = Sequential(
+            Dropout(0.5), Conv2D(512, num_classes, 1), ReLU())
+        self.pool = AdaptiveAvgPool2D((1, 1))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        if self.with_pool:
+            x = self.pool(x)
+        return ops.flatten(x, 1)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    _no_pretrained(pretrained)
+    return SqueezeNet("1.1", **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet (reference models/densenet.py)
+# ---------------------------------------------------------------------------
+
+class _DenseLayer(Layer):
+    def __init__(self, cin, growth, bn_size):
+        super().__init__()
+        self.bn1 = BatchNorm2D(cin)
+        self.relu = ReLU()
+        self.conv1 = Conv2D(cin, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth)
+        self.conv2 = Conv2D(bn_size * growth, growth, 3, padding=1,
+                            bias_attr=False)
+
+    def forward(self, x):
+        y = self.conv1(self.relu(self.bn1(x)))
+        y = self.conv2(self.relu(self.bn2(y)))
+        return ops.concat([x, y], axis=1)
+
+
+class _Transition(Layer):
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.bn = BatchNorm2D(cin)
+        self.relu = ReLU()
+        self.conv = Conv2D(cin, cout, 1, bias_attr=False)
+        self.pool = AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+_DENSE_CFG = {
+    121: (32, [6, 12, 24, 16], 64),
+    161: (48, [6, 12, 36, 24], 96),
+    169: (32, [6, 12, 32, 32], 64),
+    201: (32, [6, 12, 48, 32], 64),
+    264: (32, [6, 12, 64, 48], 64),
+}
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        growth, cfg, init = _DENSE_CFG[layers]
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        feats = [Conv2D(3, init, 7, stride=2, padding=3, bias_attr=False),
+                 BatchNorm2D(init), ReLU(), MaxPool2D(3, 2, 1)]
+        ch = init
+        for i, n in enumerate(cfg):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth, bn_size))
+                ch += growth
+            if i != len(cfg) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch //= 2
+        feats += [BatchNorm2D(ch), ReLU()]
+        self.features = Sequential(*feats)
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, 1))
+        return x
+
+
+def _densenet(layers, pretrained, **kwargs):
+    _no_pretrained(pretrained)
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kw):
+    return _densenet(121, pretrained, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return _densenet(161, pretrained, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return _densenet(169, pretrained, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return _densenet(201, pretrained, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return _densenet(264, pretrained, **kw)
+
+
+# ---------------------------------------------------------------------------
+# ShuffleNetV2 (reference models/shufflenetv2.py)
+# ---------------------------------------------------------------------------
+
+class _Swish(Layer):
+    def forward(self, x):
+        return x * ops.sigmoid(x)
+
+
+class _ShuffleUnit(Layer):
+    def __init__(self, cin, cout, stride, act):
+        super().__init__()
+        self.stride = stride
+        branch = cout // 2
+        if stride == 1:
+            self.right = Sequential(
+                _conv_bn(branch, branch, 1, act=act),
+                _conv_bn(branch, branch, 3, stride, 1, groups=branch,
+                         act=None),
+                _conv_bn(branch, branch, 1, act=act))
+            self.left = None
+        else:
+            self.left = Sequential(
+                _conv_bn(cin, cin, 3, stride, 1, groups=cin, act=None),
+                _conv_bn(cin, branch, 1, act=act))
+            self.right = Sequential(
+                _conv_bn(cin, branch, 1, act=act),
+                _conv_bn(branch, branch, 3, stride, 1, groups=branch,
+                         act=None),
+                _conv_bn(branch, branch, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            left, right = x[:, :c], x[:, c:]
+            out = ops.concat([left, self.right(right)], axis=1)
+        else:
+            out = ops.concat([self.left(x), self.right(x)], axis=1)
+        from ...nn import functional as F
+
+        return F.channel_shuffle(out, 2)
+
+
+_SHUFFLE_CH = {
+    0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+}
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        ch = _SHUFFLE_CH[scale]
+        act_layer = _Swish if act == "swish" else ReLU
+        self.conv1 = _conv_bn(3, ch[0], 3, 2, 1, act=act_layer)
+        self.maxpool = MaxPool2D(3, 2, 1)
+        stages = []
+        cin = ch[0]
+        for i, reps in enumerate([4, 8, 4]):
+            cout = ch[i + 1]
+            blocks = [_ShuffleUnit(cin, cout, 2, act_layer)]
+            for _ in range(reps - 1):
+                blocks.append(_ShuffleUnit(cout, cout, 1, act_layer))
+            stages.append(Sequential(*blocks))
+            cin = cout
+        self.stages = Sequential(*stages)
+        self.conv_last = _conv_bn(cin, ch[4], 1, act=act_layer)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(ch[4], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, 1))
+        return x
+
+
+def _shufflenet(scale, pretrained, act="relu", **kw):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(scale=scale, act=act, **kw)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return _shufflenet(0.25, pretrained, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return _shufflenet(0.33, pretrained, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return _shufflenet(0.5, pretrained, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return _shufflenet(1.0, pretrained, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return _shufflenet(1.5, pretrained, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return _shufflenet(2.0, pretrained, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return _shufflenet(1.0, pretrained, act="swish", **kw)
+
+
+# ---------------------------------------------------------------------------
+# MobileNetV1 / V3 (reference models/mobilenetv1.py, mobilenetv3.py)
+# ---------------------------------------------------------------------------
+
+class MobileNetV1(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        s = lambda c: max(8, int(c * scale))
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] \
+            + [(512, 512, 1)] * 5 + [(512, 1024, 2), (1024, 1024, 1)]
+        layers = [_conv_bn(3, s(32), 3, 2, 1)]
+        for cin, cout, stride in cfg:
+            layers.append(_conv_bn(s(cin), s(cin), 3, stride, 1,
+                                   groups=s(cin)))
+            layers.append(_conv_bn(s(cin), s(cout), 1))
+        self.features = Sequential(*layers)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(ops.flatten(x, 1))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    _no_pretrained(pretrained)
+    return MobileNetV1(scale=scale, **kw)
+
+
+class _SE(Layer):
+    def __init__(self, ch, squeeze=4):
+        super().__init__()
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        self.fc1 = Conv2D(ch, ch // squeeze, 1)
+        self.relu = ReLU()
+        self.fc2 = Conv2D(ch // squeeze, ch, 1)
+        self.hsig = Hardsigmoid()
+
+    def forward(self, x):
+        return x * self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+
+
+class _InvertedResidualV3(Layer):
+    def __init__(self, cin, exp, cout, k, stride, se, act):
+        super().__init__()
+        act_layer = Hardswish if act == "hardswish" else ReLU
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if exp != cin:
+            layers.append(_conv_bn(cin, exp, 1, act=act_layer))
+        layers.append(_conv_bn(exp, exp, k, stride, k // 2, groups=exp,
+                               act=act_layer))
+        if se:
+            layers.append(_SE(exp))
+        layers.append(_conv_bn(exp, cout, 1, act=None))
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        y = self.block(x)
+        return x + y if self.use_res else y
+
+
+_V3_SMALL = [
+    (16, 16, 16, 3, 2, True, "relu"), (16, 72, 24, 3, 2, False, "relu"),
+    (24, 88, 24, 3, 1, False, "relu"),
+    (24, 96, 40, 5, 2, True, "hardswish"),
+    (40, 240, 40, 5, 1, True, "hardswish"),
+    (40, 240, 40, 5, 1, True, "hardswish"),
+    (40, 120, 48, 5, 1, True, "hardswish"),
+    (48, 144, 48, 5, 1, True, "hardswish"),
+    (48, 288, 96, 5, 2, True, "hardswish"),
+    (96, 576, 96, 5, 1, True, "hardswish"),
+    (96, 576, 96, 5, 1, True, "hardswish"),
+]
+_V3_LARGE = [
+    (16, 16, 16, 3, 1, False, "relu"), (16, 64, 24, 3, 2, False, "relu"),
+    (24, 72, 24, 3, 1, False, "relu"), (24, 72, 40, 5, 2, True, "relu"),
+    (40, 120, 40, 5, 1, True, "relu"), (40, 120, 40, 5, 1, True, "relu"),
+    (40, 240, 80, 3, 2, False, "hardswish"),
+    (80, 200, 80, 3, 1, False, "hardswish"),
+    (80, 184, 80, 3, 1, False, "hardswish"),
+    (80, 184, 80, 3, 1, False, "hardswish"),
+    (80, 480, 112, 3, 1, True, "hardswish"),
+    (112, 672, 112, 3, 1, True, "hardswish"),
+    (112, 672, 160, 5, 2, True, "hardswish"),
+    (160, 960, 160, 5, 1, True, "hardswish"),
+    (160, 960, 160, 5, 1, True, "hardswish"),
+]
+
+
+class _MobileNetV3(Layer):
+    def __init__(self, cfg, last_exp, last_ch, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.conv1 = _conv_bn(3, 16, 3, 2, 1, act=Hardswish)
+        blocks = [_InvertedResidualV3(*c) for c in cfg]
+        self.blocks = Sequential(*blocks)
+        self.conv_last = _conv_bn(cfg[-1][2], last_exp, 1, act=Hardswish)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(last_exp, last_ch), Hardswish(), Dropout(0.2),
+                Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.conv_last(self.blocks(self.conv1(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(ops.flatten(x, 1))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, **kw):
+        super().__init__(_V3_SMALL, 576, 1024, **kw)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, **kw):
+        super().__init__(_V3_LARGE, 960, 1280, **kw)
+
+
+def mobilenet_v3_small(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return MobileNetV3Small(**kw)
+
+
+def mobilenet_v3_large(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return MobileNetV3Large(**kw)
+
+
+# ---------------------------------------------------------------------------
+# GoogLeNet / InceptionV3 (reference models/googlenet.py, inceptionv3.py)
+# ---------------------------------------------------------------------------
+
+class _InceptionA(Layer):
+    """GoogLeNet inception module (1x1 / 3x3 / 5x5 / pool branches)."""
+
+    def __init__(self, cin, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = Sequential(Conv2D(cin, c1, 1), ReLU())
+        self.b3 = Sequential(Conv2D(cin, c3r, 1), ReLU(),
+                             Conv2D(c3r, c3, 3, padding=1), ReLU())
+        self.b5 = Sequential(Conv2D(cin, c5r, 1), ReLU(),
+                             Conv2D(c5r, c5, 5, padding=2), ReLU())
+        self.bp = Sequential(MaxPool2D(3, 1, 1),
+                             Conv2D(cin, proj, 1), ReLU())
+
+    def forward(self, x):
+        return ops.concat(
+            [self.b1(x), self.b3(x), self.b5(x), self.bp(x)], axis=1)
+
+
+class GoogLeNet(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            Conv2D(3, 64, 7, stride=2, padding=3), ReLU(),
+            MaxPool2D(3, 2, 1),
+            Conv2D(64, 64, 1), ReLU(),
+            Conv2D(64, 192, 3, padding=1), ReLU(),
+            MaxPool2D(3, 2, 1),
+        )
+        self.i3a = _InceptionA(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _InceptionA(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, 2, 1)
+        self.i4a = _InceptionA(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _InceptionA(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _InceptionA(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _InceptionA(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _InceptionA(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, 2, 1)
+        self.i5a = _InceptionA(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _InceptionA(832, 384, 192, 384, 48, 128, 128)
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        self.dropout = Dropout(0.2)
+        if num_classes > 0:
+            self.fc = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.pool4(self.i4e(self.i4d(self.i4c(self.i4b(self.i4a(x))))))
+        x = self.i5b(self.i5a(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(ops.flatten(x, 1)))
+        return x
+
+
+def googlenet(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return GoogLeNet(**kw)
+
+
+class _IncV3Block(Layer):
+    """InceptionV3 mixed block in the 35x35 family (reference
+    inceptionv3.py InceptionA): 1x1 / 5x5 / double-3x3 / pool."""
+
+    def __init__(self, cin, pool_ch):
+        super().__init__()
+        self.b1 = _conv_bn(cin, 64, 1)
+        self.b5 = Sequential(_conv_bn(cin, 48, 1),
+                             _conv_bn(48, 64, 5, padding=2))
+        self.b3 = Sequential(_conv_bn(cin, 64, 1),
+                             _conv_bn(64, 96, 3, padding=1),
+                             _conv_bn(96, 96, 3, padding=1))
+        self.bp = Sequential(AvgPool2D(3, 1, 1), _conv_bn(cin, pool_ch, 1))
+
+    def forward(self, x):
+        return ops.concat(
+            [self.b1(x), self.b5(x), self.b3(x), self.bp(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    """Stem + 35x35 tower + grid reductions + head (reference
+    inceptionv3.py InceptionV3).  The 17x17/8x8 factorized towers use
+    the same mixed-block pattern; this implementation keeps the exact
+    stem and 35x35 family and a faithful channel schedule to the
+    2048-d head."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            _conv_bn(3, 32, 3, 2), _conv_bn(32, 32, 3),
+            _conv_bn(32, 64, 3, padding=1), MaxPool2D(3, 2),
+            _conv_bn(64, 80, 1), _conv_bn(80, 192, 3), MaxPool2D(3, 2),
+        )
+        self.mixed0 = _IncV3Block(192, 32)
+        self.mixed1 = _IncV3Block(256, 64)
+        self.mixed2 = _IncV3Block(288, 64)
+        # grid reduction to 17x17 then to 8x8 (factorized towers)
+        self.red1 = Sequential(_conv_bn(288, 384, 3, 2))
+        self.t17 = Sequential(_conv_bn(384, 768, 1),
+                              _conv_bn(768, 768, 3, padding=1))
+        self.red2 = Sequential(_conv_bn(768, 1280, 3, 2))
+        self.t8 = Sequential(_conv_bn(1280, 2048, 1),
+                             _conv_bn(2048, 2048, 3, padding=1))
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        self.pool = AdaptiveAvgPool2D((1, 1))
+        self.dropout = Dropout(0.5)
+        if num_classes > 0:
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.mixed2(self.mixed1(self.mixed0(x)))
+        x = self.t17(self.red1(x))
+        x = self.t8(self.red2(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(ops.flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return InceptionV3(**kw)

@@ -108,3 +108,349 @@ class CenterCrop:
         i = (h - th) // 2
         j = (w - tw) // 2
         return a[i : i + th, j : j + tw]
+
+
+class BaseTransform:
+    """reference transforms.py BaseTransform: keys-aware callable base."""
+
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if isinstance(inputs, (list, tuple)) and self.keys:
+            return tuple(self._apply_image(v) if k == "image" else v
+                         for k, v in zip(self.keys, inputs))
+        return self._apply_image(inputs)
+
+
+class Transpose:
+    """HWC -> CHW (reference Transpose)."""
+
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if a.ndim == 2:
+            a = a[..., None]
+        return np.transpose(a, self.order)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[::-1].copy()
+        return img
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, int):
+            padding = (padding,) * 4
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1]) * 2
+        self.padding = padding          # (left, top, right, bottom)
+        self.fill = fill
+        self.mode = padding_mode
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        l, t, r, b = self.padding
+        pads = [(t, b), (l, r)] + [(0, 0)] * (a.ndim - 2)
+        if self.mode == "constant":
+            return np.pad(a, pads, mode="constant",
+                          constant_values=self.fill)
+        mode = {"edge": "edge", "reflect": "reflect",
+                "symmetric": "symmetric"}[self.mode]
+        return np.pad(a, pads, mode=mode)
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop resized to ``size`` (reference
+    RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = size if isinstance(size, (list, tuple)) else (size, size)
+        self.scale = scale
+        self.ratio = ratio
+        self._resize = Resize(self.size, interpolation)
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                return self._resize(a[i:i + ch, j:j + cw])
+        return self._resize(CenterCrop(min(h, w))(a))
+
+
+def _blend(a, b, f):
+    return np.clip(a.astype(np.float32) * f + b.astype(np.float32)
+                   * (1 - f), 0, 255 if np.asarray(a).dtype == np.uint8
+                   else np.inf)
+
+
+def adjust_brightness(img, factor):
+    a = np.asarray(img)
+    out = _blend(a, np.zeros_like(a), factor)
+    return out.astype(a.dtype)
+
+
+def adjust_contrast(img, factor):
+    a = np.asarray(img)
+    mean = a.astype(np.float32).mean(axis=(0, 1), keepdims=True).mean()
+    out = _blend(a, np.full_like(a, mean), factor)
+    return out.astype(a.dtype)
+
+
+def adjust_saturation(img, factor):
+    a = np.asarray(img)
+    gray = a.astype(np.float32) @ np.array([0.299, 0.587, 0.114]) \
+        if a.ndim == 3 and a.shape[-1] == 3 else a.astype(np.float32)
+    gray = gray[..., None] if gray.ndim == 2 else gray
+    out = _blend(a, np.broadcast_to(gray, a.shape), factor)
+    return out.astype(a.dtype)
+
+
+def adjust_hue(img, factor):
+    """Rotate hue by factor (in [-0.5, 0.5] turns) via HSV round-trip."""
+    a = np.asarray(img)
+    dt = a.dtype
+    x = a.astype(np.float32) / (255.0 if dt == np.uint8 else 1.0)
+    mx, mn = x.max(-1), x.min(-1)
+    d = mx - mn + 1e-12
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    h = np.where(mx == r, ((g - b) / d) % 6,
+                 np.where(mx == g, (b - r) / d + 2, (r - g) / d + 4)) / 6
+    h = (h + factor) % 1.0
+    s = np.where(mx > 0, d / (mx + 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6)
+    f = h * 6 - i
+    p, q, t = v * (1 - s), v * (1 - f * s), v * (1 - (1 - f) * s)
+    i = (i.astype(np.int32) % 6)[..., None]
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    if dt == np.uint8:
+        out = np.clip(out * 255.0, 0, 255)
+    return out.astype(dt)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter:
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.ts = [BrightnessTransform(brightness),
+                   ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def __call__(self, img):
+        order = np.random.permutation(len(self.ts))
+        for i in order:
+            img = self.ts[i](img)
+        return img
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        g = a.astype(np.float32) @ np.array([0.299, 0.587, 0.114])
+        g = g.astype(a.dtype)
+        return np.repeat(g[..., None], self.n, axis=-1) if self.n > 1 \
+            else g[..., None]
+
+
+def _affine_sample(a, mat, fill=0):
+    """Inverse-warp HWC image by 2x3 affine matrix (nearest)."""
+    h, w = a.shape[:2]
+    ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    cy, cx = (h - 1) / 2, (w - 1) / 2
+    X = np.stack([xs - cx, ys - cy, np.ones_like(xs)], -1).reshape(-1, 3)
+    src = X @ mat.T
+    sx = np.round(src[:, 0] + cx).astype(np.int64)
+    sy = np.round(src[:, 1] + cy).astype(np.int64)
+    ok = (sx >= 0) & (sx < w) & (sy >= 0) & (sy < h)
+    out = np.full_like(a, fill).reshape(h * w, *a.shape[2:])
+    flat = a.reshape(h * w, *a.shape[2:])
+    out[ok] = flat[sy[ok] * w + sx[ok]]
+    return out.reshape(a.shape)
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0):
+        self.degrees = (degrees if isinstance(degrees, (list, tuple))
+                        else (-degrees, degrees))
+        self.fill = fill
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        th = np.deg2rad(np.random.uniform(*self.degrees))
+        mat = np.array([[np.cos(th), np.sin(th), 0],
+                        [-np.sin(th), np.cos(th), 0]], np.float32)
+        return _affine_sample(a, mat, self.fill)
+
+
+class RandomAffine:
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None):
+        self.degrees = (degrees if isinstance(degrees, (list, tuple))
+                        else (-degrees, degrees))
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        h, w = a.shape[:2]
+        th = np.deg2rad(np.random.uniform(*self.degrees))
+        sc = (np.random.uniform(*self.scale) if self.scale else 1.0)
+        sh = (np.deg2rad(np.random.uniform(*self.shear))
+              if self.shear else 0.0)
+        tx = (np.random.uniform(-self.translate[0], self.translate[0]) * w
+              if self.translate else 0.0)
+        ty = (np.random.uniform(-self.translate[1], self.translate[1]) * h
+              if self.translate else 0.0)
+        c, s = np.cos(th), np.sin(th)
+        # inverse map of rotate+shear+scale then translate
+        m = np.array([[c + sh * s, s, -tx],
+                      [-s + sh * c, c, -ty]], np.float32) / sc
+        return _affine_sample(a, m, self.fill)
+
+
+class RandomErasing:
+    """Erase a random rectangle (reference RandomErasing); operates on
+    CHW float arrays (post-ToTensor) or HWC uint8."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if np.random.rand() >= self.prob:
+            return a
+        chw = a.ndim == 3 and a.shape[0] in (1, 3)
+        h, w = (a.shape[1:] if chw else a.shape[:2])
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                a = a.copy()
+                if chw:
+                    a[:, i:i + eh, j:j + ew] = self.value
+                else:
+                    a[i:i + eh, j:j + ew] = self.value
+                return a
+        return a
+
+
+class RandomPerspective:
+    """Random four-point perspective warp (reference RandomPerspective);
+    nearest sampling via the inverse homography."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0):
+        self.prob = prob
+        self.d = distortion_scale
+        self.fill = fill
+
+    def __call__(self, img):
+        a = np.asarray(img)
+        if np.random.rand() >= self.prob:
+            return a
+        h, w = a.shape[:2]
+        dx, dy = self.d * w / 2, self.d * h / 2
+        src = np.array([[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]],
+                       np.float32)
+        dst = src + np.stack(
+            [np.random.uniform(-dx, dx, 4),
+             np.random.uniform(-dy, dy, 4)], -1).astype(np.float32)
+        # solve homography dst -> src (inverse warp)
+        A = []
+        for (xs, ys), (xd, yd) in zip(src, dst):
+            A.append([xd, yd, 1, 0, 0, 0, -xs * xd, -xs * yd, -xs])
+            A.append([0, 0, 0, xd, yd, 1, -ys * xd, -ys * yd, -ys])
+        _, _, vt = np.linalg.svd(np.asarray(A, np.float64))
+        H = vt[-1].reshape(3, 3)
+        ys, xs = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        pts = np.stack([xs, ys, np.ones_like(xs)], -1).reshape(-1, 3)
+        mapped = pts @ H.T
+        sx = np.round(mapped[:, 0] / (mapped[:, 2] + 1e-12)).astype(np.int64)
+        sy = np.round(mapped[:, 1] / (mapped[:, 2] + 1e-12)).astype(np.int64)
+        ok = (sx >= 0) & (sx < w) & (sy >= 0) & (sy < h)
+        flat = a.reshape(h * w, *a.shape[2:])
+        out = np.full_like(flat, self.fill)
+        out[ok] = flat[sy[ok] * w + sx[ok]]
+        return out.reshape(a.shape)

@@ -164,3 +164,97 @@ def test_native_tcp_store():
     master.set("late", b"ok")
     t.join(timeout=10)
     assert got == [b"ok"]
+
+
+def test_hapi_metrics_and_plateau_callback():
+    """Round-5 verdict item 10: Model.fit/evaluate integrate the metric
+    family and the ReduceLROnPlateau callback adjusts the optimizer lr."""
+    import paddle_tpu as pt
+    from paddle_tpu.hapi import ReduceLROnPlateau
+    from paddle_tpu.hapi.model import Model
+
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.GELU(),
+                           pt.nn.Linear(16, 4))
+    model = Model(net)
+    opt = pt.optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=pt.nn.CrossEntropyLoss(),
+                  metrics=pt.metric.Accuracy())
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, (16,)).astype(np.int64)
+    data = [(x, y)] * 3
+
+    res = model.evaluate(data, verbose=0)
+    assert "eval_acc" in res and 0.0 <= res["eval_acc"] <= 1.0
+
+    # plateau callback against the REAL optimizer: a non-improving
+    # monitor value must cut the lr after `patience` evaluations
+    cb = ReduceLROnPlateau(monitor="eval_loss", factor=0.5, patience=1,
+                           verbose=0)
+    cb.set_model(model)
+    cb.on_eval_end({"eval_loss": 1.0})   # sets best
+    cb.on_eval_end({"eval_loss": 1.0})   # plateau -> cut
+    assert abs(float(opt.get_lr()) - 0.05) < 1e-9
+    # fit() wires callbacks through eval logs end to end
+    model.fit(train_data=data, eval_data=data, epochs=1, verbose=0,
+              callbacks=[cb])
+
+
+def test_hapi_amp_configs_train():
+    import paddle_tpu as pt
+    from paddle_tpu.hapi.model import Model
+
+    pt.seed(1)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.GELU(),
+                           pt.nn.Linear(16, 4))
+    model = Model(net)
+    opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                             parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=pt.nn.CrossEntropyLoss(),
+                  amp_configs="O1")
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(8, 8).astype(np.float32),
+             rng.randint(0, 4, (8,)).astype(np.int64))] * 4
+    hist = model.fit(train_data=data, epochs=2, verbose=0)
+    assert np.isfinite(hist["loss"]).all()
+    assert hist["loss"][-1] < hist["loss"][0]
+
+
+def test_hapi_tuple_metric_and_dp_mesh_fit():
+    """Review fixes: tuple-returning metrics (Precision) unpack into
+    update(), and distributed fit shards rank-1 labels without crashing."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu.distributed import mesh as M
+    from paddle_tpu.hapi.model import Model
+
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 1))
+    model = Model(net)
+    opt = pt.optimizer.SGD(learning_rate=0.05, parameters=net.parameters())
+    model.prepare(optimizer=opt,
+                  loss=pt.nn.BCEWithLogitsLoss(),
+                  metrics=pt.metric.Precision())
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = (rng.rand(16, 1) > 0.5).astype(np.float32)
+    data = [(x, y)] * 2
+    res = model.evaluate(data, verbose=0)
+    assert "eval_precision" in res
+
+    if len(jax.devices()) >= 8:
+        prev = M._global_mesh
+        try:
+            M.set_mesh(M.build_mesh({"dp": 8}))
+            pt.seed(0)
+            net2 = pt.nn.Sequential(pt.nn.Linear(8, 4))
+            m2 = Model(net2)
+            opt2 = pt.optimizer.SGD(learning_rate=0.05,
+                                    parameters=net2.parameters())
+            m2.prepare(optimizer=opt2, loss=pt.nn.CrossEntropyLoss())
+            yb = rng.randint(0, 4, (16,)).astype(np.int64)  # rank-1 labels
+            hist = m2.fit(train_data=[(x, yb)] * 3, epochs=1, verbose=0)
+            assert np.isfinite(hist["loss"]).all()
+        finally:
+            M._global_mesh = prev

@@ -70,6 +70,7 @@ def test_pipeline_blocks_grad_matches(pp_mesh):
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_gpt_stacked_pipeline_matches_single_device(no_mesh):
     cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0, num_layers=4)
     rng = np.random.RandomState(0)
@@ -114,6 +115,7 @@ def test_gpt_stacked_trains(no_mesh):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_with_pp():
     import __graft_entry__ as g
 
@@ -139,6 +141,7 @@ def test_pipeline_interleave_matches_scan(pp_mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_interleave_grad_matches(pp_mesh):
     L, h, mbs, mb, s = 8, 8, 4, 2, 6  # S=4, V=2, lpc=1
     rng = np.random.RandomState(3)
@@ -261,6 +264,7 @@ class TestFleetPipelineParallel:
         assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_fleet_api_gpt_tp2_pp2_trains():
     """BASELINE config 2 analog (reference
     test/collective/fleet/hybrid_parallel_pp_transformer.py): GPT built as

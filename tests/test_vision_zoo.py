@@ -1,0 +1,87 @@
+"""Vision model zoo + transforms depth (round-5 verdict items 5/10).
+
+Reference: python/paddle/vision/models/* (full family list),
+transforms/transforms.py (~22 transforms)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import models as M
+from paddle_tpu.vision import transforms as T
+
+
+def _check_forward(mk, size=64):
+    pt.seed(0)
+    m = mk()
+    m.eval()
+    x = pt.to_tensor(np.random.RandomState(0)
+                     .randn(1, 3, size, size).astype(np.float32))
+    out = m(x)
+    assert out.shape == [1, 10]
+    assert np.isfinite(out.numpy()).all()
+
+
+@pytest.mark.parametrize("mk", [
+    lambda: M.squeezenet1_1(num_classes=10),
+    lambda: M.shufflenet_v2_x0_25(num_classes=10),
+    lambda: M.mobilenet_v1(scale=0.25, num_classes=10),
+], ids=["squeezenet1_1", "shufflenet_x0_25", "mobilenet_v1"])
+def test_zoo_forward_fast(mk):
+    _check_forward(mk)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mk,size", [
+    (lambda: M.alexnet(num_classes=10), 64),
+    (lambda: M.squeezenet1_0(num_classes=10), 64),
+    (lambda: M.densenet121(num_classes=10), 64),
+    (lambda: M.shufflenet_v2_swish(num_classes=10), 64),
+    (lambda: M.mobilenet_v3_small(num_classes=10), 64),
+    (lambda: M.googlenet(num_classes=10), 64),
+    # inception's aggressive valid-padded stem needs >= ~96px input
+    (lambda: M.inception_v3(num_classes=10), 96),
+    (lambda: M.resnext50_32x4d(num_classes=10), 64),
+], ids=["alexnet", "squeezenet1_0", "densenet121", "shufflenet_swish",
+        "mobilenet_v3_small", "googlenet", "inception_v3",
+        "resnext50_32x4d"])
+def test_zoo_forward_full(mk, size):
+    _check_forward(mk, size)
+
+
+def test_zoo_backward_one_family():
+    pt.seed(0)
+    m = M.squeezenet1_1(num_classes=4)
+    x = pt.to_tensor(np.random.RandomState(0)
+                     .randn(2, 3, 48, 48).astype(np.float32))
+    y = pt.to_tensor(np.array([1, 2], np.int64))
+    loss = pt.nn.functional.cross_entropy(m(x), y)
+    loss.backward()
+    grads = [p.grad for p in m.parameters() if not p.stop_gradient]
+    assert any(g is not None and np.abs(g.numpy()).max() > 0
+               for g in grads)
+
+
+def test_transforms_pipeline_and_adjust_ops():
+    img = (np.random.RandomState(0).rand(32, 40, 3) * 255) \
+        .astype(np.uint8)
+    np.random.seed(0)
+    pipeline = T.Compose([
+        T.RandomResizedCrop(24), T.RandomHorizontalFlip(),
+        T.RandomVerticalFlip(), T.ColorJitter(0.2, 0.2, 0.2, 0.1),
+        T.Grayscale(3), T.Pad(2), T.RandomRotation(15),
+        T.RandomAffine(10, translate=(0.1, 0.1), scale=(0.9, 1.1)),
+        T.RandomPerspective(1.0, 0.3), T.ToTensor(),
+        T.RandomErasing(1.0), T.Normalize([0.5] * 3, [0.5] * 3),
+    ])
+    out = pipeline(img)
+    assert out.shape == (3, 28, 28) and np.isfinite(out).all()
+    # identity factors are identity
+    assert np.abs(T.adjust_hue(img, 0.0).astype(int)
+                  - img.astype(int)).max() <= 1
+    np.testing.assert_array_equal(T.adjust_brightness(img, 1.0), img)
+    # grayscale has equal channels
+    g = T.Grayscale(3)(img)
+    assert (g[..., 0] == g[..., 1]).all()
+    # erasing actually zeroes a patch
+    e = T.RandomErasing(1.0, value=0)(T.ToTensor()(img))
+    assert (e == 0).sum() > 0
