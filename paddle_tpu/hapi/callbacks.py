@@ -141,13 +141,16 @@ class ReduceLROnPlateau(Callback):
         cur = (logs or {}).get(self.monitor)
         if cur is None:
             return
-        if self._cool > 0:
-            self._cool -= 1
         if self._improved(cur):
             self.best = cur
             self.wait = 0
+            if self._cool > 0:
+                self._cool -= 1
             return
         if self._cool > 0:
+            # cooldown evals don't count toward the plateau (reference
+            # ReduceOnPlateau cooldown_counter semantics)
+            self._cool -= 1
             return
         self.wait += 1
         if self.wait >= self.patience:

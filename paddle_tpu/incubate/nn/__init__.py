@@ -115,9 +115,12 @@ class FusedMultiTransformer(Layer):
             raise NotImplementedError(
                 "FusedMultiTransformer is the pre-LN fast path "
                 "(normalize_before=True), like the reference kernel")
-        if activation not in ("gelu", "geglu"):
+        if activation != "gelu":
             raise NotImplementedError(
                 f"activation {activation!r}: the fused block is GELU")
+        # per-layer *_attrs lists are accepted for API parity but only
+        # their LENGTH is consumed (num_layers inference) — the stacked
+        # slabs self-initialize; pass state via set_state_dict
         from ...models.gpt import GPTConfig, GPTStackedDecoder
 
         if embed_dim % num_heads != 0:
@@ -145,6 +148,11 @@ class FusedMultiTransformer(Layer):
             raise NotImplementedError(
                 "FusedMultiTransformer runs the causal fast path; "
                 "arbitrary masks go through nn.TransformerEncoder")
+        if caches is not None or pre_caches is not None \
+                or time_step is not None:
+            raise NotImplementedError(
+                "FusedMultiTransformer: incremental KV-cached decoding "
+                "is not implemented — run full-sequence forwards")
         return self.norm(self.decoder(src))
 
 

@@ -212,16 +212,44 @@ def interpolate(
         return (jnp.take(a, lo, axis=axis) * (1 - w)
                 + jnp.take(a, hi, axis=axis) * w)
 
+    def _axis_cubic(a, axis, n_out, scale=None):
+        """Separable Keys-cubic resize of ONE axis (a = -0.75, the
+        reference/torch kernel — jax.image's cubic uses a = -0.5), edge
+        samples replicated; supports both align modes."""
+        A = -0.75
+        n_in = a.shape[axis]
+        if n_out == n_in:
+            return a
+        if align_corners:
+            # out==1: the align_corners scale is defined as 0 (torch/
+            # paddle): sample coordinate 0, not the half-pixel center
+            pos = (jnp.linspace(0.0, n_in - 1, n_out) if n_out > 1
+                   else jnp.zeros((1,)))
+        else:
+            ratio = (1.0 / scale) if scale else (n_in / n_out)
+            pos = (jnp.arange(n_out) + 0.5) * ratio - 0.5
+        i0 = jnp.floor(pos).astype(jnp.int32)
+        t = pos - i0
+        w = [
+            ((A * (t + 1) - 5 * A) * (t + 1) + 8 * A) * (t + 1) - 4 * A,
+            ((A + 2) * t - (A + 3)) * t * t + 1,
+            ((A + 2) * (1 - t) - (A + 3)) * (1 - t) ** 2 + 1,
+            ((A * (2 - t) - 5 * A) * (2 - t) + 8 * A) * (2 - t) - 4 * A,
+        ]
+        shape = [1] * a.ndim
+        shape[axis] = n_out
+        out = 0.0
+        for k in range(4):
+            idx = jnp.clip(i0 + (k - 1), 0, n_in - 1)
+            out = out + jnp.take(a, idx, axis=axis) * w[k].reshape(shape)
+        return out
+
     def fn(a):
-        if mode == "bicubic":
-            if align_corners:
-                raise NotImplementedError(
-                    "bicubic with align_corners=True")
-            shape = list(a.shape)
-            for ax, n_out in zip(axes, out_sizes):
-                shape[ax] = n_out
-            return jax.image.resize(a, shape, method="cubic")
         out = a
+        if mode == "bicubic":
+            for ax, n_out, sc in zip(axes, out_sizes, scales):
+                out = _axis_cubic(out, ax, n_out, scale=sc)
+            return out
         for ax, n_out, sc in zip(axes, out_sizes, scales):
             out = _axis_lerp(out, ax, n_out, nearest=(mode == "nearest"),
                              scale=sc)

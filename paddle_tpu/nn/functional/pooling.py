@@ -163,12 +163,16 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
     os = _tuple_n(output_size, 2)
 
     def fn(a):
-        if data_format == "NCHW":
-            n, c, h, w = a.shape
-            oh, ow = os
-            a5 = a.reshape(n, c, oh, h // oh, ow, w // ow) if h % oh == 0 and w % ow == 0 else None
-            if a5 is not None:
-                return a5.mean(axis=(3, 5))
+        if data_format == "NHWC":
+            # route through the NCHW body (two transposes fold into the
+            # surrounding program under XLA)
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        n, c, h, w = a.shape
+        oh, ow = os
+        a5 = a.reshape(n, c, oh, h // oh, ow, w // ow) if h % oh == 0 and w % ow == 0 else None
+        if a5 is not None:
+            out = a5.mean(axis=(3, 5))
+        else:
             # general: mean over variable windows
             out = jnp.stack(
                 [
@@ -184,8 +188,9 @@ def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
                 ],
                 axis=-2,
             )
-            return out
-        raise NotImplementedError("NHWC adaptive pool")
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
 
     return dispatch.apply(fn, x, op_name="adaptive_avg_pool2d")
 
@@ -214,10 +219,33 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
             axis=-2,
         )
 
-    out = dispatch.apply(fn, x, op_name="adaptive_max_pool2d")
-    if return_mask:
-        raise NotImplementedError("adaptive_max_pool2d return_mask")
-    return out
+    if not return_mask:
+        return dispatch.apply(fn, x, op_name="adaptive_max_pool2d")
+
+    def both_fn(a):
+        # ONE pass over the windows produces value and index together
+        # (the value gathered at the argmax keeps the max's gradient)
+        n, c, h, w = a.shape
+        oh, ow = os
+        val_rows, idx_rows = [], []
+        for i in range(oh):
+            vr, ir = [], []
+            for j in range(ow):
+                hs, he = (i * h) // oh, ((i + 1) * h + oh - 1) // oh
+                ws, we = (j * w) // ow, ((j + 1) * w + ow - 1) // ow
+                win = a[:, :, hs:he, ws:we].reshape(n, c, -1)
+                flat = jnp.argmax(win, axis=-1)
+                vr.append(jnp.take_along_axis(
+                    win, flat[..., None], axis=-1)[..., 0])
+                wy = hs + flat // (we - ws)
+                wx = ws + flat % (we - ws)
+                ir.append(wy * w + wx)           # per-(N,C)-plane index
+            val_rows.append(jnp.stack(vr, -1))
+            idx_rows.append(jnp.stack(ir, -1))
+        return (jnp.stack(val_rows, -2),
+                jnp.stack(idx_rows, -2).astype(jnp.int64))
+
+    return dispatch.apply(both_fn, x, op_name="adaptive_max_pool2d")
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):

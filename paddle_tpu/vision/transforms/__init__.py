@@ -111,7 +111,9 @@ class CenterCrop:
 
 
 class BaseTransform:
-    """reference transforms.py BaseTransform: keys-aware callable base."""
+    """reference transforms.py BaseTransform — the keys-aware base for
+    USER-DEFINED transforms (subclass and implement _apply_image); the
+    built-in transforms in this module are standalone callables."""
 
     def __init__(self, keys=None):
         self.keys = keys

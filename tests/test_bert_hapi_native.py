@@ -101,6 +101,7 @@ def test_hapi_model_fit():
     assert model.summary()["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
 
 
+@pytest.mark.slow
 def test_vision_resnet_builds_and_lenet_trains():
     from paddle_tpu.vision.models import resnet50
     from paddle_tpu.vision.models.lenet import LeNet

@@ -323,6 +323,7 @@ def test_yolo_box_iou_aware_leading_block():
     np.testing.assert_allclose(ratio, exp_ratio, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_yolo_loss_compiles_to_static():
     x = pt.to_tensor(RNG.randn(1, 14, 4, 4).astype(np.float32))
     gtb = pt.to_tensor(RNG.rand(1, 3, 4).astype(np.float32) * 0.4 + 0.2)

@@ -48,6 +48,7 @@ def test_moe_expert_parallel_mesh(clean_mesh):
     assert np.isfinite(moe.experts.w1.grad.numpy()).all()
 
 
+@pytest.mark.slow
 def test_moe_alltoall_matches_dense_dispatch(clean_mesh):
     """The explicit lax.all_to_all dispatch (reference global_scatter/
     global_gather analog) must produce the same outputs as the dense GShard

@@ -172,3 +172,38 @@ def test_interpolate_linear_explicit_scale_ratio():
     ref = TF.interpolate(torch.tensor(x4), scale_factor=1.7,
                          mode="bilinear").numpy()
     np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_bicubic_parity_both_align_modes():
+    """Keys cubic with a=-0.75 (the reference/torch kernel; jax.image's
+    cubic uses a=-0.5 and was replaced)."""
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 2, 7, 9).astype(np.float32)
+    for ac in (False, True):
+        ours = F.interpolate(pt.to_tensor(x), size=[12, 5],
+                             mode="bicubic", align_corners=ac).numpy()
+        ref = TF.interpolate(torch.tensor(x), size=(12, 5),
+                             mode="bicubic", align_corners=ac).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+    ours = F.interpolate(pt.to_tensor(x), scale_factor=1.7,
+                         mode="bicubic").numpy()
+    ref = TF.interpolate(torch.tensor(x), scale_factor=1.7,
+                         mode="bicubic").numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_adaptive_pool_nhwc_and_mask():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 3, 7, 9).astype(np.float32)
+    o_nhwc = F.adaptive_avg_pool2d(
+        pt.to_tensor(np.transpose(x, (0, 2, 3, 1))), 3,
+        data_format="NHWC").numpy()
+    o_nchw = F.adaptive_avg_pool2d(pt.to_tensor(x), 3).numpy()
+    np.testing.assert_allclose(np.transpose(o_nhwc, (0, 3, 1, 2)),
+                               o_nchw, atol=1e-6)
+    ours, idx = F.adaptive_max_pool2d(pt.to_tensor(x), 3,
+                                      return_mask=True)
+    ref, ridx = TF.adaptive_max_pool2d(torch.tensor(x), 3,
+                                       return_indices=True)
+    np.testing.assert_allclose(ours.numpy(), ref.numpy(), atol=1e-6)
+    np.testing.assert_array_equal(idx.numpy(), ridx.numpy())

@@ -135,26 +135,32 @@ def test_engine_plan_non_gpt_model_measured():
     from_gpt_config shape guessing (round-4 verdict weak #3)."""
     from paddle_tpu.distributed.auto_parallel.engine import Engine
 
-    pt.seed(0)
-    model = pt.nn.Sequential(pt.nn.Linear(16, 64), pt.nn.GELU(),
-                             pt.nn.Linear(64, 16), pt.nn.GELU(),
-                             pt.nn.Linear(16, 4))
-    loss_fn = pt.nn.MSELoss()
-    eng = Engine(model=model, loss=loss_fn)
-    xb = np.random.RandomState(0).randn(8, 16).astype(np.float32)
-    yb = np.random.RandomState(1).randn(8, 4).astype(np.float32)
-    best = eng.plan(sample_batch=(xb, yb))
-    assert best.mesh["dp"] * best.mesh["mp"] * best.mesh["pp"] == len(
-        jax.devices())
-    assert hasattr(eng, "_propagation")
-    prop = eng._propagation
-    # the pass assigned a spec to every equation output
-    assert len(prop.var_specs) > 0
-    assert prop.out_specs  # loss spec exists
-    # cost() also runs from measured numbers on this model
-    cost = eng.cost()
-    assert cost["best"] is not None
-    assert all("step_time" in c for c in cost["candidates"])
+    from paddle_tpu.distributed import mesh as M
+
+    prev = M._global_mesh
+    try:
+        pt.seed(0)
+        model = pt.nn.Sequential(pt.nn.Linear(16, 64), pt.nn.GELU(),
+                                 pt.nn.Linear(64, 16), pt.nn.GELU(),
+                                 pt.nn.Linear(16, 4))
+        loss_fn = pt.nn.MSELoss()
+        eng = Engine(model=model, loss=loss_fn)
+        xb = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+        yb = np.random.RandomState(1).randn(8, 4).astype(np.float32)
+        best = eng.plan(sample_batch=(xb, yb))
+        assert best.mesh["dp"] * best.mesh["mp"] * best.mesh["pp"] == len(
+            jax.devices())
+        assert hasattr(eng, "_propagation")
+        prop = eng._propagation
+        # the pass assigned a spec to every equation output
+        assert len(prop.var_specs) > 0
+        assert prop.out_specs  # loss spec exists
+        # cost() also runs from measured numbers on this model
+        cost = eng.cost()
+        assert cost["best"] is not None
+        assert all("step_time" in c for c in cost["candidates"])
+    finally:
+        M._global_mesh = prev
 
 
 def test_scan_inner_reshards_surface():
@@ -176,3 +182,30 @@ def test_scan_inner_reshards_surface():
                                    DistSpec((None, None, None))])
     assert any(r.primitive == "scan_carry" for r in res.reshards)
     assert all(r.bytes > 0 for r in res.reshards)
+
+
+def test_propagation_through_flagship_gpt_scan():
+    """End-to-end: capture the REAL stacked GPT (lax.scan over layer
+    slabs) through Engine.capture_graph and verify the pass assigns
+    specs through the scan without erroring, with the loss replicated."""
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.models import (
+        GPTPretrainingCriterion, GPTStackedForPretraining, gpt_tiny)
+
+    pt.seed(0)
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0,
+                   num_layers=2)
+    model = GPTStackedForPretraining(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+
+    class _Loss:
+        def __call__(self, out, labels):
+            return crit(out, labels)
+
+    eng = Engine(model=model, loss=_Loss())
+    ids = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (2, 16)).astype(np.int64)
+    closed = eng.capture_graph(ids, ids)
+    prop = eng.propagate(mesh_axes={"dp": 2, "mp": 2})
+    assert len(prop.var_specs) > 100          # specs assigned throughout
+    assert prop.out_specs[0].dims == ()       # scalar loss
