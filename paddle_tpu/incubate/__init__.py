@@ -2,3 +2,4 @@
 from . import asp  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from .selected_rows import SelectedRows, merge_selected_rows  # noqa: F401

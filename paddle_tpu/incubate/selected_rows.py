@@ -1,0 +1,76 @@
+"""SelectedRows — the reference's sparse-row tensor variant
+(paddle/phi/core/selected_rows.h: a [height, ...] tensor represented by
+the index list ``rows`` plus a dense ``value`` holding only those rows;
+phi/kernels/selected_rows/ merge_selected_rows sums duplicate rows).
+
+On TPU the GRADIENT path never produces SelectedRows — XLA scatter-add
+on dense embeddings is the fast path — so this container exists for
+API/data compatibility: converting PS-era sparse checkpoints, hosting
+row-sparse updates, and the ``merge_selected_rows`` /
+``to_dense`` ops the reference exposes.  Device math is jnp
+(segment-sum for the merge — one vectorized pass, no host loop).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["SelectedRows", "merge_selected_rows"]
+
+
+class SelectedRows:
+    """rows: int ids into [0, height); value: [len(rows), ...] dense."""
+
+    def __init__(self, rows, value, height: int):
+        self.rows = (rows if isinstance(rows, Tensor)
+                     else Tensor(jnp.asarray(np.asarray(rows, np.int64))))
+        self.value = (value if isinstance(value, Tensor)
+                      else Tensor(jnp.asarray(value)))
+        self.height = int(height)
+        if self.value.shape[0] != self.rows.shape[0]:
+            raise ValueError(
+                f"value rows ({self.value.shape[0]}) != len(rows) "
+                f"({self.rows.shape[0]})")
+        if self.rows.shape[0]:
+            rmin = int(np.asarray(self.rows._value).min())
+            rmax = int(np.asarray(self.rows._value).max())
+            if rmin < 0 or rmax >= self.height:
+                # out-of-range ids must fail LOUDLY: merge's unique
+                # padding and XLA's OOB-scatter semantics would both
+                # silently drop them otherwise
+                raise ValueError(
+                    f"row ids must be in [0, {self.height}); got range "
+                    f"[{rmin}, {rmax}]")
+
+    @property
+    def shape(self):
+        return [self.height] + list(self.value.shape[1:])
+
+    def to_dense(self) -> Tensor:
+        """Scatter-ADD into the dense [height, ...] tensor (duplicate
+        rows accumulate, like the reference's merge-on-materialize)."""
+        dense = jnp.zeros((self.height,) + tuple(self.value._value.shape[1:]),
+                          self.value._value.dtype)
+        return Tensor(dense.at[self.rows._value].add(self.value._value))
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"rows={np.asarray(self.rows._value).tolist()}, "
+                f"value.shape={list(self.value.shape)})")
+
+
+def merge_selected_rows(sr: SelectedRows) -> SelectedRows:
+    """Sum duplicate rows and sort the row ids (reference
+    merge_selected_rows kernel / MergeAdd functor) — one vectorized
+    unique + segment-sum, no host loop over rows."""
+    rows = sr.rows._value
+    uniq, inv = jnp.unique(rows, return_inverse=True,
+                           size=rows.shape[0], fill_value=sr.height)
+    summed = jax.ops.segment_sum(sr.value._value, inv,
+                                 num_segments=rows.shape[0])
+    # drop the padding segments jnp.unique(size=...) introduces
+    n = int(np.asarray((uniq < sr.height).sum()))
+    return SelectedRows(Tensor(uniq[:n]), Tensor(summed[:n]), sr.height)

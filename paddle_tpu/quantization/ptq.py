@@ -89,22 +89,40 @@ class PTQ:
             else:
                 self._wrap(sub, full)
 
-    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+    def convert(self, model: Layer, inplace: bool = False,
+                backend: str = "qdq") -> Layer:
+        """backend='qdq' (reference convert: simulated quant-dequant) or
+        'int8' (TRUE int8 execution: Linear layers become Int8Linear —
+        int8 weights + MXU int8 matmul; non-Linear observed layers keep
+        QDQ)."""
+        if backend not in ("qdq", "int8"):
+            raise ValueError(f"backend must be qdq | int8, got {backend}")
         if not inplace:
             import copy as _copy
 
             model = _copy.deepcopy(model)
-        self._convert(model)
+        self._convert(model, backend)
         return model
 
-    def _convert(self, layer: Layer):
+    def _convert(self, layer: Layer, backend: str = "qdq"):
+        from ..nn.modules.common import Linear
+
         for name, sub in list(layer._sub_layers.items()):
             if isinstance(sub, _ObservedWrapper):
                 act_s = (float(sub.act_observer.scales())
                          if sub.act_observer is not None and sub.act_observer.scales() is not None else None)
                 w_s = (float(sub.weight_observer.scales())
                        if sub.weight_observer is not None and sub.weight_observer.scales() is not None else None)
-                layer._sub_layers[name] = _FrozenQDQ(sub._inner, act_s, w_s)
+                if backend == "int8" and isinstance(sub._inner, Linear):
+                    from .int8 import Int8Linear
+
+                    layer._sub_layers[name] = Int8Linear(
+                        sub._inner,
+                        act_scale=(act_s / 127.0
+                                   if act_s is not None else None))
+                else:
+                    layer._sub_layers[name] = _FrozenQDQ(sub._inner,
+                                                         act_s, w_s)
                 setattr(layer, name, layer._sub_layers[name])
             else:
-                self._convert(sub)
+                self._convert(sub, backend)

@@ -125,6 +125,7 @@ CLASS_COVERAGE = {
     "warprnnt": "nn.functional.rnnt_loss",
     "unpool3d": "nn.functional.max_unpool3d",
     "average_accumulates_": "incubate.optimizer.ModelAverage",
+    "merge_selected_rows": "incubate.merge_selected_rows",
 }
 
 # reference ops deliberately NOT implemented, with the architectural
@@ -133,9 +134,6 @@ DESCOPED = {
     "coalesce_tensor": "grad-buffer fusion feeding fused allreduce; XLA "
                        "buffer assignment + SPMD collectives make the "
                        "user-facing op surface meaningless on TPU",
-    "merge_selected_rows": "SelectedRows sparse-gradient container op; "
-                           "sparse grads lower to XLA scatter-add — no "
-                           "SelectedRows tensor variant exists here",
 }
 
 
@@ -614,6 +612,9 @@ def _explicit_smokes():
                 t(np.array([0, 2, 3, 3], np.int64)),
                 t(np.array([0.5, 0.2, 0.9], np.float32)),
                 t(np.array([0, 1], np.int64)), sample_size=1),
+        "merge_selected_rows": lambda: pt.incubate.merge_selected_rows(
+            pt.incubate.SelectedRows(
+                [1, 0, 1], np.ones((3, 2), np.float32), height=4)),
     }
 
 
