@@ -63,6 +63,7 @@ def test_fused_multi_transformer_matches_composition():
     np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_fused_multi_transformer_trains_compiled():
     pt.seed(0)
     m = FusedMultiTransformer(embed_dim=32, num_heads=4,

@@ -146,6 +146,7 @@ def _np_causal_attention(q, k, v):
     return np.einsum("bnqk,bknd->bqnd", p, v)
 
 
+@pytest.mark.slow
 def test_ring_attention_matches_reference(clean_mesh):
     from paddle_tpu.nn.functional.ring_attention import ring_attention
 

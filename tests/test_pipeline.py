@@ -327,6 +327,7 @@ def test_fleet_api_gpt_tp2_pp2_trains():
         M._global_mesh = prev
 
 
+@pytest.mark.slow
 def test_multiprocess_launch_both_nodes(tmp_path):
     """Run both 'nodes' concurrently via the launcher (auto-rank
     rendezvous) and assert both workers succeed."""

@@ -85,6 +85,7 @@ class TestQuantization:
         return pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
                                 pt.nn.Linear(16, 4))
 
+    @pytest.mark.slow
     def test_qat_quantize_and_train(self):
         q_config = QuantConfig(activation=None, weight=None)
         q_config.add_type_config(

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""One-shot TPU perf sweep: the A/B matrix the round-4/5 verdicts asked
+for, runnable the moment the chip is claimable.
+
+Runs bench.py children (same watchdog/backoff discipline) over:
+  - flagship 1.3B rung (the BENCH_r0N headline)
+  - fused-AdamW A/B (BENCH_FUSED_ADAM=1 vs XLA-composed)
+  - seq=2048 (long-context rung)
+  - flash-attention block-size variants (FLAGS_flash_block_q/kv)
+and writes ONE json report to --out (default TPU_SWEEP.json).
+
+Usage:  python tools/tpu_sweep.py [--out TPU_SWEEP.json] [--quick]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def probe(timeout=300.0) -> bool:
+    code = "import jax; print(jax.devices()[0].platform)"
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout)
+        return p.returncode == 0 and "cpu" not in (p.stdout or "")
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_case(name, env_extra, timeout=1200.0):
+    env = dict(os.environ)
+    env.update(env_extra)
+    t0 = time.time()
+    try:
+        p = subprocess.run(
+            [sys.executable, BENCH, "--child"], env=env, cwd=REPO,
+            timeout=timeout, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return {"case": name, "ok": False, "error": f"timeout {timeout}s"}
+    line = next((ln for ln in (p.stdout or "").splitlines()
+                 if ln.strip().startswith("{") and '"metric"' in ln), None)
+    rec = {"case": name, "ok": p.returncode == 0 and line is not None,
+           "wall_s": round(time.time() - t0, 1)}
+    if line:
+        rec["result"] = json.loads(line)
+    elif p.returncode != 0:
+        rec["error"] = (p.stderr or "")[-500:]
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "TPU_SWEEP.json"))
+    ap.add_argument("--quick", action="store_true",
+                    help="flagship + fused-adam A/B only")
+    args = ap.parse_args()
+
+    if not probe():
+        print("tpu_sweep: TPU backend not claimable; aborting "
+              "(no CPU fallback — this tool only measures the chip)")
+        sys.exit(2)
+
+    cases = [
+        ("flagship_1p3b_bs8_seq1024",
+         {"BENCH_CONFIG": "1p3b:8:1024:10:1:1"}),
+        ("fused_adam_1p3b_bs8_seq1024",
+         {"BENCH_CONFIG": "1p3b:8:1024:10:1:1", "BENCH_FUSED_ADAM": "1"}),
+    ]
+    if not args.quick:
+        cases += [
+            ("seq2048_1p3b_bs4",
+             {"BENCH_CONFIG": "1p3b:4:2048:10:1:1"}),
+            ("seq2048_1p3b_bs2",
+             {"BENCH_CONFIG": "1p3b:2:2048:10:1:1"}),
+            ("bs16_1p3b_seq1024",
+             {"BENCH_CONFIG": "1p3b:16:1024:10:1:1"}),
+            ("no_remat_1p3b_bs4",
+             {"BENCH_CONFIG": "1p3b:4:1024:10:0:1"}),
+            ("flash_block_256_1p3b_bs8",
+             {"BENCH_CONFIG": "1p3b:8:1024:10:1:1",
+              "FLAGS_flash_block_q": "256",
+              "FLAGS_flash_block_kv": "256"}),
+            ("flash_block_q256_kv512_1p3b_bs8",
+             {"BENCH_CONFIG": "1p3b:8:1024:10:1:1",
+              "FLAGS_flash_block_q": "256",
+              "FLAGS_flash_block_kv": "512"}),
+            ("flash_block_1024_1p3b_bs8",
+             {"BENCH_CONFIG": "1p3b:8:1024:10:1:1",
+              "FLAGS_flash_block_q": "1024",
+              "FLAGS_flash_block_kv": "1024"}),
+        ]
+
+    report = {"started": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                       time.gmtime()),
+              "cases": []}
+    for name, env_extra in cases:
+        print(f"tpu_sweep: running {name} ...", flush=True)
+        rec = run_case(name, env_extra)
+        print(f"tpu_sweep: {name}: "
+              f"{rec.get('result', rec.get('error'))}", flush=True)
+        report["cases"].append(rec)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    print(f"tpu_sweep: wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
