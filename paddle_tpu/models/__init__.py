@@ -16,3 +16,6 @@ from .gpt import (  # noqa: F401
     gpt_1p3b,
     gpt_13b,
 )
+from .ernie_moe import (  # noqa: F401
+    ErnieMoEConfig, ErnieMoEForPretraining, ErnieMoEModel, ernie_moe_tiny,
+)

@@ -1,0 +1,133 @@
+"""ERNIE-MoE — the BASELINE config-4 model family (reference:
+ERNIE-3.0-style expert-parallel pretraining over
+python/paddle/incubate/distributed/models/moe/moe_layer.py:261 MoELayer;
+fixture shape in the reference MoE tests).
+
+A pre-LN transformer LM where every ``moe_every``-th block's FFN is an
+``MoELayer`` (GShard top-k gating + optional explicit ``lax.all_to_all``
+expert parallelism over the mesh's 'ep' axis); blocks ARE
+``GPTDecoderLayer`` with the FFN swapped, so residual structure,
+sequence-parallel re-constraints and recompute behave exactly like the
+GPT family.  The gate aux losses accumulate on the model and join the
+LM loss — the reference's balance-loss wiring.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..incubate.distributed.models.moe import MoELayer
+from ..nn import Layer, LayerNorm
+from ..distributed.fleet.recompute import recompute
+from ..tensor import Tensor
+from .gpt import (
+    GPTConfig, GPTDecoderLayer, GPTEmbeddings, GPTPretrainingCriterion,
+)
+
+__all__ = ["ErnieMoEConfig", "ErnieMoEModel", "ErnieMoEForPretraining",
+           "ernie_moe_tiny"]
+
+
+class ErnieMoEConfig(GPTConfig):
+    """GPTConfig + MoE knobs (kept a dataclass-compatible subclass so
+    every GPT component accepts it unchanged)."""
+
+    def __init__(self, *args, num_experts: int = 8, top_k: int = 2,
+                 moe_every: int = 2, d_expert_hidden: Optional[int] = None,
+                 gate: str = "gshard", dispatch_mode: str = "dense",
+                 aux_loss_weight: float = 0.01, **kw):
+        super().__init__(*args, **kw)
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.moe_every = moe_every
+        self.d_expert_hidden = d_expert_hidden or self.ffn_size
+        self.gate = gate
+        self.dispatch_mode = dispatch_mode
+        self.aux_loss_weight = aux_loss_weight
+
+
+def ernie_moe_tiny(**kw) -> ErnieMoEConfig:
+    base = dict(vocab_size=1024, hidden_size=64, num_layers=4,
+                num_heads=4, max_position_embeddings=128,
+                num_experts=4, top_k=2, moe_every=2)
+    base.update(kw)
+    return ErnieMoEConfig(**base)
+
+
+class ErnieMoEBlock(GPTDecoderLayer):
+    """GPTDecoderLayer with the dense MLP swapped for an MoELayer — the
+    residual layout, _seq_shard re-constraint and attention path are
+    inherited, not copied."""
+
+    def __init__(self, cfg: ErnieMoEConfig, use_moe: bool):
+        super().__init__(cfg)
+        self.is_moe = use_moe
+        if use_moe:
+            # replace (re-registers under the same sublayer name)
+            self.mlp = MoELayer(d_model=cfg.hidden_size,
+                                num_experts=cfg.num_experts,
+                                gate=cfg.gate, top_k=cfg.top_k,
+                                d_hidden=cfg.d_expert_hidden,
+                                dispatch_mode=cfg.dispatch_mode)
+
+
+class ErnieMoEModel(Layer):
+    def __init__(self, cfg: ErnieMoEConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = GPTEmbeddings(cfg)
+        self.blocks = []
+        for i in range(cfg.num_layers):
+            blk = ErnieMoEBlock(cfg, use_moe=(i % cfg.moe_every
+                                              == cfg.moe_every - 1))
+            self.add_sublayer(f"block_{i}", blk)
+            self.blocks.append(blk)
+        self.final_ln = LayerNorm(cfg.hidden_size,
+                                  epsilon=cfg.layer_norm_eps)
+
+    def forward(self, input_ids: Tensor, position_ids=None,
+                attn_mask=None) -> Tensor:
+        h = self.embeddings(input_ids, position_ids)
+        k = self.config.recompute_interval
+        for i, blk in enumerate(self.blocks):
+            if k and (i % k == 0) and self.training:
+                h = recompute(blk, h, attn_mask)
+            else:
+                h = blk(h, attn_mask)
+        return self.final_ln(h)
+
+    def moe_aux_loss(self):
+        """Sum of the gate balance losses of every MoE block (fresh per
+        forward — MoELayer overwrites aux_loss each call)."""
+        total = None
+        for blk in self.blocks:
+            if blk.is_moe and getattr(blk.mlp, "aux_loss", None) is not None:
+                total = (blk.mlp.aux_loss if total is None
+                         else total + blk.mlp.aux_loss)
+        return total
+
+
+class ErnieMoEForPretraining(Layer):
+    """LM head tied to the word embeddings + aux-loss wiring; forward
+    with labels returns loss = LM + aux_loss_weight * balance."""
+
+    def __init__(self, cfg: ErnieMoEConfig):
+        super().__init__()
+        self.config = cfg
+        self.ernie = ErnieMoEModel(cfg)
+        self._crit = GPTPretrainingCriterion(cfg)
+
+    def forward(self, input_ids: Tensor, position_ids=None,
+                attn_mask: Optional[Tensor] = None,
+                labels: Optional[Tensor] = None):
+        from .. import ops
+
+        h = self.ernie(input_ids, position_ids, attn_mask)
+        w = self.ernie.embeddings.word_embeddings.weight
+        logits = ops.matmul(h, w, transpose_y=True)
+        if labels is None:
+            return logits
+        loss = self._crit(logits, labels)
+        aux = self.ernie.moe_aux_loss()
+        if aux is not None:
+            loss = loss + self.config.aux_loss_weight * aux
+        return loss
