@@ -169,3 +169,8 @@ from . import onnx  # noqa: E402,F401
 from . import device  # noqa: E402,F401
 from . import version  # noqa: E402,F401
 from .version import __version__  # noqa: E402,F401
+from . import regularizer  # noqa: E402,F401
+from . import utils  # noqa: E402,F401
+from . import sysconfig  # noqa: E402,F401
+from . import hub  # noqa: E402,F401
+from .hapi import callbacks  # noqa: E402,F401

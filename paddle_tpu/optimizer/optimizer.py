@@ -163,13 +163,17 @@ class Optimizer:
         raise NotImplementedError
 
     def _decayed_grad(self, p, g_raw):
-        """L2 regularization folded into the gradient (reference: regularizer
-        appended in _create_optimization_pass)."""
+        """Regularization folded into the gradient (reference: regularizer
+        appended in _create_optimization_pass).  Floats and L2Decay add
+        ``coeff * p``; L1Decay adds ``coeff * sign(p)`` — regularizer
+        objects are callables on the raw parameter value."""
         wd = self._weight_decay
         if wd is None:
             return g_raw
-        if isinstance(wd, float):
-            return g_raw + wd * p._value
+        if isinstance(wd, (int, float)):
+            return g_raw + float(wd) * p._value
+        if callable(wd):
+            return g_raw + wd(p._value)
         coeff = getattr(wd, "_coeff", None)
         if coeff is not None:
             return g_raw + coeff * p._value

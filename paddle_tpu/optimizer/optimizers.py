@@ -161,11 +161,17 @@ class Adam(Optimizer):
             self._apply_one(p, g)
 
     def _decayed(self, p, g_raw, pv):
+        """Same dispatch as Optimizer._decayed_grad, applied to the
+        (possibly master fp32) parameter value: floats add coeff*pv,
+        regularizer objects are CALLED (L1Decay adds coeff*sign(pv))."""
         wd = self._weight_decay
         if wd is None:
             return g_raw
-        coeff = wd if isinstance(wd, float) else getattr(wd, "_coeff", 0.0)
-        return g_raw + coeff * pv
+        if isinstance(wd, (int, float)):
+            return g_raw + float(wd) * pv
+        if callable(wd):
+            return g_raw + wd(pv)
+        return g_raw + getattr(wd, "_coeff", 0.0) * pv
 
     def _apply_one(self, p, g):
         lr = self._lr_value()
@@ -206,7 +212,19 @@ class AdamW(Adam):
                  parameters=None, weight_decay=0.01, lr_ratio=None,
                  apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
                  multi_precision=True, use_fused_kernel=False, name=None):
-        self._wd_coeff = weight_decay if isinstance(weight_decay, float) else getattr(weight_decay, "_coeff", 0.01)
+        # decoupled decay is L2 BY CONSTRUCTION (p *= 1 - lr*coeff):
+        # an L1Decay here would silently become L2, so reject it
+        # (reference AdamW takes float coefficients only)
+        from ..regularizer import L1Decay
+
+        if isinstance(weight_decay, L1Decay):
+            raise TypeError(
+                "AdamW applies DECOUPLED L2 decay; L1Decay cannot be "
+                "expressed here — use Adam(weight_decay=L1Decay(...)) "
+                "for L1 regularization")
+        self._wd_coeff = (float(weight_decay)
+                          if isinstance(weight_decay, (int, float))
+                          else getattr(weight_decay, "_coeff", 0.01))
         self._apply_decay_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
         self._use_fused_kernel = use_fused_kernel

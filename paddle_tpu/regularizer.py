@@ -1,0 +1,33 @@
+"""Weight regularizers (reference: python/paddle/regularizer.py —
+L1Decay/L2Decay appended to gradients in _create_optimization_pass).
+
+Folded into the gradient on the device (one fused epilogue under jit):
+L2 adds ``coeff * p``, L1 adds ``coeff * sign(p)``.
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class L1Decay:
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    def __call__(self, p_value):
+        import jax.numpy as jnp
+
+        return self._coeff * jnp.sign(p_value)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self._coeff})"
+
+
+class L2Decay:
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    def __call__(self, p_value):
+        return self._coeff * p_value
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self._coeff})"

@@ -1,0 +1,18 @@
+"""reference python/paddle/sysconfig.py: include/lib dirs (here: the
+package's own paths — there is no compiled libpaddle; native pieces live
+under core/native)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    return os.path.join(_ROOT, "include")
+
+
+def get_lib():
+    return os.path.join(_ROOT, "libs")

@@ -1,0 +1,7 @@
+"""paddle.utils analog (reference: python/paddle/utils/ — dlpack
+interchange, deprecated decorator, try_import, unique_name)."""
+from . import dlpack  # noqa: F401
+from .lazy import try_import  # noqa: F401
+from .decorator import deprecated  # noqa: F401
+
+__all__ = ["dlpack", "try_import", "deprecated"]

@@ -1,0 +1,29 @@
+"""reference python/paddle/utils/deprecated.py."""
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 1):
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            msg = f"API {fn.__module__}.{fn.__name__} is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap

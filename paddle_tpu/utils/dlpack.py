@@ -1,0 +1,20 @@
+"""DLPack interchange (reference python/paddle/utils/dlpack.py) over
+jax's zero-copy dlpack support — tensors exchange with torch/numpy/cupy
+without host round-trips where the backends allow it."""
+from __future__ import annotations
+
+import jax
+
+from ..tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x: Tensor):
+    arr = x._value if isinstance(x, Tensor) else x
+    # modern protocol: the array itself is a dlpack capsule provider
+    return arr.__dlpack__()
+
+
+def from_dlpack(capsule) -> Tensor:
+    return Tensor(jax.numpy.from_dlpack(capsule))

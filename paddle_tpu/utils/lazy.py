@@ -1,0 +1,14 @@
+"""reference python/paddle/utils/lazy_import.py try_import."""
+from __future__ import annotations
+
+import importlib
+
+__all__ = ["try_import"]
+
+
+def try_import(module_name: str, err_msg: str = None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed")

@@ -1,0 +1,107 @@
+"""Top-level namespace parity: regularizer, utils (dlpack/try_import/
+deprecated), sysconfig, hub, callbacks alias (reference:
+python/paddle/{regularizer,sysconfig}.py, utils/, hapi/hub.py)."""
+import os
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as pt
+
+
+def test_regularizer_l2_matches_float_and_l1_signs():
+    pt.seed(0)
+    w1 = pt.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    w2 = pt.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    o1 = pt.optimizer.SGD(learning_rate=0.1, parameters=[w1],
+                          weight_decay=0.5)
+    o2 = pt.optimizer.SGD(learning_rate=0.1, parameters=[w2],
+                          weight_decay=pt.regularizer.L2Decay(0.5))
+    for w, o in ((w1, o1), (w2, o2)):
+        w.grad = pt.to_tensor(np.zeros((4,), np.float32))
+        o.step()
+    np.testing.assert_allclose(w1.numpy(), w2.numpy())
+
+    w3 = pt.to_tensor(np.array([1., -1., 2., -2.], np.float32),
+                      stop_gradient=False)
+    o3 = pt.optimizer.SGD(learning_rate=0.1, parameters=[w3],
+                          weight_decay=pt.regularizer.L1Decay(0.5))
+    w3.grad = pt.to_tensor(np.zeros((4,), np.float32))
+    o3.step()
+    np.testing.assert_allclose(w3.numpy(), [0.95, -0.95, 1.95, -1.95],
+                               rtol=1e-6)
+
+
+def test_dlpack_interchange_with_torch():
+    t = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    tt = torch.from_dlpack(pt.utils.dlpack.to_dlpack(t))
+    np.testing.assert_allclose(tt.numpy(), t.numpy())
+    back = pt.utils.dlpack.from_dlpack(torch.arange(4).float())
+    np.testing.assert_allclose(back.numpy(), [0, 1, 2, 3])
+
+
+def test_hub_local_entrypoints(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def lenet(num_classes=10):\n"
+        "    'LeNet entrypoint'\n"
+        "    from paddle_tpu.vision.models import LeNet\n"
+        "    return LeNet(num_classes=num_classes)\n")
+    d = str(tmp_path)
+    assert pt.hub.list(d) == ["lenet"]
+    assert "LeNet" in pt.hub.help(d, "lenet")
+    m = pt.hub.load(d, "lenet", num_classes=4)
+    out = m(pt.to_tensor(np.zeros((1, 1, 28, 28), np.float32)))
+    assert out.shape == [1, 4]
+    with pytest.raises(NotImplementedError):
+        pt.hub.load("o/r", "m", source="github")
+
+
+def test_utils_misc_and_sysconfig():
+    assert pt.utils.try_import("numpy") is np
+    with pytest.raises(ImportError):
+        pt.utils.try_import("definitely_not_a_module_xyz")
+
+    calls = []
+
+    @pt.utils.deprecated(update_to="new_fn", since="2.0")
+    def old_fn():
+        calls.append(1)
+        return 7
+
+    with pytest.warns(DeprecationWarning):
+        assert old_fn() == 7
+    assert calls == [1]
+
+    assert os.path.basename(os.path.dirname(pt.sysconfig.get_include())) \
+        == "paddle_tpu"
+    # callbacks alias (paddle.callbacks surface)
+    assert pt.callbacks.EarlyStopping is pt.hapi.EarlyStopping
+
+
+def test_regularizer_through_adam_family():
+    """Review fixes: Adam honors L1Decay callables; AdamW rejects
+    L1Decay (decoupled decay is L2 by construction); int decay counts."""
+    w = pt.to_tensor(np.array([1., -1.], np.float32), stop_gradient=False)
+    opt = pt.optimizer.Adam(learning_rate=0.1, parameters=[w],
+                            weight_decay=pt.regularizer.L1Decay(0.5))
+    w.grad = pt.to_tensor(np.zeros((2,), np.float32))
+    opt.step()
+    # L1: effective grad = 0.5*sign(p) -> both entries move TOWARD zero
+    # by the same magnitude (Adam normalizes magnitude, sign survives)
+    out = w.numpy()
+    assert out[0] < 1.0 and out[1] > -1.0
+    np.testing.assert_allclose(abs(out[0] - 1.0), abs(out[1] + 1.0),
+                               rtol=1e-5)
+
+    with pytest.raises(TypeError):
+        pt.optimizer.AdamW(learning_rate=0.1, parameters=[w],
+                           weight_decay=pt.regularizer.L1Decay(0.5))
+
+    # int weight_decay is honored, not silently dropped
+    w2 = pt.to_tensor(np.ones((2,), np.float32), stop_gradient=False)
+    o2 = pt.optimizer.SGD(learning_rate=0.1, parameters=[w2],
+                          weight_decay=1)
+    w2.grad = pt.to_tensor(np.zeros((2,), np.float32))
+    o2.step()
+    np.testing.assert_allclose(w2.numpy(), [0.9, 0.9], rtol=1e-6)
