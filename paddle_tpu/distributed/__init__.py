@@ -27,3 +27,9 @@ from . import sharding  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .auto_parallel.api import shard_tensor, shard_op, dtensor_from_fn  # noqa: F401
 from .auto_parallel.process_mesh import ProcessMesh  # noqa: F401
+from . import stream  # noqa: F401
+from .collective import (  # noqa: F401
+    P2POp, all_gather_object, batch_isend_irecv, broadcast_object_list,
+    destroy_process_group, gather, scatter_object_list, wait,
+)
+from .auto_parallel.api import reshard  # noqa: F401

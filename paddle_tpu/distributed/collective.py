@@ -330,3 +330,178 @@ def barrier(group=None):
 
 def get_backend(group=None):
     return "xla"
+
+
+# -- object collectives (reference communication/all_gather.py
+#    all_gather_object & friends: pickle + tensor transport; here the
+#    transport is the job's TCPStore on multi-host, trivial in-process) --
+
+def _obj_pack(obj) -> bytes:
+    import pickle
+
+    return pickle.dumps(obj)
+
+
+def _obj_unpack(blob: bytes):
+    import pickle
+
+    return pickle.loads(blob)
+
+
+_OBJ_SEQ = [0]
+
+
+def _obj_timeout() -> float:
+    """Same patience as recv(): peers may sit in minute-long XLA
+    compiles before posting."""
+    import os as _os
+
+    return float(_os.environ.get("PADDLE_P2P_TIMEOUT", "3600"))
+
+
+def _require_store(ws):
+    from .env import get_store
+
+    store = get_store()
+    if ws > 1 and store is None:
+        raise RuntimeError(
+            "multi-host object collective needs the job's TCPStore, but "
+            "the init_parallel_env rendezvous did not produce one — "
+            "check PADDLE_MASTER and that rank 0 is reachable")
+    return store
+
+
+def _store_exchange(obj, tag: str):
+    """Every rank posts its object; returns the list by rank.  Keys are
+    deleted after a completion barrier so the rank-0 store's memory
+    stays bounded over long jobs (same discipline as recv())."""
+    from .env import get_rank, get_world_size
+
+    ws = get_world_size()
+    if ws <= 1:
+        return [obj]
+    store = _require_store(ws)
+    _OBJ_SEQ[0] += 1
+    base = f"obj/{tag}/{_OBJ_SEQ[0]}"
+    store.set(f"{base}/{get_rank()}", _obj_pack(obj))
+    out = []
+    for r in range(ws):
+        out.append(_obj_unpack(store.wait(f"{base}/{r}",
+                                          timeout=_obj_timeout())))
+    store.barrier(f"{base}/done", ws, timeout=_obj_timeout())
+    if get_rank() == 0:
+        for r in range(ws):
+            store.delete(f"{base}/{r}")
+    return out
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.extend(_store_exchange(obj, "ag"))
+    return object_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Single-key form: only src serializes/uploads; everyone else
+    downloads that one key (O(payload), and non-src placeholder lists
+    are never pickled)."""
+    from .env import get_rank, get_world_size
+
+    ws = get_world_size()
+    if ws <= 1:
+        return object_list
+    store = _require_store(ws)
+    _OBJ_SEQ[0] += 1
+    base = f"obj/bc/{_OBJ_SEQ[0]}"
+    if get_rank() == src:
+        store.set(base, _obj_pack(list(object_list)))
+    object_list[:] = _obj_unpack(store.wait(base, timeout=_obj_timeout()))
+    store.barrier(f"{base}/done", ws, timeout=_obj_timeout())
+    if get_rank() == src:
+        store.delete(base)
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    from .env import get_rank, get_world_size
+
+    ws = get_world_size()
+    if ws <= 1:
+        # each rank receives its element: rank 0 gets entry 0
+        out_object_list.append((in_object_list or [None])[0])
+        return out_object_list
+    store = _require_store(ws)
+    _OBJ_SEQ[0] += 1
+    base = f"obj/sc/{_OBJ_SEQ[0]}"
+    if get_rank() == src:
+        store.set(base, _obj_pack(list(in_object_list)))
+    scattered = _obj_unpack(store.wait(base, timeout=_obj_timeout()))
+    out_object_list.append(scattered[get_rank()])
+    store.barrier(f"{base}/done", ws, timeout=_obj_timeout())
+    if get_rank() == src:
+        store.delete(base)
+    return out_object_list
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """reference communication/gather.py: like all_gather but only dst
+    keeps the result.  In-mesh SPMD values are controller-replicated so
+    this IS all_gather; ACROSS HOSTS each controller's local value ships
+    through the store and only dst materializes the list."""
+    ax = _axis(group)
+    if not _in_mapped_context(ax) and _cross_host():
+        from .env import get_rank
+        import jax.numpy as _jnp
+
+        vals = _store_exchange(np.asarray(tensor._value), "gather")
+        if get_rank() == dst:
+            if gather_list is not None:
+                gather_list.extend(Tensor(_jnp.asarray(v)) for v in vals)
+                return gather_list
+            return [Tensor(_jnp.asarray(v)) for v in vals]
+        return None
+    return all_gather(gather_list, tensor, group=group)
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Block until the tensor's async computation lands (the reference
+    waits on the communication stream; XLA's async dispatch is awaited
+    via block_until_ready)."""
+    jax.block_until_ready(tensor._value if isinstance(tensor, Tensor)
+                          else tensor)
+    return tensor
+
+
+def destroy_process_group(group=None):
+    """Tear down process-group state (reference
+    communication/group.py:destroy_process_group)."""
+    from . import env as _env
+
+    if group is None:
+        _P2P_SEQ.clear()
+        _P2P_STAGE.clear()
+        _OBJ_SEQ[0] = 0
+        _BARRIER_SEQ[0] = 0
+        _env._store = None
+        _env._initialized = False
+        _env._parallel_env = None
+
+
+class P2POp:
+    """reference communication/batch_isend_irecv.py P2POp."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv, send, recv):
+            raise ValueError("P2POp op must be isend/irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Execute a batch of P2POps; returns (already-completed) tasks."""
+    tasks = []
+    for p in p2p_op_list:
+        tasks.append(p.op(p.tensor, p.peer, group=p.group))
+    return tasks

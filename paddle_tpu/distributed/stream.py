@@ -1,0 +1,16 @@
+"""paddle.distributed.stream analog (reference
+distributed/communication/stream/*): the stream-explicit collective
+variants.  TPU/XLA has no user-visible communication streams — each
+collective is a program op ordered by data dependence — so these
+delegate to the synchronous forms (``use_calc_stream`` accepted and
+irrelevant)."""
+from __future__ import annotations
+
+from .collective import (  # noqa: F401
+    all_gather, all_reduce, alltoall, alltoall_single, broadcast,
+    gather, recv, reduce, reduce_scatter, scatter, send,
+)
+
+__all__ = ["all_gather", "all_reduce", "alltoall", "alltoall_single",
+           "broadcast", "gather", "recv", "reduce", "reduce_scatter",
+           "scatter", "send"]
