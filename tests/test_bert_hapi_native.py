@@ -33,7 +33,7 @@ def test_bert_pretraining_trains():
     opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
     ids, seg, pos, mlm_labels, nsp = _bert_batch(cfg)
     losses = []
-    for _ in range(4):
+    for _ in range(3):
         mlm_logits, nsp_logits = m(ids, token_type_ids=seg, masked_positions=pos)
         loss = crit(mlm_logits, nsp_logits, mlm_labels, nsp)
         loss.backward()

@@ -52,6 +52,7 @@ def test_pipeline_blocks_matches_scan(pp_mesh):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_blocks_grad_matches(pp_mesh):
     L, h, mbs, mb, s = 4, 8, 4, 2, 6
     rng = np.random.RandomState(1)

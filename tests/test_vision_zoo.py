@@ -10,7 +10,7 @@ from paddle_tpu.vision import models as M
 from paddle_tpu.vision import transforms as T
 
 
-def _check_forward(mk, size=64):
+def _check_forward(mk, size=32):
     pt.seed(0)
     m = mk()
     m.eval()
@@ -23,10 +23,17 @@ def _check_forward(mk, size=64):
 
 @pytest.mark.parametrize("mk", [
     lambda: M.squeezenet1_1(num_classes=10),
+], ids=["squeezenet1_1"])
+def test_zoo_forward_fast(mk):
+    _check_forward(mk)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mk", [
     lambda: M.shufflenet_v2_x0_25(num_classes=10),
     lambda: M.mobilenet_v1(scale=0.25, num_classes=10),
-], ids=["squeezenet1_1", "shufflenet_x0_25", "mobilenet_v1"])
-def test_zoo_forward_fast(mk):
+], ids=["shufflenet_x0_25", "mobilenet_v1"])
+def test_zoo_forward_more(mk):
     _check_forward(mk)
 
 
@@ -52,8 +59,8 @@ def test_zoo_backward_one_family():
     pt.seed(0)
     m = M.squeezenet1_1(num_classes=4)
     x = pt.to_tensor(np.random.RandomState(0)
-                     .randn(2, 3, 48, 48).astype(np.float32))
-    y = pt.to_tensor(np.array([1, 2], np.int64))
+                     .randn(1, 3, 32, 32).astype(np.float32))
+    y = pt.to_tensor(np.array([1], np.int64))
     loss = pt.nn.functional.cross_entropy(m(x), y)
     loss.backward()
     grads = [p.grad for p in m.parameters() if not p.stop_gradient]
