@@ -19,7 +19,9 @@ import numpy as np
 
 from ...io import Dataset
 
-__all__ = ["FakeData", "MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+__all__ = ["FakeData", "MNIST", "FashionMNIST", "Cifar10",
+           "Cifar100", "DatasetFolder", "ImageFolder", "Flowers",
+           "VOC2012", "IMG_EXTENSIONS"]
 
 
 def _data_home():
@@ -191,3 +193,162 @@ class Cifar100(_CifarBase):
     _train_members = ("train",)
     _test_members = ("test",)
     _default_name = "cifar-100-python.tar.gz"
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                  ".tif", ".tiff", ".webp")
+
+
+def _pil_loader(path):
+    from PIL import Image
+
+    with open(path, "rb") as f:
+        return np.asarray(Image.open(f).convert("RGB"))
+
+
+def _scan_files(root, extensions, is_valid_file):
+    """Shared walk+filter for the folder datasets.  Passing BOTH an
+    extension list and is_valid_file is ambiguous (reference folder.py
+    raises the same way)."""
+    if extensions is not None and is_valid_file is not None:
+        raise ValueError(
+            "both extensions and is_valid_file cannot be passed — "
+            "use one filter")
+    exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+    out = []
+    for dirpath, _, files in sorted(os.walk(root)):
+        for fname in sorted(files):
+            path = os.path.join(dirpath, fname)
+            ok = (is_valid_file(path) if is_valid_file
+                  else fname.lower().endswith(exts))
+            if ok:
+                out.append(path)
+    return out
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class image dataset (reference
+    vision/datasets/folder.py DatasetFolder): root/<class_x>/xxx.ext."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _pil_loader
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            for path in _scan_files(os.path.join(root, c), extensions,
+                                    is_valid_file):
+                self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"found no image files under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive unlabeled image folder (reference folder.py
+    ImageFolder): every image under root, no labels."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _pil_loader
+        self.transform = transform
+        self.samples = _scan_files(root, extensions, is_valid_file)
+        if not self.samples:
+            raise RuntimeError(f"found no images under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference vision/datasets/flowers.py): jpg
+    archive + .mat label/setid files, read from local paths (no
+    download in this environment)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None):
+        if not (data_file and label_file and setid_file):
+            raise _missing("Flowers",
+                     ["data_file (jpg dir)", "label_file (imagelabels.mat)",
+                      "setid_file (setid.mat)"])
+        import scipy.io as sio
+
+        labels = sio.loadmat(label_file)["labels"][0]
+        setid = sio.loadmat(setid_file)
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.indexes = setid[key][0]
+        self.data_dir = data_file
+        self.labels = labels
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        flower_id = int(self.indexes[idx])
+        path = os.path.join(self.data_dir,
+                            f"image_{flower_id:05d}.jpg")
+        img = _pil_loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, int(self.labels[flower_id - 1]) - 1
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation pairs (reference
+    vision/datasets/voc2012.py): JPEGImages + SegmentationClass read
+    from a local VOCdevkit/VOC2012 directory."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if not data_file:
+            raise _missing("VOC2012",
+                     ["data_file (extracted VOCdevkit/VOC2012 dir)"])
+        root = data_file
+        split_file = os.path.join(
+            root, "ImageSets", "Segmentation",
+            {"train": "train", "valid": "val", "test": "val"}[mode]
+            + ".txt")
+        with open(split_file) as f:
+            self.ids = [ln.strip() for ln in f if ln.strip()]
+        self.root = root
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        name = self.ids[idx]
+        img = _pil_loader(os.path.join(self.root, "JPEGImages",
+                                       name + ".jpg"))
+        from PIL import Image
+
+        label = np.asarray(Image.open(os.path.join(
+            self.root, "SegmentationClass", name + ".png")))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.ids)

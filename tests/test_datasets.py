@@ -103,3 +103,70 @@ def test_fakedata_explicit_opt_in():
     img, lab = ds[0]
     assert img.shape == (1, 8, 8)
     assert 0 <= int(lab) < 3
+
+
+def test_dataset_folder_and_image_folder(tmp_path):
+    """reference vision/datasets/folder.py DatasetFolder/ImageFolder."""
+    from PIL import Image
+
+    from paddle_tpu.vision import transforms as T
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+    rng = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        (tmp_path / cls).mkdir()
+        for i in range(2):
+            Image.fromarray((rng.rand(8, 8, 3) * 255).astype(np.uint8)) \
+                .save(tmp_path / cls / f"{i}.png")
+    ds = DatasetFolder(str(tmp_path))
+    assert len(ds) == 4
+    assert ds.classes == ["cat", "dog"]
+    img, y = ds[0]
+    assert img.shape == (8, 8, 3) and y == 0
+    img2, _ = DatasetFolder(str(tmp_path),
+                            transform=T.Compose([T.ToTensor()]))[1]
+    assert img2.shape == (3, 8, 8)
+
+    imf = ImageFolder(str(tmp_path))
+    assert len(imf) == 4
+    assert imf[0][0].shape == (8, 8, 3)
+
+    with pytest.raises(RuntimeError):
+        DatasetFolder(str(tmp_path / "cat"))   # no class dirs
+
+
+def test_voc2012_local_layout(tmp_path):
+    from PIL import Image
+
+    from paddle_tpu.vision.datasets import VOC2012
+
+    root = tmp_path / "VOC2012"
+    (root / "ImageSets" / "Segmentation").mkdir(parents=True)
+    (root / "JPEGImages").mkdir()
+    (root / "SegmentationClass").mkdir()
+    rng = np.random.RandomState(0)
+    for name in ("a", "b"):
+        Image.fromarray((rng.rand(6, 6, 3) * 255).astype(np.uint8)) \
+            .save(root / "JPEGImages" / f"{name}.jpg")
+        Image.fromarray(rng.randint(0, 4, (6, 6)).astype(np.uint8)) \
+            .save(root / "SegmentationClass" / f"{name}.png")
+    (root / "ImageSets" / "Segmentation" / "train.txt").write_text("a\nb\n")
+    ds = VOC2012(data_file=str(root), mode="train")
+    assert len(ds) == 2
+    img, label = ds[0]
+    assert img.shape == (6, 6, 3) and label.shape == (6, 6)
+
+
+def test_folder_filters_and_missing_corpus_errors(tmp_path):
+    from paddle_tpu.vision.datasets import (
+        DatasetFolder, Flowers, VOC2012)
+
+    (tmp_path / "c").mkdir()
+    (tmp_path / "c" / "x.png").write_bytes(b"not-an-image")
+    with pytest.raises(ValueError):
+        DatasetFolder(str(tmp_path), extensions=(".png",),
+                      is_valid_file=lambda p: True)
+    with pytest.raises(FileNotFoundError):
+        Flowers()
+    with pytest.raises(FileNotFoundError):
+        VOC2012()
