@@ -436,6 +436,49 @@ def _propagate_eqns(jaxpr, var_specs, reshards, eqn_offset=0):
             else:
                 outs = [DistSpec.replicated(out_rank)]
             new_in, bad = list(specs), []
+        elif prim in ("scatter", "scatter-add", "dynamic_update_slice"):
+            # .at[].set/.add style updates keep the OPERAND's layout.
+            # A SHARDED or PARTIAL update/operand mismatch is a real
+            # collective (GSPMD reshards the update / psums the partial
+            # before a set), so record it — the cost model must see it.
+            s = specs[0]
+            is_add = prim == "scatter-add"
+            new_in = list(specs)
+            bad = []
+            if s.partial and not is_add:
+                new_in[0] = s.drop_partial()
+                bad.append(0)
+            for i in range(1, len(specs)):
+                sp = specs[i]
+                if sp.n_sharded or (sp.partial and not is_add):
+                    new_in[i] = DistSpec.replicated(len(sp.dims))
+                    bad.append(i)
+            # partial survives only through ADD (linear); set semantics
+            # mixes full and partial rows, which has no valid description
+            part = (frozenset().union(*[sp.partial for sp in specs])
+                    if is_add else frozenset())
+            outs = [DistSpec(s.dims, part)]
+        elif prim in ("cumsum", "cumprod", "cummax", "cummin",
+                      "cumlogsumexp", "sort"):
+            # axis-local scans/sorts: layout passes through; a shard on
+            # the scanned/sorted axis would need cross-shard carry, so
+            # drop it there.  Partial commutes only with the LINEAR
+            # cumsum; the others need the psum materialized first.
+            s = specs[0] if specs else DistSpec.replicated(
+                len(eqn.outvars[0].aval.shape))
+            new_in, bad = list(specs), []
+            if s.partial and prim != "cumsum":
+                new_in[0] = s.drop_partial()
+                bad = [0]
+                s = new_in[0]
+            ax_p = eqn.params.get("axis", eqn.params.get("dimension"))
+            dims = list(s.dims) if len(s.dims) == len(
+                eqn.outvars[0].aval.shape) else \
+                [None] * len(eqn.outvars[0].aval.shape)
+            if isinstance(ax_p, int) and 0 <= ax_p < len(dims):
+                dims[ax_p] = None
+            outs = [DistSpec(tuple(dims), s.partial)
+                    for _ in eqn.outvars]
         else:
             # unknown primitive: conservatively replicate outputs; a
             # sharded operand flowing in means GSPMD will gather it

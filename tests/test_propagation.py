@@ -209,3 +209,41 @@ def test_propagation_through_flagship_gpt_scan():
     prop = eng.propagate(mesh_axes={"dp": 2, "mp": 2})
     assert len(prop.var_specs) > 100          # specs assigned throughout
     assert prop.out_specs[0].dims == ()       # scalar loss
+
+
+def test_scatter_and_scan_primitive_rules():
+    """scatter-family keeps the operand layout; axis-local cumsum/sort
+    drop only the scanned axis's shard."""
+    def f(tbl, upd, x):
+        tbl2 = tbl.at[0].set(upd)          # dynamic_update_slice/scatter
+        c = jnp.cumsum(x, axis=1)
+        s = jnp.sort(x, axis=1)
+        return tbl2, c, s
+
+    tbl = np.zeros((8, 16), np.float32)
+    upd = np.zeros((16,), np.float32)
+    x = np.zeros((8, 16), np.float32)
+    closed = capture_jaxpr(f, tbl, upd, x)
+    res = propagate_jaxpr(closed, [DistSpec(("mp", None)), None,
+                                   DistSpec(("dp", "mp"))])
+    out_tbl, out_c, out_s = res.out_specs
+    assert out_tbl.dims == ("mp", None)          # operand layout kept
+    assert out_c.dims == ("dp", None)            # scanned axis dropped
+    assert out_s.dims == ("dp", None)            # sorted axis dropped
+
+
+def test_scatter_mismatched_update_records_reshard():
+    """A sharded update scattered into a differently-laid-out operand is
+    a real collective — the cost model must see it (review finding)."""
+    def f(tbl, upd):
+        return tbl.at[0].set(upd)
+
+    tbl = np.zeros((8, 16), np.float32)
+    upd = np.zeros((16,), np.float32)
+    closed = capture_jaxpr(f, tbl, upd)
+    res = propagate_jaxpr(closed, [DistSpec(("mp", None)),
+                                   DistSpec(("dp",))])
+    assert any(r.primitive in ("scatter", "dynamic_update_slice")
+               for r in res.reshards)
+    # set-semantics output carries NO pending-psum state
+    assert res.out_specs[0].partial == frozenset()
