@@ -1,0 +1,31 @@
+"""FLAGS_flash_block_q/kv tuning knobs (round-5: the on-chip block
+sweep lever; invalid overrides fall back to auto per side)."""
+import paddle_tpu as pt
+from paddle_tpu.core import flags as F
+from paddle_tpu.ops.pallas_kernels.flash_attention import _pick_blocks
+
+
+def _reset():
+    F.set_flags({"FLAGS_flash_block_q": 0, "FLAGS_flash_block_kv": 0})
+
+
+def test_flash_block_overrides():
+    _reset()
+    try:
+        assert _pick_blocks(1024) == (512, 512)
+        F.set_flags({"FLAGS_flash_block_q": 256})
+        assert _pick_blocks(1024) == (256, 512)
+        F.set_flags({"FLAGS_flash_block_kv": 128})
+        assert _pick_blocks(1024) == (256, 128)
+        # non-divisor falls back to auto on THAT side only
+        F.set_flags({"FLAGS_flash_block_q": 300})
+        assert _pick_blocks(1024) == (512, 128)
+        # negative / zero are auto
+        F.set_flags({"FLAGS_flash_block_q": -64,
+                     "FLAGS_flash_block_kv": 0})
+        assert _pick_blocks(1024) == (512, 512)
+        # override larger than s clamps to s when divisible
+        F.set_flags({"FLAGS_flash_block_q": 4096})
+        assert _pick_blocks(256) == (256, 256)
+    finally:
+        _reset()
