@@ -1,16 +1,14 @@
 """FLAGS_flash_block_q/kv tuning knobs (round-5: the on-chip block
 sweep lever; invalid overrides fall back to auto per side)."""
-import paddle_tpu as pt
 from paddle_tpu.core import flags as F
 from paddle_tpu.ops.pallas_kernels.flash_attention import _pick_blocks
 
-
-def _reset():
-    F.set_flags({"FLAGS_flash_block_q": 0, "FLAGS_flash_block_kv": 0})
+_NAMES = ["FLAGS_flash_block_q", "FLAGS_flash_block_kv"]
 
 
 def test_flash_block_overrides():
-    _reset()
+    saved = F.get_flags(_NAMES)
+    F.set_flags({n: 0 for n in _NAMES})
     try:
         assert _pick_blocks(1024) == (512, 512)
         F.set_flags({"FLAGS_flash_block_q": 256})
@@ -28,4 +26,4 @@ def test_flash_block_overrides():
         F.set_flags({"FLAGS_flash_block_q": 4096})
         assert _pick_blocks(256) == (256, 256)
     finally:
-        _reset()
+        F.set_flags(saved)   # restore env-configured values
