@@ -1,0 +1,135 @@
+"""Fused residual-add + RMSNorm as one Pallas TPU kernel.
+
+Reference analog: the fused norm kernels under
+paddle/phi/kernels/fusion/ (fused_bias_residual_layernorm /
+rms_norm_kernel) that modern-LLM blocks call between attention and FFN.
+
+TPU-native: one VMEM pass computes h = x + residual, the row-wise RMS
+statistic, and the scaled output — the residual sum is never written to
+HBM separately (the usual extra round-trip when XLA schedules the add
+and the norm apart).  Returns BOTH the normalized output and h (the
+carry the next residual needs).  Backward is XLA autodiff over the
+same math via custom_vjp recompute — the fused win is the fwd HBM
+traffic; bwd reuses XLA's fusion.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def np_prod(xs):
+    out = 1
+    for v in xs:
+        out *= int(v)
+    return out
+
+__all__ = ["fused_add_rms_norm", "shape_supported"]
+
+_BLOCK_ROWS = 256
+
+
+def shape_supported(hidden: int) -> bool:
+    """Lane constraint: the hidden (row) dim must tile the 128-wide
+    lanes."""
+    return hidden % 128 == 0
+
+
+def _kernel(x_ref, r_ref, g_ref, o_ref, h_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    r = r_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    h = x + r
+    ms = jnp.mean(h * h, axis=-1, keepdims=True)
+    o = h * jax.lax.rsqrt(ms + eps) * g
+    o_ref[...] = o.astype(o_ref.dtype)
+    h_ref[...] = h.astype(h_ref.dtype)
+
+
+def _pick_rows(rows: int, hdim: int) -> int:
+    """Largest power-of-two row block that (a) divides rows, (b) stays
+    inside the VMEM budget: 4 buffers of block*hdim*4B within ~8 MiB
+    (the same discipline fused_adamw documents)."""
+    if rows <= 0:
+        return 0
+    cap = max(1, (8 * 2 ** 20) // (16 * hdim))
+    b = min(_BLOCK_ROWS, rows, cap)
+    # round down to a power of two
+    while b & (b - 1):
+        b &= b - 1
+    while b > 1 and rows % b:
+        b //= 2
+    return b
+
+
+def _fwd_impl(x, r, g, eps, interpret):
+    shape = x.shape
+    hdim = shape[-1]
+    x2 = x.reshape(-1, hdim)
+    r2 = r.reshape(-1, hdim)
+    rows = x2.shape[0]
+    block = _pick_rows(rows, hdim)
+    grid = (rows // block,)
+    out, h = pl.pallas_call(
+        functools.partial(_kernel, eps=float(eps)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block, hdim), lambda i: (i, 0)),
+            pl.BlockSpec((block, hdim), lambda i: (i, 0)),
+            pl.BlockSpec((1, hdim), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block, hdim), lambda i: (i, 0)),
+            pl.BlockSpec((block, hdim), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x2.shape, x.dtype),
+            jax.ShapeDtypeStruct(x2.shape, x.dtype),
+        ],
+        interpret=interpret,
+    )(x2, r2, g.reshape(1, hdim))
+    return out.reshape(shape), h.reshape(shape)
+
+
+def _reference(x, r, g, eps):
+    h = (x + r).astype(jnp.float32)
+    ms = jnp.mean(h * h, axis=-1, keepdims=True)
+    out = h * jax.lax.rsqrt(ms + eps) * g.astype(jnp.float32)
+    return out.astype(x.dtype), h.astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_add_rms_norm(x, residual, weight, eps=1e-6, interpret=False):
+    """(normed, h) where h = x + residual and
+    normed = rms_norm(h) * weight — one fused VMEM pass on TPU, the
+    plain XLA expression elsewhere/ineligible shapes."""
+    out, h = _fused_fwd(x, residual, weight, eps, interpret)
+    return out, h
+
+
+def _fused_fwd(x, r, g, eps, interpret):
+    from .flash_attention import _on_tpu
+
+    rows = int(np_prod(x.shape[:-1]))
+    eligible = (shape_supported(x.shape[-1]) and rows > 0
+                and _pick_rows(rows, x.shape[-1]) >= 8)
+    if (interpret or _on_tpu()) and eligible:
+        return _fwd_impl(x, r, g, eps, interpret)
+    return _reference(x, r, g, eps)
+
+
+def _vjp_fwd(x, r, g, eps, interpret):
+    out, h = _fused_fwd(x, r, g, eps, interpret)
+    return (out, h), (x, r, g)
+
+
+def _vjp_bwd(eps, interpret, res, cts):
+    x, r, g = res
+    _, vjp = jax.vjp(lambda a, b, c: _reference(a, b, c, eps), x, r, g)
+    return vjp(cts)
+
+
+fused_add_rms_norm.defvjp(_vjp_fwd, _vjp_bwd)

@@ -1,0 +1,70 @@
+"""Owned Pallas fused residual-add + RMSNorm kernel (reference
+fusion/fused_bias_residual_layernorm analog) — interpret-mode parity
+(the CPU check discipline used for flash-attn and fused AdamW)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas_kernels.rms_norm import (
+    _reference, fused_add_rms_norm, shape_supported)
+
+
+def test_fused_add_rms_norm_interpret_parity():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(6, 256).astype(np.float32))
+    r = jnp.asarray(rng.randn(6, 256).astype(np.float32))
+    g = jnp.asarray(rng.randn(256).astype(np.float32))
+    out, h = fused_add_rms_norm(x, r, g, 1e-6, True)
+    ref_out, ref_h = _reference(x, r, g, 1e-6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(ref_h))
+
+    def loss(fn):
+        def inner(a, b, c):
+            o, hh = fn(a, b, c)
+            return jnp.sum(o * o) + jnp.sum(hh)
+        return inner
+
+    g1 = jax.grad(loss(lambda a, b, c: fused_add_rms_norm(
+        a, b, c, 1e-6, True)), argnums=(0, 1, 2))(x, r, g)
+    g2 = jax.grad(loss(lambda a, b, c: _reference(a, b, c, 1e-6)),
+                  argnums=(0, 1, 2))(x, r, g)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4)
+
+
+def test_fused_add_rms_norm_shapes_and_fallback():
+    assert shape_supported(256) and not shape_supported(100)
+    rng = np.random.RandomState(1)
+    # ineligible hidden dim falls back to the XLA expression
+    x = jnp.asarray(rng.randn(2, 3, 100).astype(np.float32))
+    out, h = fused_add_rms_norm(x, x, jnp.ones((100,)), 1e-6, False)
+    ref_out, ref_h = _reference(x, x, jnp.ones((100,)), 1e-6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               atol=1e-6)
+
+
+def test_block_sizing_and_edge_rows():
+    from paddle_tpu.ops.pallas_kernels.rms_norm import _pick_rows
+
+    # VMEM-aware cap: 8 MiB / (16 * hdim)
+    assert _pick_rows(1024, 8192) <= (8 * 2 ** 20) // (16 * 8192)
+    assert _pick_rows(1024, 256) == 256
+    assert _pick_rows(0, 256) == 0
+    assert _pick_rows(257, 256) == 1       # odd rows degrade -> gated out
+
+    rng = np.random.RandomState(2)
+    # odd row count: eligibility gate routes to the XLA reference (no
+    # 1-row grid), result still exact
+    x = jnp.asarray(rng.randn(257, 128).astype(np.float32))
+    g = jnp.ones((128,))
+    out, h = fused_add_rms_norm(x, x, g, 1e-6, True)
+    ref_out, ref_h = _reference(x, x, g, 1e-6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               atol=1e-6)
+    # empty batch: no crash
+    e = jnp.zeros((0, 256), jnp.float32)
+    out0, _ = fused_add_rms_norm(e, e, jnp.ones((256,)), 1e-6, True)
+    assert out0.shape == (0, 256)
