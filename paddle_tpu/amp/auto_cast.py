@@ -19,7 +19,8 @@ white_list = {"matmul", "linear", "conv2d", "conv1d", "conv3d", "einsum", "mm", 
 black_list = {
     "exp", "square", "log", "mean", "sum", "cos_sim", "softmax", "log_softmax",
     "softmax_with_cross_entropy", "cross_entropy", "layer_norm", "batch_norm",
-    "p_norm", "logsumexp", "cumsum",
+    "p_norm", "logsumexp", "cumsum", "fused_add_layer_norm",
+    "fused_add_rms_norm",
 }
 
 

@@ -10,6 +10,25 @@ from ...nn import functional as F
 from ... import ops
 
 
+
+
+def _fused_post_ln(residual, branch, ln):
+    """ln(residual + branch) through the owned Pallas
+    fused_add_layer_norm kernel (one VMEM pass; falls back to the XLA
+    expression off-TPU / ineligible shapes)."""
+    from ...ops import dispatch
+    from ...ops.pallas_kernels.rms_norm import fused_add_layer_norm
+
+    eps = ln._epsilon
+
+    def fn(r, x, g, b):
+        out, _ = fused_add_layer_norm(x, r, g, b, eps)
+        return out
+
+    return dispatch.apply(fn, residual, branch, ln.weight, ln.bias,
+                          op_name="fused_add_layer_norm")
+
+
 class FusedMultiHeadAttention(Layer):
     """Reference fused_transformer.py:193. attn = SDPA (XLA/Pallas fused)."""
 
@@ -43,6 +62,10 @@ class FusedMultiHeadAttention(Layer):
             training=self.training,
         )
         out = self.out_proj(out.reshape([b, s, self.embed_dim]))
+        drop_active = self.training and self.dropout.p > 0.0
+        if not self.normalize_before and not drop_active:
+            # post-LN fast path: residual add + LayerNorm in ONE pass
+            return _fused_post_ln(residual, out, self.ln)
         out = residual + self.dropout(out)
         if not self.normalize_before:
             out = self.ln(out)
@@ -71,6 +94,9 @@ class FusedFeedForward(Layer):
         residual = src
         x = self.ln(src) if self.normalize_before else src
         x = self.linear2(self.act_dropout(self.activation(self.linear1(x))))
+        drop_active = self.training and self.dropout.p > 0.0
+        if not self.normalize_before and not drop_active:
+            return _fused_post_ln(residual, x, self.ln)
         x = residual + self.dropout(x)
         if not self.normalize_before:
             x = self.ln(x)

@@ -4,4 +4,4 @@ The reference keeps its hot ops as handwritten CUDA
 (paddle/phi/kernels/fusion/, operators/fused/); here the hot ops are
 Pallas kernels compiled through Mosaic for the TPU's MXU/VMEM.
 """
-from .rms_norm import fused_add_rms_norm  # noqa: F401
+from .rms_norm import fused_add_layer_norm, fused_add_rms_norm  # noqa: F401

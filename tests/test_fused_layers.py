@@ -101,3 +101,62 @@ def test_fused_mha_and_ffn_layers():
     loss.backward()
     for p in list(mha.parameters()) + list(ffn.parameters()):
         assert p.grad is not None
+
+
+def test_fused_post_ln_path_matches_composition():
+    """Post-LN (normalize_before=False) eval path routes through the
+    owned fused_add_layer_norm kernel and must equal the plain
+    residual+LN composition."""
+    pt.seed(5)
+    mha = FusedMultiHeadAttention(embed_dim=128, num_heads=2,
+                                  dropout_rate=0.3, attn_dropout_rate=0.0,
+                                  normalize_before=False)
+    ffn = FusedFeedForward(d_model=128, dim_feedforward=256,
+                           dropout_rate=0.3, normalize_before=False)
+    mha.eval()
+    ffn.eval()
+    x = pt.to_tensor(np.random.RandomState(0)
+                     .randn(2, 8, 128).astype(np.float32))
+    out = ffn(mha(x))
+
+    # manual composition with the same weights
+    def np_ln(v, g, b, eps):
+        mu = v.mean(-1, keepdims=True)
+        d = v - mu
+        var = (d * d).mean(-1, keepdims=True)
+        return d / np.sqrt(var + eps) * g + b
+
+    xin = x.numpy()
+    B, S, E = xin.shape
+    qkv = xin @ mha.qkv.weight.numpy() + mha.qkv.bias.numpy()
+    qkv = qkv.reshape(B, S, 3, 2, E // 2)
+    q, k, v = (np.swapaxes(qkv[:, :, i], 1, 2) for i in range(3))
+    sc = np.einsum("bnqd,bnkd->bnqk", q, k) / np.sqrt(E // 2)
+    att = np.exp(sc - sc.max(-1, keepdims=True))
+    att = att / att.sum(-1, keepdims=True)
+    ao = np.swapaxes(np.einsum("bnqk,bnkd->bnqd", att, v), 1, 2) \
+        .reshape(B, S, E)
+    ao = ao @ mha.out_proj.weight.numpy() + mha.out_proj.bias.numpy()
+    h1 = np_ln(xin + ao, mha.ln.weight.numpy(), mha.ln.bias.numpy(),
+               mha.ln._epsilon)
+    f = np.maximum(h1 @ ffn.linear1.weight.numpy()
+                   + ffn.linear1.bias.numpy(), 0.0)
+    f = f @ ffn.linear2.weight.numpy() + ffn.linear2.bias.numpy()
+    expect = np_ln(h1 + f, ffn.ln.weight.numpy(), ffn.ln.bias.numpy(),
+                   ffn.ln._epsilon)
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4,
+                               atol=1e-5)
+
+    # training with dropout>0 still works (non-fused branch) and grads
+    # flow through the fused path too
+    mha.train()
+    ffn.train()
+    loss = pt.ops.mean(ffn(mha(x)) ** 2)
+    loss.backward()
+    mha.eval()
+    ffn.eval()
+    x2 = pt.to_tensor(np.random.RandomState(1)
+                      .randn(2, 8, 128).astype(np.float32),
+                      stop_gradient=False)
+    pt.ops.mean(ffn(mha(x2)) ** 2).backward()
+    assert np.isfinite(x2.grad.numpy()).all()

@@ -1,18 +1,23 @@
-"""Owned Pallas fused residual-add + RMSNorm kernel (reference
+"""Owned Pallas fused residual-add + RMS/LayerNorm kernels (reference
 fusion/fused_bias_residual_layernorm analog) — interpret-mode parity
-(the CPU check discipline used for flash-attn and fused AdamW)."""
+with row counts ABOVE the eligibility gate so the kernels actually
+execute (the CPU check discipline used for flash-attn and fused AdamW)."""
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from paddle_tpu.ops.pallas_kernels.rms_norm import (
-    _reference, fused_add_rms_norm, shape_supported)
+    _ln_reference, _pick_rows, _reference, fused_add_layer_norm,
+    fused_add_rms_norm, shape_supported)
+
+ROWS = 16          # >= 8: the pallas path engages under interpret=True
 
 
 def test_fused_add_rms_norm_interpret_parity():
+    assert _pick_rows(ROWS, 256) >= 8      # kernel path, not fallback
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.randn(6, 256).astype(np.float32))
-    r = jnp.asarray(rng.randn(6, 256).astype(np.float32))
+    x = jnp.asarray(rng.randn(ROWS, 256).astype(np.float32))
+    r = jnp.asarray(rng.randn(ROWS, 256).astype(np.float32))
     g = jnp.asarray(rng.randn(256).astype(np.float32))
     out, h = fused_add_rms_norm(x, r, g, 1e-6, True)
     ref_out, ref_h = _reference(x, r, g, 1e-6)
@@ -35,34 +40,44 @@ def test_fused_add_rms_norm_interpret_parity():
                                    atol=1e-4)
 
 
-def test_fused_add_rms_norm_shapes_and_fallback():
-    assert shape_supported(256) and not shape_supported(100)
-    rng = np.random.RandomState(1)
-    # ineligible hidden dim falls back to the XLA expression
-    x = jnp.asarray(rng.randn(2, 3, 100).astype(np.float32))
-    out, h = fused_add_rms_norm(x, x, jnp.ones((100,)), 1e-6, False)
-    ref_out, ref_h = _reference(x, x, jnp.ones((100,)), 1e-6)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+def test_fused_add_layer_norm_interpret_parity():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, ROWS, 128).astype(np.float32))
+    r = jnp.asarray(rng.randn(2, ROWS, 128).astype(np.float32))
+    g = jnp.asarray(rng.randn(128).astype(np.float32))
+    b = jnp.asarray(rng.randn(128).astype(np.float32))
+    out, h = fused_add_layer_norm(x, r, g, b, 1e-5, True)
+    ro, rh = _ln_reference(x, r, g, b, 1e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ro),
                                atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h), np.asarray(rh))
+    g1 = jax.grad(lambda a: jnp.sum(
+        fused_add_layer_norm(a, r, g, b, 1e-5, True)[0] ** 2))(x)
+    g2 = jax.grad(lambda a: jnp.sum(
+        _ln_reference(a, r, g, b, 1e-5)[0] ** 2))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               atol=1e-4)
 
 
-def test_block_sizing_and_edge_rows():
-    from paddle_tpu.ops.pallas_kernels.rms_norm import _pick_rows
-
+def test_block_sizing_and_fallbacks():
     # VMEM-aware cap: 8 MiB / (16 * hdim)
     assert _pick_rows(1024, 8192) <= (8 * 2 ** 20) // (16 * 8192)
     assert _pick_rows(1024, 256) == 256
     assert _pick_rows(0, 256) == 0
     assert _pick_rows(257, 256) == 1       # odd rows degrade -> gated out
+    assert shape_supported(256) and not shape_supported(100)
 
     rng = np.random.RandomState(2)
-    # odd row count: eligibility gate routes to the XLA reference (no
-    # 1-row grid), result still exact
+    # odd row count and ineligible hidden both route to the reference
     x = jnp.asarray(rng.randn(257, 128).astype(np.float32))
-    g = jnp.ones((128,))
-    out, h = fused_add_rms_norm(x, x, g, 1e-6, True)
-    ref_out, ref_h = _reference(x, x, g, 1e-6)
+    out, _ = fused_add_rms_norm(x, x, jnp.ones((128,)), 1e-6, True)
+    ref_out, _ = _reference(x, x, jnp.ones((128,)), 1e-6)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               atol=1e-6)
+    y = jnp.asarray(rng.randn(16, 100).astype(np.float32))
+    out2, _ = fused_add_rms_norm(y, y, jnp.ones((100,)), 1e-6, False)
+    ref2, _ = _reference(y, y, jnp.ones((100,)), 1e-6)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(ref2),
                                atol=1e-6)
     # empty batch: no crash
     e = jnp.zeros((0, 256), jnp.float32)
