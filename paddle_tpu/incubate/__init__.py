@@ -3,3 +3,5 @@ from . import asp  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from .selected_rows import SelectedRows, merge_selected_rows  # noqa: F401
+from .string_tensor import (  # noqa: F401
+    StringTensor, strings_empty, strings_lower, strings_upper)

@@ -1,0 +1,111 @@
+"""StringTensor — the reference's string tensor variant
+(paddle/phi/core/string_tensor.h: a TensorBase whose elements are
+``pstring`` values, with the kernel surface in
+paddle/phi/kernels/strings/: strings_empty, strings_copy,
+strings_lower_upper — ASCII fast path + UTF-8 full path via
+unicode.cc).
+
+TPU-native design: strings never touch the device — XLA has no string
+dtype and no string op benefits from the MXU — so this is a HOST
+container (numpy object array of ``str``) holding the same shape/meta
+contract as the reference's, with the lower/upper kernels implemented
+over Python's str (which is exactly the full-unicode path the
+reference hand-rolls in unicode.cc).  It exists for API parity and as
+the staging buffer tokenizers read from / detokenizers write into; the
+moment data becomes ids it moves into a device ``Tensor``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "strings_empty", "strings_lower", "strings_upper"]
+
+
+class StringTensor:
+    """N-d array of python strings with tensor-like meta.
+
+    Mirrors phi::StringTensor's surface: shape/dims, numel, copy,
+    elementwise lower/upper producing new StringTensors.
+    """
+
+    def __init__(self, data=None, shape=None):
+        if data is None:
+            shape = tuple(shape) if shape is not None else (0,)
+            arr = np.full(shape, "", dtype=object)
+        else:
+            arr = np.array(data, dtype=object)
+            if shape is not None:
+                arr = arr.reshape(shape)
+        bad = [x for x in arr.reshape(-1) if not isinstance(x, str)]
+        if bad:
+            raise TypeError(
+                f"StringTensor elements must be str; got {type(bad[0])}")
+        self._data = arr
+
+    # --- meta (reference string_tensor.h dims()/numel()/valid()) ---
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    def numel(self) -> int:
+        return int(self._data.size)
+
+    def dim(self) -> int:
+        return self._data.ndim
+
+    # --- access ---
+    def numpy(self) -> np.ndarray:
+        return self._data.copy()
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, str):
+            return out
+        return StringTensor(out)
+
+    def __len__(self):
+        return self._data.shape[0] if self._data.ndim else 0
+
+    def __eq__(self, other):
+        if isinstance(other, StringTensor):
+            other = other._data
+        return np.asarray(self._data == other)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data.tolist()!r})"
+
+    # --- kernels (strings_lower_upper_kernel.h; unicode path = py str) ---
+    def lower(self) -> "StringTensor":
+        return self._map(str.lower)
+
+    def upper(self) -> "StringTensor":
+        return self._map(str.upper)
+
+    def copy(self) -> "StringTensor":
+        return StringTensor(self._data.copy())
+
+    def reshape(self, shape) -> "StringTensor":
+        return StringTensor(self._data.reshape(shape))
+
+    def _map(self, fn) -> "StringTensor":
+        flat = np.array([fn(x) for x in self._data.reshape(-1)],
+                        dtype=object)
+        return StringTensor(flat.reshape(self._data.shape))
+
+
+def strings_empty(shape) -> StringTensor:
+    """strings_empty_kernel.cc — allocate a StringTensor of empty strings."""
+    return StringTensor(shape=shape)
+
+
+def strings_lower(x: StringTensor, use_utf8_encoding: bool = True) -> StringTensor:
+    """strings_lower_upper_kernel.h StringLowerKernel (the utf8 flag picks
+    the reference's ASCII vs unicode path; python str covers both)."""
+    return x.lower()
+
+
+def strings_upper(x: StringTensor, use_utf8_encoding: bool = True) -> StringTensor:
+    return x.upper()

@@ -221,3 +221,30 @@ class TestSelectedRows:
             SelectedRows([5, 1], np.ones((2, 2), np.float32), height=4)
         with pytest.raises(ValueError):
             SelectedRows([-1], np.ones((1, 2), np.float32), height=4)
+
+
+class TestStringTensor:
+    def test_meta_and_kernels(self):
+        from paddle_tpu.incubate import (StringTensor, strings_empty,
+                                         strings_lower, strings_upper)
+
+        st = StringTensor([["Hello", "WÖRLD"], ["xyz", ""]])
+        assert st.shape == [2, 2]
+        assert st.numel() == 4
+        assert st[0, 1] == "WÖRLD"
+        lo = strings_lower(st)
+        up = strings_upper(st)
+        # full-unicode path: Ö lowers to ö (the reference's unicode.cc
+        # table, here via python str)
+        assert lo.tolist() == [["hello", "wörld"], ["xyz", ""]]
+        assert up.tolist() == [["HELLO", "WÖRLD"], ["XYZ", ""]]
+        e = strings_empty((3,))
+        assert e.tolist() == ["", "", ""]
+        row = st[1]
+        assert isinstance(row, StringTensor) and row.tolist() == ["xyz", ""]
+
+    def test_type_discipline(self):
+        from paddle_tpu.incubate import StringTensor
+
+        with pytest.raises(TypeError):
+            StringTensor([1, 2])

@@ -75,24 +75,36 @@ def main():
     ]
     if not args.quick:
         cases += [
-            ("seq2048_1p3b_bs4",
-             {"BENCH_CONFIG": "1p3b:4:2048:10:1:1"}),
-            ("seq2048_1p3b_bs2",
-             {"BENCH_CONFIG": "1p3b:2:2048:10:1:1"}),
+            # r05 capture: peak_hbm was 7.5GiB of a 256GiB-probe chip at
+            # bs=8 — batch is the widest-open lever (bigger MXU tiles,
+            # amortized optimizer+boundary overhead)
             ("bs16_1p3b_seq1024",
              {"BENCH_CONFIG": "1p3b:16:1024:10:1:1"}),
-            ("no_remat_1p3b_bs4",
-             {"BENCH_CONFIG": "1p3b:4:1024:10:0:1"}),
-            ("flash_block_256_1p3b_bs8",
-             {"BENCH_CONFIG": "1p3b:8:1024:10:1:1",
+            ("bs32_1p3b_seq1024",
+             {"BENCH_CONFIG": "1p3b:32:1024:10:1:1"}),
+            ("bs64_1p3b_seq1024",
+             {"BENCH_CONFIG": "1p3b:64:1024:6:1:1"}),
+            ("bs32_fused_adam_1p3b",
+             {"BENCH_CONFIG": "1p3b:32:1024:10:1:1",
+              "BENCH_FUSED_ADAM": "1"}),
+            ("seq2048_1p3b_bs16",
+             {"BENCH_CONFIG": "1p3b:16:2048:6:1:1"}),
+            ("seq2048_1p3b_bs4",
+             {"BENCH_CONFIG": "1p3b:4:2048:10:1:1"}),
+            ("no_remat_1p3b_bs8",
+             {"BENCH_CONFIG": "1p3b:8:1024:10:0:1"}),
+            ("no_remat_1p3b_bs32",
+             {"BENCH_CONFIG": "1p3b:32:1024:10:0:1"}),
+            ("flash_block_256_1p3b_bs32",
+             {"BENCH_CONFIG": "1p3b:32:1024:10:1:1",
               "FLAGS_flash_block_q": "256",
               "FLAGS_flash_block_kv": "256"}),
-            ("flash_block_q256_kv512_1p3b_bs8",
-             {"BENCH_CONFIG": "1p3b:8:1024:10:1:1",
+            ("flash_block_q256_kv512_1p3b_bs32",
+             {"BENCH_CONFIG": "1p3b:32:1024:10:1:1",
               "FLAGS_flash_block_q": "256",
               "FLAGS_flash_block_kv": "512"}),
-            ("flash_block_1024_1p3b_bs8",
-             {"BENCH_CONFIG": "1p3b:8:1024:10:1:1",
+            ("flash_block_1024_1p3b_bs32",
+             {"BENCH_CONFIG": "1p3b:32:1024:10:1:1",
               "FLAGS_flash_block_q": "1024",
               "FLAGS_flash_block_kv": "1024"}),
         ]
