@@ -261,9 +261,14 @@ def main():
             rung = int(os.environ.get("BENCH_RUNG", "0"))
             name, batch, seq, steps, remat, pure_bf16 = _RUNGS[rung]
         mk = gpt_1p3b if name == "1p3b" else gpt_small
+        # BENCH_REMAT_POLICY=dots: selective remat (save MXU outputs,
+        # recompute only VPU work in backward) — trades HBM for the ~33%
+        # recompute FLOPs full remat pays
         cfg = mk(hidden_dropout=0.0, attention_dropout=0.0,
                  max_position_embeddings=max(seq, 1024),
-                 recompute_interval=remat, use_flash_attention=True)
+                 recompute_interval=remat,
+                 recompute_policy=os.environ.get("BENCH_REMAT_POLICY") or None,
+                 use_flash_attention=True)
     else:
         # CPU fallback uses a toy shape so the bench always completes
         name, batch, seq, steps, pure_bf16 = "small", 2, 128, 3, False

@@ -49,13 +49,34 @@ def stacked_param_sharding(shape, pp_axis="pp"):
     return NamedSharding(mesh, PartitionSpec())
 
 
-def scan_blocks(block_fn: Callable, stacked: Sequence, x, *, remat: bool = False):
+def _checkpoint(fn, policy):
+    """jax.checkpoint with a named rematerialisation policy.
+
+    None/"full" recomputes everything (min residency); "dots" saves MXU
+    outputs and recomputes only VPU work (near-free backward recompute);
+    "dots_saveable" additionally saves batched dots.
+    """
+    if policy in (None, "full"):
+        return jax.checkpoint(fn)
+    policies = {
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    }
+    if policy not in policies:
+        raise ValueError(
+            f"unknown remat policy {policy!r}; expected one of "
+            f"{['full', *policies]}")
+    return jax.checkpoint(fn, policy=policies[policy])
+
+
+def scan_blocks(block_fn: Callable, stacked: Sequence, x, *, remat: bool = False,
+                remat_policy: str | None = None):
     """Run L stacked homogeneous blocks sequentially: x -> block(p_i, x).
 
     ``block_fn(params_tuple, x) -> y`` with params_tuple holding one
     layer's slices. ``stacked`` is a tuple of [L, ...] arrays.
     """
-    body = jax.checkpoint(block_fn) if remat else block_fn
+    body = _checkpoint(block_fn, remat_policy) if remat else block_fn
 
     def step(h, params):
         return body(params, h), None
@@ -66,7 +87,8 @@ def scan_blocks(block_fn: Callable, stacked: Sequence, x, *, remat: bool = False
 
 def pipeline_blocks(block_fn: Callable, stacked: Sequence, x_micro, *,
                     layers_per_stage: int, pp_axis: str = "pp",
-                    remat: bool = False, block_takes_index: bool = False,
+                    remat: bool = False, remat_policy: str | None = None,
+                    block_takes_index: bool = False,
                     n_virtual: int = 1):
     """Microbatch-pipelined execution of stacked blocks over the pp axis.
 
@@ -111,7 +133,7 @@ def pipeline_blocks(block_fn: Callable, stacked: Sequence, x_micro, *,
     if not block_takes_index:
         base = block_fn
         block_fn = lambda p, h, idx: base(p, h)  # noqa: E731
-    body = jax.checkpoint(block_fn) if remat else block_fn
+    body = _checkpoint(block_fn, remat_policy) if remat else block_fn
 
     lpc = layers_per_stage // V  # layers per virtual chunk
 

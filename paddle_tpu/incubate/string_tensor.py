@@ -42,6 +42,14 @@ class StringTensor:
                 f"StringTensor elements must be str; got {type(bad[0])}")
         self._data = arr
 
+    @classmethod
+    def _wrap(cls, arr: np.ndarray) -> "StringTensor":
+        """Internal constructor for arrays that are str by construction
+        (copy/reshape/slice/_map) — skips the O(numel) validation pass."""
+        out = cls.__new__(cls)
+        out._data = arr
+        return out
+
     # --- meta (reference string_tensor.h dims()/numel()/valid()) ---
     @property
     def shape(self):
@@ -64,7 +72,7 @@ class StringTensor:
         out = self._data[idx]
         if isinstance(out, str):
             return out
-        return StringTensor(out)
+        return StringTensor._wrap(np.asarray(out, dtype=object))
 
     def __len__(self):
         return self._data.shape[0] if self._data.ndim else 0
@@ -78,22 +86,32 @@ class StringTensor:
         return f"StringTensor(shape={self.shape}, data={self._data.tolist()!r})"
 
     # --- kernels (strings_lower_upper_kernel.h; unicode path = py str) ---
-    def lower(self) -> "StringTensor":
-        return self._map(str.lower)
+    def lower(self, ascii_only: bool = False) -> "StringTensor":
+        return self._map(_ascii_lower if ascii_only else str.lower)
 
-    def upper(self) -> "StringTensor":
-        return self._map(str.upper)
+    def upper(self, ascii_only: bool = False) -> "StringTensor":
+        return self._map(_ascii_upper if ascii_only else str.upper)
 
     def copy(self) -> "StringTensor":
-        return StringTensor(self._data.copy())
+        return StringTensor._wrap(self._data.copy())
 
     def reshape(self, shape) -> "StringTensor":
-        return StringTensor(self._data.reshape(shape))
+        return StringTensor._wrap(self._data.reshape(shape))
 
     def _map(self, fn) -> "StringTensor":
         flat = np.array([fn(x) for x in self._data.reshape(-1)],
                         dtype=object)
-        return StringTensor(flat.reshape(self._data.shape))
+        return StringTensor._wrap(flat.reshape(self._data.shape))
+
+
+def _ascii_lower(s: str) -> str:
+    """The reference's ASCII fast path (case_utils.h AsciiToLower):
+    only [A-Z] mapped, non-ASCII bytes untouched."""
+    return "".join(chr(ord(c) + 32) if "A" <= c <= "Z" else c for c in s)
+
+
+def _ascii_upper(s: str) -> str:
+    return "".join(chr(ord(c) - 32) if "a" <= c <= "z" else c for c in s)
 
 
 def strings_empty(shape) -> StringTensor:
@@ -102,10 +120,10 @@ def strings_empty(shape) -> StringTensor:
 
 
 def strings_lower(x: StringTensor, use_utf8_encoding: bool = True) -> StringTensor:
-    """strings_lower_upper_kernel.h StringLowerKernel (the utf8 flag picks
-    the reference's ASCII vs unicode path; python str covers both)."""
-    return x.lower()
+    """strings_lower_upper_kernel.h StringLowerKernel: utf8=True is the
+    full-unicode path (unicode.cc), False the ASCII-only fast path."""
+    return x.lower(ascii_only=not use_utf8_encoding)
 
 
 def strings_upper(x: StringTensor, use_utf8_encoding: bool = True) -> StringTensor:
-    return x.upper()
+    return x.upper(ascii_only=not use_utf8_encoding)

@@ -73,11 +73,31 @@ class GPTConfig:
     use_tensor_parallel: bool = False   # mpu layers over the 'mp' axis
     sequence_parallel: bool = False     # shard activations over 'sp'
     recompute_interval: int = 0         # 0 = off; k = remat every k blocks
+    # remat granularity when recompute_interval > 0 (reference analog:
+    # recompute(..., use_reentrant) is all-or-nothing; XLA lets us do
+    # better).  None/"full" = recompute the whole block in backward
+    # (min memory, +~fwd/3 hardware FLOPs); "dots" = save matmul outputs
+    # and recompute only elementwise/norm work (jax
+    # checkpoint_policies.dots_with_no_batch_dims_saveable — near-zero
+    # recompute FLOPs at the cost of the saved dot activations).  Applies
+    # to the compiled stacked/pipelined path (scan_blocks/pipeline_blocks);
+    # the eager per-layer fleet.recompute is an autograd-engine rerun
+    # where XLA checkpoint policies have no meaning.
+    recompute_policy: Optional[str] = None
     virtual_pp_degree: int = 1          # interleaved virtual stages per device
     # Tri-state SDPA routing: None = defer to FLAGS_use_pallas_flash_attention
     # (default), True = force the pallas kernel (when shape-eligible),
     # False = force the plain XLA expression.
     use_flash_attention: Optional[bool] = None
+
+    def __post_init__(self):
+        # validate eagerly: a typo'd policy must fail at config time, not
+        # only when remat actually engages (training + interval > 0)
+        if self.recompute_policy not in (None, "full", "dots",
+                                         "dots_saveable"):
+            raise ValueError(
+                f"unknown remat policy {self.recompute_policy!r}; expected "
+                "one of [None, 'full', 'dots', 'dots_saveable']")
 
     @property
     def ffn_size(self) -> int:
@@ -461,6 +481,7 @@ class GPTStackedDecoder(Layer):
         mesh = _mesh.get_mesh() if _mesh.has_mesh() else None
         pp = mesh.shape["pp"] if (mesh and "pp" in mesh.axis_names) else 1
         remat = cfg.recompute_interval > 0 and self.training
+        remat_policy = cfg.recompute_policy if remat else None
 
         stacked_in = list(self._stacked())
         if with_dropout:
@@ -490,12 +511,14 @@ class GPTStackedDecoder(Layer):
                 xm = h.reshape(n_micro, mb, *h.shape[1:])
                 out = pp_spmd.pipeline_blocks(
                     block_mb or block, stacked, xm, layers_per_stage=lps,
-                    remat=remat, block_takes_index=block_mb is not None,
+                    remat=remat, remat_policy=remat_policy,
+                    block_takes_index=block_mb is not None,
                     n_virtual=cfg.virtual_pp_degree)
                 return out.reshape(b, *h.shape[1:])
         else:
             def raw(h, *stacked):
-                return pp_spmd.scan_blocks(block, stacked, h, remat=remat)
+                return pp_spmd.scan_blocks(block, stacked, h, remat=remat,
+                                           remat_policy=remat_policy)
 
         return dispatch.apply(raw, hidden, *stacked_in,
                               op_name="gpt_stacked_decoder")

@@ -75,9 +75,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)            # [block_q, D]
-        k = k_ref[0].astype(jnp.float32)            # [block_kv, D]
-        v = v_ref[0].astype(jnp.float32)
+        # MXU discipline: dots take the STORAGE dtype (bf16 under AMP —
+        # the native MXU input width) and accumulate in fp32 via
+        # preferred_element_type; only the softmax runs in fp32 on the
+        # VPU.  Casting operands up to fp32 here would push the matmuls
+        # off the fast bf16 MXU path for zero accuracy gain (accumulation
+        # is fp32 either way).
+        q = q_ref[0]                                # [block_q, D]
+        k = k_ref[0]                                # [block_kv, D]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * np.float32(scale)
         if causal:
@@ -96,7 +102,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + l_cur
         acc_sc[...] = acc_sc[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
         l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
 
@@ -169,10 +176,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)             # [block_q, D]
-        k = k_ref[0].astype(jnp.float32)             # [block_kv, D]
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)           # [block_q, D]
+        # same MXU discipline as the fwd kernel: operands in storage
+        # dtype, fp32 accumulation; fp32 only for softmax/dS on the VPU
+        q = q_ref[0]                                 # [block_q, D]
+        k = k_ref[0]                                 # [block_kv, D]
+        v = v_ref[0]
+        do = do_ref[0]                               # [block_q, D]
         lse = jnp.transpose(lse_ref[0][:1, :])       # [block_q, 1]
         delta = jnp.transpose(delta_ref[0][:1, :])   # [block_q, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -186,14 +195,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse)                         # [block_q, block_kv]
         # dV += P^T dO
         dv_sc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         # dP = dO V^T ; dS = P * (dP - delta)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         # dK += dS^T Q * scale
         dk_sc[...] += np.float32(scale) * jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(q_i == n_q - 1)
     def _finish():
@@ -217,10 +228,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(run)
     def _body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = jnp.transpose(lse_ref[0][:1, :])       # [block_q, 1]
         delta = jnp.transpose(delta_ref[0][:1, :])   # [block_q, 1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -236,7 +247,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
         dq_sc[...] += np.float32(scale) * jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(kv_i == n_kv - 1)
     def _finish():

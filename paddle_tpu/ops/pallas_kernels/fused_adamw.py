@@ -22,6 +22,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -95,7 +96,11 @@ def fused_adamw_update(p, g, m1, m2, lr, b1p, b2p, *,
     kernel = functools.partial(_kernel, beta1=float(beta1),
                                beta2=float(beta2), eps=float(eps),
                                wd=float(wd))
-    row_spec = pl.BlockSpec((block_rows, _LANES), lambda i: (i, 0))
+    # index maps must return int32: the axon Mosaic rejects i64 index-map
+    # returns ("failed to legalize 'func.return' (i64, i64)") — same
+    # convention as flash_attention.py's np.int32 casts
+    row_spec = pl.BlockSpec((block_rows, _LANES),
+                            lambda i: (i, np.int32(0)))
     smem = pl.BlockSpec(memory_space=pltpu.SMEM) if not interpret else \
         pl.BlockSpec(memory_space=None)
     new_p, new_m1, new_m2 = pl.pallas_call(

@@ -22,6 +22,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 __all__ = ["fused_add_rms_norm", "fused_add_layer_norm",
@@ -93,8 +94,11 @@ def _build(norm_math, n_params, name):
         r2 = r.reshape(-1, hdim)
         rows = x2.shape[0]
         block = _pick_rows(rows, hdim)
-        row_spec = pl.BlockSpec((block, hdim), lambda i: (i, 0))
-        p_spec = pl.BlockSpec((1, hdim), lambda i: (0, 0))
+        # int32 index-map returns: axon Mosaic rejects i64 (see
+        # fused_adamw.py / flash_attention.py)
+        row_spec = pl.BlockSpec((block, hdim), lambda i: (i, np.int32(0)))
+        p_spec = pl.BlockSpec((1, hdim),
+                              lambda i: (np.int32(0), np.int32(0)))
         out, h = pl.pallas_call(
             functools.partial(kernel, eps=float(eps)),
             grid=(rows // block,),

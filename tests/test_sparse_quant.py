@@ -248,3 +248,14 @@ class TestStringTensor:
 
         with pytest.raises(TypeError):
             StringTensor([1, 2])
+
+    def test_ascii_vs_unicode_path(self):
+        from paddle_tpu.incubate import (StringTensor, strings_lower,
+                                         strings_upper)
+
+        st = StringTensor([["WÖRLD"]])
+        # ASCII fast path (use_utf8_encoding=False): only [A-Za-z] mapped
+        assert strings_lower(st, use_utf8_encoding=False).tolist() == [["wÖrld"]]
+        assert strings_lower(st).tolist() == [["wörld"]]
+        assert strings_upper(StringTensor([["aöb"]]),
+                             use_utf8_encoding=False).tolist() == [["AöB"]]
