@@ -53,6 +53,21 @@ def shape_supported(seq_len: int, head_dim: int) -> bool:
 NEG_INF = np.float32(-1e30)
 
 
+def _dot(a, b, dims):
+    """bf16-operand MXU dot with fp32 accumulation.
+
+    precision MUST be pinned to DEFAULT here: the package sets
+    jax_default_matmul_precision="highest" globally (fp32 OpTest parity),
+    and under "highest" Mosaic receives contract_precision<fp32> for
+    bf16 operands and rejects the kernel with "Bad lhs type".  The
+    operands are already in storage dtype (bf16 under AMP) and the
+    accumulator is fp32 via preferred_element_type, so DEFAULT loses
+    nothing."""
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               precision=jax.lax.Precision.DEFAULT,
+                               preferred_element_type=jnp.float32)
+
+
 # ---------------------------------------------------------------------------
 # forward kernel
 # ---------------------------------------------------------------------------
@@ -84,8 +99,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
         q = q_ref[0]                                # [block_q, D]
         k = k_ref[0]                                # [block_kv, D]
         v = v_ref[0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * np.float32(scale)
+        s = _dot(q, k, ((1,), (1,))) * np.float32(scale)
         if causal:
             rows = q_i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0)
@@ -101,9 +115,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
         l_cur = jnp.sum(p, axis=-1, keepdims=True)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + l_cur
-        acc_sc[...] = acc_sc[...] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        acc_sc[...] = acc_sc[...] * alpha + _dot(p.astype(v.dtype), v, ((1,), (0,)))
         m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
         l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
 
@@ -184,8 +196,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]                               # [block_q, D]
         lse = jnp.transpose(lse_ref[0][:1, :])       # [block_q, 1]
         delta = jnp.transpose(delta_ref[0][:1, :])   # [block_q, 1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * np.float32(scale)
+        s = _dot(q, k, ((1,), (1,))) * np.float32(scale)
         if causal:
             rows = q_i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0)
@@ -194,17 +205,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)                         # [block_q, block_kv]
         # dV += P^T dO
-        dv_sc[...] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dv_sc[...] += _dot(p.astype(do.dtype), do, ((0,), (0,)))
         # dP = dO V^T ; dS = P * (dP - delta)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+        dp = _dot(do, v, ((1,), (1,)))
         ds = p * (dp - delta)
         # dK += dS^T Q * scale
-        dk_sc[...] += np.float32(scale) * jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dk_sc[...] += np.float32(scale) * _dot(ds.astype(q.dtype), q, ((0,), (0,)))
 
     @pl.when(q_i == n_q - 1)
     def _finish():
@@ -234,8 +240,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         lse = jnp.transpose(lse_ref[0][:1, :])       # [block_q, 1]
         delta = jnp.transpose(delta_ref[0][:1, :])   # [block_q, 1]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * np.float32(scale)
+        s = _dot(q, k, ((1,), (1,))) * np.float32(scale)
         if causal:
             rows = q_i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_kv), 0)
@@ -243,12 +248,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block_q, block_kv), 1)
             s = jnp.where(rows >= cols, s, NEG_INF)
         p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
+        dp = _dot(do, v, ((1,), (1,)))
         ds = p * (dp - delta)
-        dq_sc[...] += np.float32(scale) * jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dq_sc[...] += np.float32(scale) * _dot(ds.astype(k.dtype), k, ((1,), (0,)))
 
     @pl.when(kv_i == n_kv - 1)
     def _finish():
