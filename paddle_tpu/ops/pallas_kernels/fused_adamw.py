@@ -29,7 +29,10 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["fused_adamw_update"]
 
 _LANES = 1024        # flattened row width (8 lanes of 128)
-_BLOCK_ROWS = 512    # rows per grid step: 512*1024*4B*4bufs = 8 MiB VMEM
+_BLOCK_ROWS = 256    # rows per grid step: 256 rows keeps the kernel's
+                     # VMEM stack (in/out blocks + fp32 upcast temps)
+                     # under the 16 MiB scoped limit — 512 rows overflows
+                     # it by 96 KiB on v5e (measured)
 
 
 def _kernel(lr_ref, b1p_ref, b2p_ref, p_ref, g_ref, m1_ref, m2_ref,
@@ -101,8 +104,13 @@ def fused_adamw_update(p, g, m1, m2, lr, b1p, b2p, *,
     # convention as flash_attention.py's np.int32 casts
     row_spec = pl.BlockSpec((block_rows, _LANES),
                             lambda i: (i, np.int32(0)))
-    smem = pl.BlockSpec(memory_space=pltpu.SMEM) if not interpret else \
-        pl.BlockSpec(memory_space=None)
+    # the scalar specs need an EXPLICIT int32 index map too: a BlockSpec
+    # without one defaults to python-int (0, 0), which traces as i64
+    # under the package's x64 mode and fails Mosaic legalization with
+    # "func.return (i64, i64)"
+    smem_map = lambda i: (np.int32(0), np.int32(0))
+    smem = (pl.BlockSpec((1, 1), smem_map, memory_space=pltpu.SMEM)
+            if not interpret else pl.BlockSpec((1, 1), smem_map))
     new_p, new_m1, new_m2 = pl.pallas_call(
         kernel,
         grid=grid,
