@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import dtype as _dtype_mod
+from ..core import op_cache as _op_cache
 
 __all__ = ["GradNode", "run_backward", "grad"]
 
@@ -169,6 +170,11 @@ def run_backward(
                     g = new_g._value if isinstance(new_g, Tensor) else new_g
             cotangents[t._output_index] = g
 
+        # dispatch counters: a CachedVJP runs through the shared jitted
+        # runner (C++ fast path); a plain Partial/py_layer fn re-walks the
+        # linearized jaxpr in Python
+        _op_cache.count_bwd(
+            node.name, isinstance(node.vjp_fn, _op_cache.CachedVJP))
         in_grads = node.vjp_fn(tuple(cotangents))
         if not retain_graph:
             node.vjp_fn = None
@@ -324,9 +330,11 @@ def _run_backward_create_graph(tensors, grad_tensors, *, capture=None,
             )
 
         with dispatch.enable_grad():
+            # _cacheable=False: grad_op is a fresh per-node closure — keying
+            # the op cache on it would jit-trace every backward call
             in_grads = dispatch.apply(
                 grad_op, *(tuple(ct_tensors) + tuple(node.inputs)),
-                op_name=f"{node.name}_grad")
+                op_name=f"{node.name}_grad", _cacheable=False)
         if not isinstance(in_grads, tuple):
             in_grads = (in_grads,)
 

@@ -1,4 +1,4 @@
-from . import dtype, enforce, flags, memory, place  # noqa: F401
+from . import dtype, enforce, flags, memory, op_cache, place  # noqa: F401
 from .dtype import *  # noqa: F401,F403
 from .enforce import *  # noqa: F401,F403
 from .flags import get_flags, set_flags  # noqa: F401

@@ -61,6 +61,10 @@ def flag(name: str):
 define_flag("FLAGS_check_nan_inf", False, "check every op output for nan/inf")
 define_flag("FLAGS_check_nan_inf_level", 0, "0: error on nan/inf; >0 log only")
 define_flag("FLAGS_eager_op_cache", True, "cache per-op jitted executables in eager mode")
+define_flag("FLAGS_eager_op_cache_size", 1024,
+            "max entries in the eager op compilation cache (LRU eviction)")
+define_flag("FLAGS_eager_cache_log",
+            False, "dump eager op-cache dispatch counters at process exit")
 define_flag("FLAGS_use_bf16_matmul", False, "force bf16 matmul accumulation")
 define_flag("FLAGS_log_level", 0, "framework VLOG level")
 define_flag("FLAGS_benchmark", False, "block on every op for timing")

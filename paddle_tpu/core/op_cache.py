@@ -1,0 +1,419 @@
+"""Eager op compilation cache — jitted eager dispatch.
+
+TPU-native analog of the reference's cached kernel dispatch: eager mode in
+the reference never re-resolves a kernel per call — ``matmul_ad_func`` looks
+up a phi KernelFactory entry keyed by KernelKey once and the generated
+GradNode reuses compiled kernels (SURVEY.md §3.1).  Our dispatch layer used
+to do the opposite: every differentiated op call re-traced a fresh
+``jax.vjp``, paying full Python+tracing overhead per op — the dominant cost
+of eager mode off the ``jit.to_static`` path.
+
+This module is the KernelFactory analog.  Dispatch asks :func:`acquire` for
+a compiled entry keyed by
+
+    (raw_fn identity, mode, input avals (shape/dtype/weak_type),
+     hashable attrs, AMP state)
+
+where ``mode`` is ``"fwd"`` (no-grad path: a plain ``jax.jit`` of the
+forward) or ``"vjp"`` (grad path: a jitted ``jax.vjp`` returning outputs
+plus the residual ``Partial`` — a pytree, so it round-trips through jit).
+The grad path's backward then runs through one shared jitted runner
+(:data:`_vjp_runner`), so repeated eager calls hit JAX's C++ dispatch fast
+path in BOTH directions instead of re-tracing.
+
+Fallback rules (all transparent — the un-jitted path is always correct):
+
+- ``tracing``       under a ``jit.to_static`` trace (tracers must never be
+                    cached: an entry would leak the trace).
+- ``tracer_input``  a raw input is a jax tracer (any foreign transform).
+- ``disabled``      ``FLAGS_eager_op_cache`` is off.
+- ``opt_out``       the caller passed ``_cacheable=False`` (e.g. the
+                    autograd engine's per-node ``create_graph`` closures).
+- ``unstable_fn``   raw_fn is a per-call closure/lambda — caching it would
+                    trace on every call (identity never repeats).
+- ``unhashable``    an attr can't participate in a dict key.
+- ``unjittable``    the op's first jitted run raised a concretization
+                    error (host-value-dependent Python inside raw_fn); the
+                    entry is poisoned so later calls skip jit immediately.
+- ``jit_error``     the jitted run raised a non-concretization error
+                    (transient runtime failure or a genuine op error); the
+                    entry is dropped so a later call can retry, and the
+                    eager re-run surfaces any genuine error naturally.
+- ``churn``         one (raw_fn, mode, avals) family keeps minting fresh
+                    attr keys (64+ misses — e.g. a per-step-varying Python
+                    scalar); only every 16th miss still builds an entry.
+                    Cached attr values for the family keep hitting.
+
+The cache is a bounded LRU (``FLAGS_eager_op_cache_size``) guarded by one
+lock; per-op dispatch counters (calls / hits / misses / traces / backward
+dispatches / fallback reasons) are exposed via :func:`stats`,
+:func:`reset_stats` and :func:`summary`, dumped at exit when
+``FLAGS_eager_cache_log`` is set, and surfaced by bench.py next to
+tokens/s.  See docs/eager_dispatch.md.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import sys
+import threading
+import types
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from . import flags as _flags
+
+__all__ = [
+    "acquire", "mark_stable", "fn_stable", "CachedVJP", "count_bwd",
+    "fail_entry", "wrap_tuple_fn", "stats", "reset_stats", "summary",
+    "cache_info", "clear", "log_stats",
+]
+
+_lock = threading.RLock()
+_cache: "OrderedDict[Tuple, _Entry]" = OrderedDict()
+_stats: Dict[str, Dict[str, Any]] = {}
+
+
+class _Entry:
+    """One compiled dispatch artifact.  ``fn`` is the jitted callable
+    (``None`` marks a poisoned, known-unjittable key); ``multi`` records
+    whether the op's raw output was a tuple (set during the first trace of
+    a vjp-mode entry by the tuple_fn side channel); ``bwd`` is the entry's
+    own jitted VJP runner (vjp mode only) so evicting the entry also frees
+    its compiled backward executables; ``key`` back-references the cache
+    slot for discard-on-failure."""
+
+    __slots__ = ("fn", "multi", "bwd", "key")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.multi = None
+        self.bwd = None
+        self.key = None
+
+
+# Concretization-class errors mean raw_fn is VALID eager code that can
+# never be jitted (host-value-dependent branching, data-dependent output
+# shapes) — those keys are poisoned permanently.  Anything else (transient
+# runtime failures, genuine op errors) just discards the entry so a later
+# call can retry; a genuine error re-raises from the eager fallback.
+_POISON_ERRORS = tuple(
+    e for e in (getattr(jax.errors, n, None)
+                for n in ("ConcretizationTypeError",
+                          "NonConcreteBooleanIndexError"))
+    if e is not None)
+
+
+def fail_entry(entry: "_Entry", op_name: str, exc: BaseException):
+    """A jitted call for ``entry`` raised: poison unjittable keys, drop the
+    entry for everything else (the caller re-runs the eager path)."""
+    if isinstance(exc, _POISON_ERRORS):
+        entry.fn = None
+        _count_fallback(op_name, "unjittable")
+        return
+    _count_fallback(op_name, "jit_error")
+    with _lock:
+        if _cache.get(entry.key) is entry:
+            del _cache[entry.key]
+
+
+def _op_stats(name: str) -> Dict[str, Any]:
+    st = _stats.get(name)
+    if st is None:
+        st = _stats[name] = {
+            "calls": 0, "hits": 0, "misses": 0, "traces": 0,
+            "bwd_calls": 0, "bwd_jitted": 0, "fallbacks": {},
+        }
+    return st
+
+
+def _count_fallback(name: str, reason: str):
+    with _lock:
+        fb = _op_stats(name)["fallbacks"]
+        fb[reason] = fb.get(reason, 0) + 1
+
+
+# ---------------------------------------------------------------------------
+# cacheability of the raw function
+# ---------------------------------------------------------------------------
+
+def mark_stable(fn: Callable, stable: bool = True) -> Callable:
+    """Declare that ``fn`` has a stable identity across calls (op factories
+    call this once per op definition on their closure helpers)."""
+    try:
+        fn._pt_cache_stable = stable
+    except (AttributeError, TypeError):
+        pass  # ufuncs / C callables: the heuristic already accepts them
+    return fn
+
+
+def fn_stable(fn: Callable) -> bool:
+    """True when caching on ``fn``'s identity can ever hit: module-level
+    functions and callable singletons (jnp ufuncs, PjitFunctions) qualify;
+    lambdas, per-call nested defs, partials and bound methods do not —
+    keying on those would jit-trace every single call."""
+    explicit = getattr(fn, "_pt_cache_stable", None)
+    if explicit is not None:
+        return bool(explicit)
+    if isinstance(fn, (functools.partial, types.MethodType)):
+        return False
+    if isinstance(fn, types.FunctionType):
+        return (fn.__name__ != "<lambda>"
+                and "<locals>" not in getattr(fn, "__qualname__", ""))
+    return callable(fn)
+
+
+# ---------------------------------------------------------------------------
+# key construction
+# ---------------------------------------------------------------------------
+
+def _aval_key(r):
+    aval = getattr(r, "aval", None)
+    if aval is not None:
+        return (tuple(aval.shape), aval.dtype, getattr(aval, "weak_type", False))
+    return (tuple(np.shape(r)), np.result_type(r), False)
+
+
+def _make_key(raw_fn, mode, raws, attrs, extra_key):
+    avals = tuple(_aval_key(r) for r in raws)
+    # attr values carry their TYPE: Python equality would otherwise collide
+    # True == 1 == 1.0 onto one compiled entry with the first caller's
+    # constant (and dtype) baked in
+    attrs_key = tuple(sorted(((k, type(v), v) for k, v in attrs.items()),
+                             key=lambda item: item[0])) if attrs else ()
+    key = (raw_fn, mode, avals, attrs_key, extra_key)
+    hash(key)  # TypeError for unhashable attrs -> caller falls back
+    return key
+
+
+# ---------------------------------------------------------------------------
+# the LRU + acquire
+# ---------------------------------------------------------------------------
+
+def wrap_tuple_fn(fwd, set_multi):
+    """Normalize ``fwd`` to always return a tuple, reporting whether the
+    raw output was one via ``set_multi`` (runs at trace time).  Shared by
+    the cached entry builder and dispatch's un-jitted vjp fallback so the
+    two grad paths can't drift."""
+    def tuple_fn(*xs):
+        o = fwd(*xs)
+        if isinstance(o, tuple):
+            set_multi(True)
+            return o
+        set_multi(False)
+        return (o,)
+
+    return tuple_fn
+
+
+def _run_partial(p, cts):
+    return p(cts)
+
+
+def _build_entry(fwd, mode) -> _Entry:
+    if mode != "vjp":
+        return _Entry(jax.jit(fwd))
+
+    entry = _Entry(None)
+    tuple_fn = wrap_tuple_fn(
+        fwd, lambda m: setattr(entry, "multi", m))
+    entry.fn = jax.jit(lambda *xs: jax.vjp(tuple_fn, *xs))
+    # per-entry backward runner: the residual Partial is a pytree argument,
+    # so this jit compiles once per (residual, cotangent) avals and its
+    # executables die WITH the entry (a shared module-level runner would
+    # accumulate specializations past LRU eviction forever)
+    entry.bwd = jax.jit(_run_partial)
+    return entry
+
+
+# churn guard state: distinct-key miss count per FAMILY — the key minus
+# its attrs, i.e. (raw_fn, mode, avals, extra).  A family that mints a
+# fresh attrs key on (nearly) every call — a per-step-varying Python
+# scalar, say — would pay a jit trace per call, worse than the un-jitted
+# path it replaced.  Scoping to the family (not the op name) keeps
+# tensor-tensor hits on the same op from masking scalar churn.
+_CHURN_MISSES = 64     # family misses before the guard engages
+_CHURN_REPROBE = 16    # …after which only every Nth miss builds an entry
+_family: Dict[Tuple, int] = {}
+
+
+def acquire(op_name: str, raw_fn: Callable, fwd: Callable, raws, attrs,
+            mode: str, extra_key=None, tracing: bool = False,
+            opted_out: bool = False) -> Optional[_Entry]:
+    """KernelFactory lookup for one dispatch: return a compiled entry for
+    this (op, shapes, attrs, mode) or ``None`` when the call must take the
+    un-jitted path (counting the fallback reason either way).
+
+    ``extra_key`` may be a callable (evaluated lazily, only when the call
+    is actually cacheable).  One lock acquisition per dispatch."""
+    reason = None
+    key = None
+    if opted_out:
+        reason = "opt_out"
+    elif not _flags.flag("FLAGS_eager_op_cache"):
+        reason = "disabled"
+    elif tracing:
+        reason = "tracing"
+    elif not fn_stable(raw_fn):
+        reason = "unstable_fn"
+    elif any(isinstance(r, jax.core.Tracer) for r in raws):
+        reason = "tracer_input"
+    else:
+        try:
+            extra = extra_key() if callable(extra_key) else extra_key
+            key = _make_key(raw_fn, mode, raws, attrs, extra)
+        except TypeError:
+            reason = "unhashable"
+
+    with _lock:
+        st = _op_stats(op_name)
+        st["calls"] += 1
+        if reason is not None:
+            fb = st["fallbacks"]
+            fb[reason] = fb.get(reason, 0) + 1
+            return None
+        entry = _cache.get(key)
+        if entry is not None:
+            _cache.move_to_end(key)
+            if entry.fn is None:  # poisoned: known-unjittable op
+                fb = st["fallbacks"]
+                fb["unjittable"] = fb.get("unjittable", 0) + 1
+                return None
+            st["hits"] += 1
+            return entry
+        st["misses"] += 1
+        famkey = (key[0], key[1], key[2], key[4])
+        if len(_family) > 8192:  # heuristic state, safe to forget
+            _family.clear()
+        fam_misses = _family[famkey] = _family.get(famkey, 0) + 1
+        if fam_misses > _CHURN_MISSES and fam_misses % _CHURN_REPROBE:
+            # already-cached attr values for this family keep hitting
+            # above; only the minting of NEW entries is throttled
+            fb = st["fallbacks"]
+            fb["churn"] = fb.get("churn", 0) + 1
+            return None
+        st["traces"] += 1  # first call of a fresh entry jit-traces
+        entry = _build_entry(fwd, mode)
+        entry.key = key
+        _cache[key] = entry
+        limit = int(_flags.flag("FLAGS_eager_op_cache_size"))
+        while len(_cache) > max(1, limit):
+            _cache.popitem(last=False)
+        return entry
+
+
+# ---------------------------------------------------------------------------
+# cached backward execution
+# ---------------------------------------------------------------------------
+
+class CachedVJP:
+    """GradNode backward callable for the cached grad path: holds the
+    residual ``Partial`` produced by the jitted forward and executes it
+    through its entry's jitted runner (repeated backward calls hit that
+    jit's C++ cache; the runner is freed when the entry is evicted and
+    every referencing GradNode is done)."""
+
+    __slots__ = ("partial", "op_name", "bwd")
+
+    def __init__(self, partial, op_name: str, bwd):
+        self.partial = partial
+        self.op_name = op_name
+        self.bwd = bwd
+
+    def __call__(self, cotangents):
+        try:
+            return self.bwd(self.partial, cotangents)
+        except Exception:
+            # never trade an answer for a cache: run the Partial directly
+            # (a genuine error re-raises here with its natural traceback)
+            _count_fallback(self.op_name, "unjittable")
+            return self.partial(cotangents)
+
+
+def count_bwd(op_name: str, jitted: bool):
+    """Called by the autograd engine per backward node dispatch."""
+    with _lock:
+        st = _op_stats(op_name)
+        st["bwd_calls"] += 1
+        if jitted:
+            st["bwd_jitted"] += 1
+
+
+# ---------------------------------------------------------------------------
+# observability
+# ---------------------------------------------------------------------------
+
+def stats() -> Dict[str, Dict[str, Any]]:
+    """Per-op dispatch counters (deep copy)."""
+    with _lock:
+        return {
+            name: {**st, "fallbacks": dict(st["fallbacks"])}
+            for name, st in _stats.items()
+        }
+
+
+def reset_stats():
+    with _lock:
+        _stats.clear()
+
+
+def summary() -> Dict[str, Any]:
+    """Aggregate counters + hit rate, the bench.py one-liner payload."""
+    with _lock:
+        agg = {"ops": len(_stats), "calls": 0, "hits": 0, "misses": 0,
+               "traces": 0, "bwd_calls": 0, "bwd_jitted": 0}
+        fb: Dict[str, int] = {}
+        for st in _stats.values():
+            for k in ("calls", "hits", "misses", "traces", "bwd_calls",
+                      "bwd_jitted"):
+                agg[k] += st[k]
+            for reason, n in st["fallbacks"].items():
+                fb[reason] = fb.get(reason, 0) + n
+        agg["fallbacks"] = fb
+        looked_up = agg["hits"] + agg["misses"]
+        agg["hit_rate"] = agg["hits"] / looked_up if looked_up else 0.0
+        agg["entries"] = len(_cache)
+        agg["capacity"] = int(_flags.flag("FLAGS_eager_op_cache_size"))
+        return agg
+
+
+def cache_info() -> Dict[str, int]:
+    with _lock:
+        return {"entries": len(_cache),
+                "capacity": int(_flags.flag("FLAGS_eager_op_cache_size"))}
+
+
+def clear(reset: bool = False):
+    """Drop every compiled entry (and optionally the counters)."""
+    with _lock:
+        _cache.clear()
+        _family.clear()
+        if reset:
+            _stats.clear()
+
+
+def log_stats(stream=None, top: int = 20):
+    """FLAGS_eager_cache_log dump hook: aggregate line + hottest ops."""
+    stream = stream if stream is not None else sys.stderr
+    stream.write("[paddle_tpu] eager op-cache: " + json.dumps(summary()) + "\n")
+    per_op = sorted(stats().items(), key=lambda kv: -kv[1]["calls"])[:top]
+    for name, st in per_op:
+        stream.write(
+            f"[paddle_tpu]   {name}: calls={st['calls']} hits={st['hits']} "
+            f"misses={st['misses']} traces={st['traces']} "
+            f"bwd={st['bwd_calls']} fallbacks={st['fallbacks']}\n")
+
+
+def _exit_dump():
+    try:
+        if _flags.flag("FLAGS_eager_cache_log"):
+            log_stats()
+    except Exception:
+        pass
+
+
+atexit.register(_exit_dump)

@@ -14,10 +14,16 @@ from typing import Callable
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import op_cache as _op_cache
 from ..tensor import Tensor, to_tensor
 from . import dispatch
 
 __all__ = ["ensure_tensor", "unary_op", "binary_op", "cmp_op", "logical_op"]
+
+# Python scalars ride along as hashable attrs (part of the op-cache key),
+# so `x + 2.0` dispatches a STABLE helper instead of a per-call lambda and
+# repeated calls hit the compiled entry.
+_SCALARS = (bool, int, float, np.generic)
 
 
 def ensure_tensor(x, like=None):
@@ -30,6 +36,8 @@ def ensure_tensor(x, like=None):
 
 
 def unary_op(jfn: Callable, name: str):
+    _op_cache.mark_stable(jfn)  # one instance per op definition
+
     def op(x, name=None):  # noqa: A002  (matches reference signature)
         x = ensure_tensor(x)
         return dispatch.apply(jfn, x, op_name=op.__name__)
@@ -41,14 +49,31 @@ def unary_op(jfn: Callable, name: str):
 
 
 def binary_op(jfn: Callable, name: str):
+    _op_cache.mark_stable(jfn)
+
+    def _scalar_rhs(a, *, _scalar):
+        return jfn(a, _scalar)
+
+    def _scalar_lhs(b, *, _scalar):
+        return jfn(_scalar, b)
+
+    _op_cache.mark_stable(_scalar_rhs)
+    _op_cache.mark_stable(_scalar_lhs)
+
     def op(x, y, name=None):  # noqa: A002
         xt = isinstance(x, Tensor)
         yt = isinstance(y, Tensor)
         if xt and yt:
             return dispatch.apply(jfn, x, y, op_name=op.__name__)
         if xt:
+            if isinstance(y, _SCALARS):
+                return dispatch.apply(_scalar_rhs, x, op_name=op.__name__,
+                                      _scalar=y)
             return dispatch.apply(lambda a: jfn(a, y), x, op_name=op.__name__)
         if yt:
+            if isinstance(x, _SCALARS):
+                return dispatch.apply(_scalar_lhs, y, op_name=op.__name__,
+                                      _scalar=x)
             return dispatch.apply(lambda b: jfn(x, b), y, op_name=op.__name__)
         return dispatch.apply(jfn, ensure_tensor(x), ensure_tensor(y), op_name=op.__name__)
 
@@ -59,11 +84,20 @@ def binary_op(jfn: Callable, name: str):
 
 
 def cmp_op(jfn: Callable, name: str):
+    _op_cache.mark_stable(jfn)
+
+    def _scalar_rhs(a, *, _scalar):
+        return jfn(a, _scalar)
+
+    _scalar_rhs.__name__ = name  # stats bucket matches the op
+    _op_cache.mark_stable(_scalar_rhs)
+
     def op(x, y, name=None):  # noqa: A002
         x = ensure_tensor(x)
-        y = y if not isinstance(y, Tensor) else y
         if isinstance(y, Tensor):
             return dispatch.apply_nondiff(jfn, x, y)
+        if isinstance(y, _SCALARS):
+            return dispatch.apply_nondiff(_scalar_rhs, x, _scalar=y)
         return dispatch.apply_nondiff(lambda a: jfn(a, y), x)
 
     op.__name__ = name
@@ -71,6 +105,8 @@ def cmp_op(jfn: Callable, name: str):
 
 
 def logical_op(jfn: Callable, name: str):
+    _op_cache.mark_stable(jfn)
+
     def op(x, y=None, out=None, name=None):  # noqa: A002
         x = ensure_tensor(x)
         if y is None:

@@ -24,6 +24,7 @@ import numpy as np
 from ..core import dtype as _dtype_mod
 
 from ..core import flags as _flags
+from ..core import op_cache as _op_cache
 
 __all__ = [
     "apply",
@@ -176,11 +177,34 @@ def finite_check_report(reset: bool = True):
     return ok
 
 
-def apply(raw_fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
+def _tracing_now() -> bool:
+    """True while jit.to_static functionalization logs are live — compiled
+    artifacts must never be built from (or keyed on) trace-time values."""
+    ts = _trace_state
+    return (ts.mutation_log is not None or ts.read_log is not None
+            or ts.branch_log is not None)
+
+
+def _amp_cache_key():
+    from ..amp.auto_cast import _amp_state
+
+    if not _amp_state.enabled:
+        return None
+    return (_amp_state.level, str(_amp_state.dtype))
+
+
+def apply(raw_fn: Callable, *inputs, op_name: Optional[str] = None,
+          _cacheable: Optional[bool] = None, **attrs):
     """Run ``raw_fn(*raw_values, **attrs)`` over Tensor inputs.
 
     Records a GradNode holding the op's VJP when any input requires grad.
     Returns Tensor or tuple of Tensors mirroring raw_fn's output structure.
+
+    Repeated eager calls on the same shapes reuse a jitted forward (and a
+    jitted forward+VJP pair on the grad path) from ``core.op_cache`` — the
+    reference's cached KernelFactory dispatch.  ``_cacheable=False`` forces
+    the un-jitted path (one-shot closures like the engine's create_graph
+    grad ops).
     """
     from ..tensor import Tensor  # local import to break the cycle
     from ..autograd.engine import GradNode
@@ -194,35 +218,54 @@ def apply(raw_fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
     _log_reads(inputs)
     raws = tuple(t._value for t in inputs)
     needs_grad = _grad_state.enabled and any(not t.stop_gradient for t in inputs)
+    name = op_name or getattr(raw_fn, "__name__", "op")
 
     if attrs:
         fwd = functools.partial(raw_fn, **attrs)
     else:
         fwd = raw_fn
 
+    entry = _op_cache.acquire(
+        name, raw_fn, fwd, raws, attrs,
+        mode="vjp" if needs_grad else "fwd",
+        extra_key=_amp_cache_key,  # evaluated lazily, cacheable calls only
+        tracing=_tracing_now(),
+        opted_out=(_cacheable is False),
+    )
+
     if not needs_grad:
-        out = fwd(*raws)
+        if entry is not None:
+            try:
+                out = entry.fn(*raws)
+            except Exception as e:  # noqa: BLE001 — fallback re-raises real errors
+                _op_cache.fail_entry(entry, name, e)
+                out = fwd(*raws)
+        else:
+            out = fwd(*raws)
         if _flags.flag("FLAGS_check_nan_inf"):
-            _check_finite(op_name or getattr(raw_fn, "__name__", "op"),
-                          out if isinstance(out, tuple) else (out,))
+            _check_finite(name, out if isinstance(out, tuple) else (out,))
         return _wrap_outputs(out, stop_gradient=True)
 
     multi = [None]
+    vjp_fn = None
+    if entry is not None:
+        try:
+            outs_raw, vjp_partial = entry.fn(*raws)
+        except Exception as e:  # noqa: BLE001 — fallback re-raises real errors
+            _op_cache.fail_entry(entry, name, e)
+        else:
+            multi[0] = entry.multi
+            vjp_fn = _op_cache.CachedVJP(vjp_partial, name, entry.bwd)
 
-    def tuple_fn(*args):
-        o = fwd(*args)
-        if isinstance(o, tuple):
-            multi[0] = True
-            return o
-        multi[0] = False
-        return (o,)
-
-    outs_raw, vjp_fn = jax.vjp(tuple_fn, *raws)
+    if vjp_fn is None:
+        tuple_fn = _op_cache.wrap_tuple_fn(
+            fwd, lambda m: multi.__setitem__(0, m))
+        outs_raw, vjp_fn = jax.vjp(tuple_fn, *raws)
     node = GradNode(
         vjp_fn=vjp_fn,
         inputs=inputs,
         out_avals=tuple((o.shape, o.dtype) for o in outs_raw),
-        name=op_name or getattr(raw_fn, "__name__", "op"),
+        name=name,
         fwd=fwd,
     )
     outs = []
@@ -243,11 +286,28 @@ def apply(raw_fn: Callable, *inputs, op_name: Optional[str] = None, **attrs):
     return outs[0]
 
 
-def apply_nondiff(raw_fn: Callable, *inputs, **attrs):
-    """Dispatch an op that is never differentiated (comparisons, indexing…)."""
+def apply_nondiff(raw_fn: Callable, *inputs,
+                  _cacheable: Optional[bool] = None, **attrs):
+    """Dispatch an op that is never differentiated (comparisons, indexing…).
+
+    Shares the eager op compilation cache with :func:`apply` (no-grad
+    forward mode only)."""
     _log_reads(inputs)
     raws = tuple(t._value for t in inputs)
-    out = raw_fn(*raws, **attrs) if attrs else raw_fn(*raws)
+    fwd = functools.partial(raw_fn, **attrs) if attrs else raw_fn
+    entry = _op_cache.acquire(
+        getattr(raw_fn, "__name__", "op"), raw_fn, fwd, raws, attrs,
+        mode="nondiff", extra_key=None, tracing=_tracing_now(),
+        opted_out=(_cacheable is False),
+    )
+    if entry is not None:
+        try:
+            out = entry.fn(*raws)
+        except Exception as e:  # noqa: BLE001 — fallback re-raises real errors
+            _op_cache.fail_entry(entry, getattr(raw_fn, "__name__", "op"), e)
+            out = fwd(*raws)
+    else:
+        out = fwd(*raws)
     return _wrap_outputs(out, stop_gradient=True)
 
 

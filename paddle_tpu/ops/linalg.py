@@ -13,17 +13,21 @@ from . import dispatch
 from ._factory import ensure_tensor
 
 
+def _matmul_raw(a, b, transpose_x=False, transpose_y=False):
+    # module-level (stable identity) with the transposes as hashable attrs,
+    # so every eager matmul hits the op compilation cache
+    if transpose_x:
+        a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+    if transpose_y:
+        b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+    return jnp.matmul(a, b)
+
+
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
     x, y = ensure_tensor(x), ensure_tensor(y)
-
-    def fn(a, b):
-        if transpose_x:
-            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
-        if transpose_y:
-            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
-        return jnp.matmul(a, b)
-
-    return dispatch.apply(fn, x, y, op_name="matmul")
+    return dispatch.apply(_matmul_raw, x, y, op_name="matmul",
+                          transpose_x=bool(transpose_x),
+                          transpose_y=bool(transpose_y))
 
 
 def mm(input, mat2, name=None):  # noqa: A002

@@ -54,18 +54,23 @@ NEG_INF = np.float32(-1e30)
 
 
 def _dot(a, b, dims):
-    """bf16-operand MXU dot with fp32 accumulation.
+    """MXU dot with fp32 accumulation, precision picked per operand dtype.
 
-    precision MUST be pinned to DEFAULT here: the package sets
-    jax_default_matmul_precision="highest" globally (fp32 OpTest parity),
-    and under "highest" Mosaic receives contract_precision<fp32> for
-    bf16 operands and rejects the kernel with "Bad lhs type".  The
-    operands are already in storage dtype (bf16 under AMP) and the
-    accumulator is fp32 via preferred_element_type, so DEFAULT loses
-    nothing."""
-    return jax.lax.dot_general(a, b, (dims, ((), ())),
-                               precision=jax.lax.Precision.DEFAULT,
-                               preferred_element_type=jnp.float32)
+    For sub-fp32 operands (bf16/fp16 under AMP) precision MUST be DEFAULT:
+    the package sets jax_default_matmul_precision="highest" globally (fp32
+    OpTest parity), and under "highest" Mosaic receives
+    contract_precision<fp32> for bf16 operands and rejects the kernel with
+    "Bad lhs type".  The accumulator is fp32 via preferred_element_type, so
+    DEFAULT loses nothing there.  For fp32 operands, DEFAULT would let the
+    MXU round inputs through bf16 passes — select HIGHEST so an fp32 call
+    keeps full fp32 contraction (ADVICE round 5)."""
+    fp32 = (jnp.dtype(a.dtype) == jnp.float32
+            and jnp.dtype(b.dtype) == jnp.float32)
+    return jax.lax.dot_general(
+        a, b, (dims, ((), ())),
+        precision=(jax.lax.Precision.HIGHEST if fp32
+                   else jax.lax.Precision.DEFAULT),
+        preferred_element_type=jnp.float32)
 
 
 # ---------------------------------------------------------------------------

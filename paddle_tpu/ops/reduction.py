@@ -7,6 +7,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import op_cache as _op_cache
 from ..core.dtype import to_jax_dtype
 from ..tensor import Tensor
 from . import dispatch
@@ -25,20 +26,23 @@ def _norm_axis(axis):
 
 
 def _reduce(jfn, name, promote_int=False):
+    def raw(a, *, _axis, _keepdim, _dtype):
+        kw = {}
+        if _dtype is not None:
+            kw["dtype"] = _dtype
+        elif promote_int and np.issubdtype(np.dtype(a.dtype), np.integer):
+            kw["dtype"] = jnp.int64
+        return jfn(a, axis=_axis, keepdims=_keepdim, **kw)
+
+    raw.__name__ = name  # one stable instance per op; attrs carry the axis
+    _op_cache.mark_stable(raw)
+
     def op(x, axis=None, keepdim=False, name=None, dtype=None):  # noqa: A002
         x = ensure_tensor(x)
         ax = _norm_axis(axis)
         jd = to_jax_dtype(dtype) if dtype is not None else None
-
-        def fn(a):
-            kw = {}
-            if jd is not None:
-                kw["dtype"] = jd
-            elif promote_int and np.issubdtype(np.dtype(a.dtype), np.integer):
-                kw["dtype"] = jnp.int64
-            return jfn(a, axis=ax, keepdims=keepdim, **kw)
-
-        return dispatch.apply(fn, x, op_name=name)
+        return dispatch.apply(raw, x, op_name=name,
+                              _axis=ax, _keepdim=bool(keepdim), _dtype=jd)
 
     op.__name__ = name
     return op
@@ -51,16 +55,26 @@ nansum = _reduce(jnp.nansum, "nansum", promote_int=True)
 nanmean = _reduce(jnp.nanmean, "nanmean")
 
 
+def _max_raw(a, *, _axis, _keepdim):
+    return jnp.max(a, axis=_axis, keepdims=_keepdim)
+
+
+def _min_raw(a, *, _axis, _keepdim):
+    return jnp.min(a, axis=_axis, keepdims=_keepdim)
+
+
 def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
     x = ensure_tensor(x)
     ax = _norm_axis(axis)
-    return dispatch.apply(lambda a: jnp.max(a, axis=ax, keepdims=keepdim), x, op_name="max")
+    return dispatch.apply(_max_raw, x, op_name="max",
+                          _axis=ax, _keepdim=bool(keepdim))
 
 
 def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
     x = ensure_tensor(x)
     ax = _norm_axis(axis)
-    return dispatch.apply(lambda a: jnp.min(a, axis=ax, keepdims=keepdim), x, op_name="min")
+    return dispatch.apply(_min_raw, x, op_name="min",
+                          _axis=ax, _keepdim=bool(keepdim))
 
 
 def amax(x, axis=None, keepdim=False, name=None):

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Eager dispatch-cache micro-benchmark.
+
+Measures the wall-time of 1k repeated eager ``matmul`` + ``add`` calls on
+fixed shapes — the ISSUE-1 acceptance workload — with the op compilation
+cache off vs on, in both the no-grad and grad-capture regimes.  The grad
+regime is where the uncached path hurts most: every call re-traces a fresh
+``jax.vjp``.
+
+Prints one JSON line:
+
+    {"iters", "nograd": {"uncached_s","cached_s","speedup"},
+              "grad":   {...}, "overall_speedup", "hit_rate"}
+
+Exit 0 when cached dispatch is >=2x faster overall with a >95% hit rate
+after warmup (the acceptance bar), 1 otherwise.  Runs fine on CPU:
+``JAX_PLATFORMS=cpu python tools/dispatch_bench.py [iters]``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _loop(pt, x, y, b, iters):
+    z = None
+    for _ in range(iters):
+        z = pt.add(pt.matmul(x, y), b)
+    z._value.block_until_ready()
+    return z
+
+
+def _timed(pt, x, y, b, iters, cached):
+    from paddle_tpu.core import op_cache
+
+    pt.set_flags({"FLAGS_eager_op_cache": cached})
+    _loop(pt, x, y, b, max(10, iters // 100))  # warmup (jit traces here)
+    op_cache.reset_stats()
+    t0 = time.perf_counter()
+    _loop(pt, x, y, b, iters)
+    dt = time.perf_counter() - t0
+    return dt, op_cache.summary()
+
+
+def main() -> int:
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 1000
+
+    import paddle_tpu as pt
+    from paddle_tpu.core import op_cache
+
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(64, 64).astype(np.float32))
+    y = pt.to_tensor(rng.randn(64, 64).astype(np.float32))
+    b = pt.to_tensor(rng.randn(64).astype(np.float32))
+
+    report = {"iters": iters}
+    hit_rates = []
+    totals = {"uncached_s": 0.0, "cached_s": 0.0}
+    for regime in ("nograd", "grad"):
+        if regime == "grad":
+            for t in (x, y, b):
+                t.stop_gradient = False
+        un_s, _ = _timed(pt, x, y, b, iters, cached=False)
+        ca_s, summ = _timed(pt, x, y, b, iters, cached=True)
+        report[regime] = {
+            "uncached_s": round(un_s, 4),
+            "cached_s": round(ca_s, 4),
+            "speedup": round(un_s / ca_s, 2) if ca_s else float("inf"),
+            "hit_rate": round(summ["hit_rate"], 4),
+        }
+        hit_rates.append(summ["hit_rate"])
+        totals["uncached_s"] += un_s
+        totals["cached_s"] += ca_s
+
+    report["overall_speedup"] = round(
+        totals["uncached_s"] / totals["cached_s"], 2)
+    report["hit_rate"] = round(min(hit_rates), 4)
+    pt.set_flags({"FLAGS_eager_op_cache": True})
+    op_cache.reset_stats()
+
+    print(json.dumps(report))
+    ok = report["overall_speedup"] >= 2.0 and report["hit_rate"] > 0.95
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
