@@ -120,11 +120,19 @@ def _cpu_env() -> dict:
     return env
 
 
-def _probe_hbm(timeout=300.0) -> float:
+def _probe_hbm(timeout=None) -> float:
     """HBM capacity probe (GiB) in a throwaway subprocess: the axon PJRT
     plugin reports no memory_stats()/bytes_limit, so allocate 1-GiB device
     buffers until RESOURCE_EXHAUSTED and report how many fit.  Gives every
-    OOM down-ladder a denominator ('model needs X of Y GiB')."""
+    OOM down-ladder a denominator ('model needs X of Y GiB').
+
+    Timeout is env-overridable like the backend probe's
+    (PADDLE_TPU_BENCH_PROBE_TIMEOUT / BENCH_PROBE_TIMEOUT, default 300s) —
+    CI hosts that want a fast verdict shorten BOTH probes with one knob."""
+    if timeout is None:
+        timeout = float(
+            os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT")
+            or os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
     code = r"""
 import jax, jax.numpy as jnp
 bufs = []
@@ -177,9 +185,10 @@ def _probe_backend(timeout=240.0):
 
 
 def _run_child(env, timeout):
-    """Run the measured workload in a watchdog-timed child; return its JSON
-    line or None.  A backend that initializes but hangs at compile/execute
-    is killed by the timeout instead of wedging the whole bench."""
+    """Run the measured workload in a watchdog-timed child; return its
+    JSON metric lines (train + decode) or None.  A backend that
+    initializes but hangs at compile/execute is killed by the timeout
+    instead of wedging the whole bench."""
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--child"],
@@ -193,10 +202,10 @@ def _run_child(env, timeout):
     if proc.returncode != 0:
         sys.stderr.write(f"bench: child rc={proc.returncode}\n")
         return None
-    for line in (proc.stdout or "").splitlines():
-        line = line.strip()
-        if line.startswith("{") and '"metric"' in line:
-            return line
+    lines = [ln.strip() for ln in (proc.stdout or "").splitlines()
+             if ln.strip().startswith("{") and '"metric"' in ln]
+    if lines:
+        return lines
     sys.stderr.write("bench: child produced no JSON line\n")
     return None
 
@@ -228,7 +237,7 @@ def parent():
             sys.stderr.write(f"bench: probe attempt {attempt + 1} failed; "
                              "retrying in 60s\n")
             time.sleep(60)
-    line = None
+    lines = None
     if probed:
         hbm = _probe_hbm()
         sys.stderr.write(f"bench: HBM capacity probe: "
@@ -238,19 +247,20 @@ def parent():
         for rung in range(len(_RUNGS)):
             env = dict(os.environ)
             env["BENCH_RUNG"] = str(rung)
-            line = _run_child(env, tpu_timeout)
-            if line is not None:
+            lines = _run_child(env, tpu_timeout)
+            if lines is not None:
                 break
             sys.stderr.write(f"bench: rung {rung} {_RUNGS[rung]} failed; "
                              "backing off\n")
-    if line is None:
+    if lines is None:
         sys.stderr.write("bench: falling back to clean-env CPU child\n")
-        line = _run_child(_cpu_env(), cpu_timeout)
-    if line is None:
+        lines = _run_child(_cpu_env(), cpu_timeout)
+    if lines is None:
         _emit("gpt_small_train_tokens_per_sec_per_chip", 0.0,
               "tokens/s (bench failed on both tpu and cpu paths)", 0.0)
         return
-    print(line)
+    for line in lines:
+        print(line)
     sys.stdout.flush()
 
 
@@ -390,6 +400,55 @@ def main():
         f"on {'tpu' if on_tpu else 'cpu'})",
         round(mfu / 0.45, 4),
     )
+
+    # ---- decode (serving) metric: prefill + autoregressive decode over the
+    # donated KV cache, same ladder model.  Two compiled programs total
+    # (prefill + one decode step); the loop is retrace-free and the cache
+    # donation keeps HBM flat across steps (delta recorded in the unit).
+    if on_tpu:
+        dec_bs, prompt_len, new_tokens = 8, 128, 64
+        # smallest 128-multiple that fits the request: the cache is live
+        # ON TOP of the still-resident train state, and on the ladder's
+        # tight rungs a seq-sized cache (1024+) would be 4-5x more HBM
+        # than the 256 positions actually decoded
+        max_seq_cache = -(-(prompt_len + new_tokens) // 128) * 128
+    else:
+        dec_bs, prompt_len, new_tokens = 2, 16, 8
+        max_seq_cache = 64
+    prompt = pt.to_tensor(
+        rng.randint(0, cfg.vocab_size, (dec_bs, prompt_len)), dtype="int64")
+    try:
+        # warmup compiles prefill + decode; the timed call reuses both
+        model.generate(prompt, max_new_tokens=2, max_seq_len=max_seq_cache,
+                       cache_dtype="bfloat16")
+        mem_before = pt_memory.memory_allocated()
+        t0 = time.perf_counter()
+        out_ids = model.generate(prompt, max_new_tokens=new_tokens,
+                                 max_seq_len=max_seq_cache,
+                                 cache_dtype="bfloat16")
+        np.asarray(out_ids.numpy())  # force completion of the async chain
+        dec_dt = time.perf_counter() - t0
+        mem_after = pt_memory.memory_allocated()
+        pt_memory.log_memory("after decode bench")
+        decode_tps = dec_bs * new_tokens / dec_dt
+        from paddle_tpu.models import generation as _gen
+
+        tc = _gen.trace_counts()
+        _emit(
+            f"gpt_{name}_decode_tokens_per_sec_per_chip",
+            round(decode_tps, 1),
+            f"tokens/s (bs={dec_bs} prompt={prompt_len} new={new_tokens} "
+            f"cache=[{cfg.num_layers},{dec_bs},{cfg.num_heads},"
+            f"{max_seq_cache},{cfg.head_dim}]xbf16 "
+            f"mem_delta={(mem_after - mem_before) / 2**20:.1f}MiB "
+            f"traces={tc} on {'tpu' if on_tpu else 'cpu'})",
+            0.0,
+        )
+    except Exception as e:  # noqa: BLE001 — decode must not kill the train metric
+        sys.stderr.write(f"bench: decode bench failed: {type(e).__name__}: "
+                         f"{str(e)[:500]}\n")
+        _emit(f"gpt_{name}_decode_tokens_per_sec_per_chip", 0.0,
+              "tokens/s (decode bench failed; see stderr)", 0.0)
 
 
 if __name__ == "__main__":

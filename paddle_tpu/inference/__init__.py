@@ -64,9 +64,41 @@ class Config:
         self._device_id = 0
         self._enable_memory_optim = True
         self._switches: Dict[str, object] = {}
+        self._causal_lm_model = None
+        self._decode_opts: Optional[Dict[str, object]] = None
 
     def set_model(self, prog_file, params_file=None):
         self._model_prefix = prog_file
+
+    # -- causal-LM decode mode --------------------------------------------
+    def set_causal_lm_model(self, model):
+        """Serve a LIVE causal-LM (a model exposing ``generate()``) instead
+        of a saved static-shape program.  A saved StableHLO artifact cannot
+        run the autoregressive loop (its programs are single static calls);
+        the live model's decode engine compiles exactly two programs
+        (prefill + decode) and reuses them across every ``run()``."""
+        self._causal_lm_model = model
+        return self
+
+    def enable_causal_lm_decode(self, max_new_tokens: int = 32,
+                                do_sample: bool = False,
+                                temperature: float = 1.0, top_k: int = 0,
+                                top_p: Optional[float] = None,
+                                eos_token_id: Optional[int] = None,
+                                max_seq_len: Optional[int] = None,
+                                cache_dtype: str = "bfloat16"):
+        """Switch ``Predictor.run`` to autoregressive decode: input handle
+        x0 takes int64 prompt ids [B, S0]; output handle out0 returns
+        [B, S0 + max_new_tokens] generated ids."""
+        self._decode_opts = dict(
+            max_new_tokens=int(max_new_tokens), do_sample=bool(do_sample),
+            temperature=float(temperature), top_k=int(top_k), top_p=top_p,
+            eos_token_id=eos_token_id, max_seq_len=max_seq_len,
+            cache_dtype=str(cache_dtype))
+        return self
+
+    def causal_lm_decode_enabled(self) -> bool:
+        return self._decode_opts is not None
 
     def model_dir(self):
         return self._model_prefix
@@ -114,6 +146,8 @@ class Config:
         lines = [f"model: {self._model_prefix}",
                  f"device: {'tpu' if self._use_tpu else 'cpu'}:{self._device_id}",
                  "compiler: XLA (StableHLO program from jit.save)"]
+        if self._decode_opts is not None:
+            lines.append(f"causal_lm_decode: {self._decode_opts}")
         lines += [f"{k}: {v}" for k, v in self._switches.items()]
         return "\n".join(lines)
 
@@ -151,17 +185,38 @@ class Predictor:
     XLA call (ZeroCopyRun -> jitted program)."""
 
     def __init__(self, config: Config):
-        from ..jit.save_load import load as _load
-
         self._config = config
-        self._layer = _load(config.prog_file())
-        self._n_inputs = getattr(self._layer, "n_inputs", None)
-        if self._n_inputs is None:
+        self._causal_lm = config._causal_lm_model
+        if config.causal_lm_decode_enabled() and self._causal_lm is None:
             raise RuntimeError(
-                "cannot determine the model's input arity from "
-                f"'{config.prog_file()}': the artifact predates jit.save's "
-                "n_inputs field and the exported program did not expose its "
-                "calling convention; re-save the model with jit.save")
+                "enable_causal_lm_decode() needs a live model: saved "
+                "StableHLO programs are single static-shape calls and "
+                "cannot run the autoregressive loop; attach the model with "
+                "Config.set_causal_lm_model(model)")
+        if self._causal_lm is not None and not config.causal_lm_decode_enabled():
+            raise RuntimeError(
+                "set_causal_lm_model() without enable_causal_lm_decode(): "
+                "decode options must be chosen explicitly (max_new_tokens, "
+                "sampling, cache dtype) — call "
+                "Config.enable_causal_lm_decode(...) before create_predictor")
+        if self._causal_lm is not None:
+            if not hasattr(self._causal_lm, "generate"):
+                raise RuntimeError(
+                    "set_causal_lm_model expects a model with generate() "
+                    "(GenerationMixin)")
+            self._layer = None
+            self._n_inputs = 1
+        else:
+            from ..jit.save_load import load as _load
+
+            self._layer = _load(config.prog_file())
+            self._n_inputs = getattr(self._layer, "n_inputs", None)
+            if self._n_inputs is None:
+                raise RuntimeError(
+                    "cannot determine the model's input arity from "
+                    f"'{config.prog_file()}': the artifact predates jit.save's "
+                    "n_inputs field and the exported program did not expose its "
+                    "calling convention; re-save the model with jit.save")
         self._input_names = [f"x{i}" for i in range(self._n_inputs)]
         self._inputs: Dict[str, object] = {}
         self._outputs: Dict[str, object] = {}
@@ -202,7 +257,11 @@ class Predictor:
         else:
             ctx = contextlib.nullcontext()
         with ctx:
-            out = self._layer(*args)
+            if self._causal_lm is not None:
+                opts = self._config._decode_opts or {}
+                out = self._causal_lm.generate(args[0], **opts)
+            else:
+                out = self._layer(*args)
         outs = out if isinstance(out, (list, tuple)) else [out]
         self._output_names = [f"out{i}" for i in range(len(outs))]
         self._outputs = {n: o._value for n, o in zip(self._output_names, outs)}

@@ -13,6 +13,7 @@ from __future__ import annotations
 import multiprocessing as mp
 import queue
 import threading
+import time
 import traceback
 from typing import Any, Callable, Optional
 
@@ -178,9 +179,7 @@ class DataLoader:
             next_out = 0
             timeout = self._timeout or None
             while next_out < n:
-                import time as _time
-
-                deadline = (_time.monotonic() + timeout) if timeout else None
+                deadline = (time.monotonic() + timeout) if timeout else None
                 while next_out not in received:
                     # poll in short slices so a worker that died WITHOUT
                     # enqueueing an error (OOM-kill, segfault) raises
@@ -194,7 +193,7 @@ class DataLoader:
                                 f"DataLoader worker(s) {dead} died "
                                 "unexpectedly (killed or crashed without "
                                 "reporting an error)")
-                        if deadline and _time.monotonic() > deadline:
+                        if deadline and time.monotonic() > deadline:
                             raise RuntimeError(
                                 f"DataLoader timed out after {timeout}s "
                                 "waiting for a worker batch")
@@ -232,22 +231,66 @@ class DataLoader:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
         sentinel = object()
         err = []
+        # consumer-side shutdown signal: a consumer that breaks out of
+        # iteration early (or is gc'd) closes the generator, which must
+        # release a producer blocked on a full queue — a plain q.put would
+        # leak the thread (parked forever) plus its prefetched batches
+        stop = threading.Event()
 
         def producer():
             try:
                 for b in self._batches():
-                    q.put(b)
+                    while not stop.is_set():
+                        try:
+                            q.put(b, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
             except BaseException as e:  # propagate into consumer
                 err.append(e)
             finally:
-                q.put(sentinel)
+                # normal completion: wait for space (never displace a real
+                # batch); on shutdown: force-place so nothing ever blocks
+                placed = False
+                while not stop.is_set():
+                    try:
+                        q.put(sentinel, timeout=0.1)
+                        placed = True
+                        break
+                    except queue.Full:
+                        continue
+                while not placed:
+                    try:
+                        q.put_nowait(sentinel)
+                        placed = True
+                    except queue.Full:
+                        try:
+                            q.get_nowait()
+                        except queue.Empty:
+                            pass
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            yield item
-        if err:
-            raise err[0]
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                yield item
+            if err:
+                raise err[0]
+        finally:
+            # runs on normal exhaustion AND on generator close() (early
+            # break / gc): unblock + retire the producer
+            stop.set()
+            while True:  # drain so a blocked put releases immediately
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            # best-effort reap: the daemon thread exits at its next put
+            # poll (<=0.1s) unless it is mid-computation inside
+            # _batches(); don't stall the caller's break/GC path for that
+            t.join(timeout=0.5)

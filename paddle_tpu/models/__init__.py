@@ -3,7 +3,8 @@ reference's test and benchmark suites — GPT at
 test/auto_parallel/get_gpt_model.py and
 test/collective/fleet/hybrid_parallel_gpt fixtures; vision models live in
 paddle_tpu.vision.models)."""
-from . import gpt  # noqa: F401
+from . import generation, gpt  # noqa: F401
+from .generation import GenerationMixin, KVCache  # noqa: F401
 from .gpt import (  # noqa: F401
     GPTConfig,
     GPTModel,

@@ -42,7 +42,8 @@ from ..distributed.fleet.layers.mpu.mp_layers import (
     VocabParallelEmbedding,
 )
 from ..distributed.fleet.recompute import recompute
-from ..tensor import Parameter, Tensor
+from ..tensor import Parameter, Tensor, to_tensor
+from .generation import GenerationMixin, KVCache
 
 __all__ = [
     "GPTConfig",
@@ -51,6 +52,7 @@ __all__ = [
     "GPTStackedDecoder",
     "GPTStackedForPretraining",
     "GPTPretrainingCriterion",
+    "KVCache",
     "gpt_tiny",
     "gpt_small",
     "gpt_1p3b",
@@ -151,6 +153,137 @@ def _seq_shard(x: Tensor, cfg: GPTConfig) -> Tensor:
     return x
 
 
+# ---------------------------------------------------------------------------
+# KV-cache decode path (shared by the layered and stacked decoders)
+# ---------------------------------------------------------------------------
+
+def _as_pos(cache_index) -> Tensor:
+    """Normalize a cache position to a scalar int32 Tensor (a TRACED
+    scalar under jit — positions are data, never shapes)."""
+    if isinstance(cache_index, Tensor):
+        return cache_index
+    return to_tensor(np.int32(cache_index or 0))
+
+
+def _cache_position_ids(input_ids: Tensor, pos: Tensor) -> Tensor:
+    """position_ids [B, S] = cache position offset + arange(S)."""
+    s = input_ids.shape[-1]
+    rel = ops.arange(0, s, dtype="int64") + pos.astype("int64")
+    return ops.expand(ops.unsqueeze(rel, 0), list(input_ids.shape))
+
+
+def _resolve_use_flash(cfg: GPTConfig) -> bool:
+    if cfg.use_flash_attention is not None:
+        return bool(cfg.use_flash_attention)
+    from ..core import flags as _flags
+
+    return bool(_flags.flag("FLAGS_use_pallas_flash_attention"))
+
+
+def _ln_f32(x, g, b, eps):
+    """fp32 LayerNorm body shared by the train (_block_fn) and decode
+    (_cached_block_fn) stacked blocks — one numerics definition.  (Their
+    remaining block math is pinned together by the decode-vs-full-forward
+    parity tests in tests/test_generate.py.)"""
+    x = x.astype(jnp.float32)
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def _raw_attend_with_cache(qh, kh, vh, ckr, cvr, posr, *, head_dim,
+                           use_flash, pos_is_zero=True):
+    """Raw (traced) cache write + attend.  qh/kh/vh: [B, N, S, D] head-major
+    fresh projections; ckr/cvr: [B, N, max_seq, D] cache; posr: traced
+    scalar position.  Returns (out [B, N, S, D], new_k, new_v).
+
+    S == 1 is the decode step: position-indexed ``dynamic_update_slice``
+    write, then the q-len-1 flash-decode kernel (XLA fallback off-TPU) over
+    ``posr + 1`` valid positions.  S > 1 with ``pos_is_zero`` is the
+    common whole-prompt prefill: it attends causally to itself, so
+    attention runs over the fresh K/V (flash kernel when eligible) while
+    the cache is populated.  S > 1 at a nonzero/unknown position (chunked
+    prefill) attends over the WHOLE updated cache with an absolute-
+    position causal+length mask — earlier chunks are visible."""
+    from ..ops.pallas_kernels.decode_attention import decode_attention
+    from ..ops.pallas_kernels.flash_attention import (
+        _on_tpu, flash_attention_bnsd, shape_supported,
+    )
+
+    s = qh.shape[2]
+    scale = float(1.0 / np.sqrt(head_dim))
+    p = posr.astype(jnp.int32)
+    zero = jnp.zeros((), p.dtype)
+    idx = (zero, zero, p, zero)
+    ck2 = jax.lax.dynamic_update_slice(ckr, kh.astype(ckr.dtype), idx)
+    cv2 = jax.lax.dynamic_update_slice(cvr, vh.astype(cvr.dtype), idx)
+    if s == 1:
+        out = decode_attention(qh[:, :, 0, :], ck2, cv2, p + 1,
+                               sm_scale=scale)
+        out = out[:, :, None, :].astype(qh.dtype)
+    elif not pos_is_zero:
+        # chunked prefill: queries at absolute positions p..p+S-1 attend to
+        # every cache position <= their own (covers earlier chunks)
+        max_seq = ck2.shape[2]
+        scores = jnp.einsum("bnqd,bnkd->bnqk", qh.astype(ck2.dtype), ck2,
+                            preferred_element_type=jnp.float32) * scale
+        rows = p + jax.lax.broadcasted_iota(jnp.int32, (s, max_seq), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (s, max_seq), 1)
+        scores = jnp.where(cols <= rows, scores,
+                           jnp.asarray(-1e9, scores.dtype))
+        att = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bnqk,bnkd->bnqd", att.astype(cv2.dtype),
+                         cv2).astype(qh.dtype)
+    elif use_flash and _on_tpu() and shape_supported(s, head_dim):
+        out = flash_attention_bnsd(qh.astype(kh.dtype), kh, vh, causal=True,
+                                   sm_scale=scale).astype(qh.dtype)
+    else:
+        scores = jnp.einsum("bnqd,bnkd->bnqk", qh, kh,
+                            preferred_element_type=jnp.float32) * scale
+        causal = jnp.tril(jnp.ones((s, s), jnp.bool_))
+        scores = jnp.where(causal, scores, jnp.asarray(-1e9, scores.dtype))
+        att = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bnqk,bnkd->bnqd", att.astype(qh.dtype), vh)
+    return out, ck2, cv2
+
+
+def _pos_is_static_zero(pos: Tensor) -> bool:
+    """True when the cache position is a compile-time-known 0 (the whole-
+    prompt prefill) — selects the fast self-attention prefill path.  A
+    traced or nonzero position routes S>1 calls to the general
+    cache-masked path instead (chunked prefill stays correct)."""
+    v = pos._value
+    if isinstance(v, jax.core.Tracer):
+        return False
+    try:
+        return int(np.asarray(v)) == 0
+    except Exception:
+        return False
+
+
+def _attend_with_cache(q: Tensor, k: Tensor, v: Tensor, ck_t: Tensor,
+                       cv_t: Tensor, pos: Tensor, cfg: GPTConfig) -> Tensor:
+    """Tensor-level cached attention for the layered decoder.  q/k/v:
+    [B, S, nh, hd]; mutates the cache Tensors in place (the mutation is
+    logged, so jit.to_static donates them)."""
+    use_flash = _resolve_use_flash(cfg)
+    pos_is_zero = _pos_is_static_zero(pos)
+
+    def raw(qr, kr, vr, ckr, cvr, posr):
+        qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (qr, kr, vr))
+        out, ck2, cv2 = _raw_attend_with_cache(
+            qh, kh, vh, ckr, cvr, posr,
+            head_dim=cfg.head_dim, use_flash=use_flash,
+            pos_is_zero=pos_is_zero)
+        return jnp.swapaxes(out, 1, 2), ck2, cv2
+
+    out, ck_new, cv_new = ops.dispatch.apply(
+        raw, q, k, v, ck_t, cv_t, pos, op_name="cached_attention")
+    ck_t._set_value(ck_new._value)
+    cv_t._set_value(cv_new._value)
+    return out
+
+
 class GPTEmbeddings(Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -192,7 +325,8 @@ class GPTAttention(Layer):
             self.out_proj = Linear(h, h, weight_attr=_winit(cfg))
         self.dropout = Dropout(cfg.hidden_dropout)
 
-    def forward(self, x: Tensor, attn_mask: Optional[Tensor] = None) -> Tensor:
+    def forward(self, x: Tensor, attn_mask: Optional[Tensor] = None,
+                layer_kv=None, cache_index=None) -> Tensor:
         cfg = self._cfg
         b, s = x.shape[0], x.shape[1]
         nh, hd = cfg.num_heads, cfg.head_dim
@@ -201,10 +335,23 @@ class GPTAttention(Layer):
         q = ops.squeeze(ops.slice(qkv, [2], [0], [1]), 2)   # [B, S, nh, hd]
         k = ops.squeeze(ops.slice(qkv, [2], [1], [2]), 2)
         v = ops.squeeze(ops.slice(qkv, [2], [2], [3]), 2)
+        if layer_kv is not None:
+            # serving path: write K/V into the preallocated cache at
+            # cache_index, attend over it (q-len-1 flash-decode kernel for
+            # single-token steps)
+            if attn_mask is not None:
+                raise ValueError(
+                    "attn_mask is not supported on the KV-cache path (it "
+                    "is causal+length-masked); left-padded batches would "
+                    "write pad positions into the cache — right-pad or "
+                    "serve per-sequence")
+            ck_t, cv_t = layer_kv
+            out = _attend_with_cache(q, k, v, ck_t, cv_t,
+                                     _as_pos(cache_index), cfg)
         # sequence-parallel causal attention runs as a ring over 'sp'
         # (K/V rotate via ppermute; online-softmax merge) — the S axis stays
         # sharded instead of being all-gathered for the score matmul
-        if (cfg.sequence_parallel and attn_mask is None
+        elif (cfg.sequence_parallel and attn_mask is None
                 and cfg.attention_dropout == 0.0
                 and _mesh.has_mesh() and _mesh.axis_size("sp") > 1):
             from ..nn.functional.ring_attention import ring_attention
@@ -252,8 +399,10 @@ class GPTDecoderLayer(Layer):
         self.ln2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
         self.mlp = GPTMLP(cfg)
 
-    def forward(self, x: Tensor, attn_mask: Optional[Tensor] = None) -> Tensor:
-        x = x + self.attn(self.ln1(x), attn_mask)
+    def forward(self, x: Tensor, attn_mask: Optional[Tensor] = None,
+                layer_kv=None, cache_index=None) -> Tensor:
+        x = x + self.attn(self.ln1(x), attn_mask, layer_kv=layer_kv,
+                          cache_index=cache_index)
         x = x + self.mlp(self.ln2(x))
         return _seq_shard(x, self._cfg)
 
@@ -271,20 +420,31 @@ class GPTModel(Layer):
         self.final_ln = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
 
     def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None,
-                attn_mask: Optional[Tensor] = None) -> Tensor:
+                attn_mask: Optional[Tensor] = None, kv_cache=None,
+                cache_index=None) -> Tensor:
+        pos = _as_pos(cache_index) if kv_cache is not None else None
+        if kv_cache is not None and position_ids is None:
+            position_ids = _cache_position_ids(input_ids, pos)
         h = self.embeddings(input_ids, position_ids)
         k = self.config.recompute_interval
         for i, layer in enumerate(self.layers):
-            if k and (i % k == 0) and self.training:
+            if kv_cache is not None:
+                h = layer(h, attn_mask, layer_kv=kv_cache.layer(i),
+                          cache_index=pos)
+            elif k and (i % k == 0) and self.training:
                 h = recompute(layer, h, attn_mask)
             else:
                 h = layer(h, attn_mask)
         return self.final_ln(h)
 
 
-class GPTForPretraining(Layer):
+class GPTForPretraining(Layer, GenerationMixin):
     """LM head tied to the word embedding (reference GPT fixtures tie
-    weights; logits = h @ E^T, a vocab-sharded matmul under TP)."""
+    weights; logits = h @ E^T, a vocab-sharded matmul under TP).
+
+    Serving: inherits ``generate()`` (models/generation.py) — greedy /
+    temperature / top-k / top-p over a donated KV cache with zero
+    retraces after warmup."""
 
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -292,11 +452,24 @@ class GPTForPretraining(Layer):
         self.config = cfg
 
     def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None,
-                attn_mask: Optional[Tensor] = None) -> Tensor:
-        h = self.gpt(input_ids, position_ids, attn_mask)
+                attn_mask: Optional[Tensor] = None, kv_cache=None,
+                cache_index=None) -> Tensor:
+        h = self.gpt(input_ids, position_ids, attn_mask,
+                     kv_cache=kv_cache, cache_index=cache_index)
         w = self.gpt.embeddings.word_embeddings.weight  # [V, H]
         logits = ops.matmul(h, w, transpose_y=True)     # [B, S, V]
         return logits
+
+    # -- GenerationMixin cache contract ------------------------------------
+    def new_kv_cache(self, batch_size: int, max_seq: int,
+                     dtype: str = "bfloat16") -> KVCache:
+        cfg = self.config
+        return KVCache(cfg.num_layers, batch_size, cfg.num_heads, max_seq,
+                       cfg.head_dim, dtype=dtype, stacked=False)
+
+    def _cached_lm_logits(self, input_ids, kv_cache, cache_index):
+        return self.forward(input_ids, kv_cache=kv_cache,
+                            cache_index=cache_index)
 
 
 class GPTStackedDecoder(Layer):
@@ -396,23 +569,10 @@ class GPTStackedDecoder(Layer):
 
         cdt = _amp_state.dtype if (_amp_state.enabled and _amp_state.level == "O1") else None
 
-        use_flash = cfg.use_flash_attention
-        if use_flash is None:
-            from ..core import flags as _flags
-
-            use_flash = bool(_flags.flag("FLAGS_use_pallas_flash_attention"))
-
-        def _on_tpu():
-            try:
-                return jax.devices()[0].platform == "tpu"
-            except Exception:
-                return False
+        use_flash = _resolve_use_flash(cfg)
 
         def ln(x, g, b):
-            x = x.astype(jnp.float32)
-            mu = x.mean(-1, keepdims=True)
-            var = ((x - mu) ** 2).mean(-1, keepdims=True)
-            return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+            return _ln_f32(x, g, b, eps)
 
         def drop(x, rate, key):
             if not with_dropout or rate <= 0.0:
@@ -426,7 +586,7 @@ class GPTStackedDecoder(Layer):
             # path inside the kernel); else the XLA expression with fp32
             # softmax.  Both see amp-dtype q/k/v.
             from ..ops.pallas_kernels.flash_attention import (
-                flash_attention_bnsd, shape_supported,
+                _on_tpu, flash_attention_bnsd, shape_supported,
             )
 
             if (use_flash and _on_tpu() and not (with_dropout and attn_p > 0.0)
@@ -470,11 +630,88 @@ class GPTStackedDecoder(Layer):
 
         return block, with_dropout
 
-    def forward(self, hidden: Tensor, n_micro: int = 1) -> Tensor:
+    def _cached_block_fn(self, pos_is_zero=True):
+        """Decode-block body: like _block_fn but threading a per-layer KV
+        cache slice through the scan — (params, h, k_cache, v_cache, pos)
+        -> (h, k_cache, v_cache).  Inference-only: no dropout; AMP casts
+        follow _block_fn's discipline (matmuls in amp dtype, LayerNorm
+        fp32)."""
+        cfg = self._cfg
+        nh, hd = cfg.num_heads, cfg.head_dim
+        eps = cfg.layer_norm_eps
+        from ..amp.auto_cast import _amp_state
+
+        cdt = _amp_state.dtype if (_amp_state.enabled
+                                   and _amp_state.level == "O1") else None
+        use_flash = _resolve_use_flash(cfg)
+
+        def ln(x, g, b):
+            return _ln_f32(x, g, b, eps)
+
+        def block(p, h, kc, vc, pos):
+            (l1g, l1b, qkvw, qkvb, pw, pb, l2g, l2b, f1w, f1b, f2w, f2b) = p
+            if cdt is not None:
+                qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b = (
+                    a.astype(cdt) for a in (qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b)
+                )
+            b, s, hidden = h.shape
+            x = ln(h, l1g, l1b)
+            if cdt is not None:
+                x = x.astype(cdt)
+            qkv = (x @ qkvw + qkvb).reshape(b, s, 3, nh, hd)
+            q, k, v = (jnp.swapaxes(qkv[:, :, i], 1, 2) for i in range(3))
+            out, kc, vc = _raw_attend_with_cache(
+                q, k, v, kc, vc, pos, head_dim=hd, use_flash=use_flash,
+                pos_is_zero=pos_is_zero)
+            out = jnp.swapaxes(out, 1, 2).reshape(b, s, hidden)
+            h = h + (out @ pw + pb).astype(h.dtype)
+            y = ln(h, l2g, l2b)
+            if cdt is not None:
+                y = y.astype(cdt)
+            y = jax.nn.gelu(y @ f1w + f1b, approximate=True) @ f2w + f2b
+            return h + y.astype(h.dtype), kc, vc
+
+        return block
+
+    def _forward_cached(self, hidden: Tensor, kv_cache, cache_index) -> Tensor:
+        """Decode/prefill over the stacked parameters with a STACKED
+        [L, B, H, max_seq, D] cache: lax.scan carries the hidden state and
+        scans the per-layer cache slices as xs/ys.  The updated stacked
+        cache is written back in place (mutation-logged -> donated under
+        jit.to_static).  The pp pipeline does not apply to serving steps —
+        decode always scans."""
+        from ..ops import dispatch
+
+        pos = _as_pos(cache_index)
+        block = self._cached_block_fn(pos_is_zero=_pos_is_static_zero(pos))
+
+        def raw(h, posr, ck, cv, *stacked):
+            def step(carry, xs):
+                params, kc, vc = xs[:-2], xs[-2], xs[-1]
+                h2, kc2, vc2 = block(params, carry, kc, vc,
+                                     posr.astype(jnp.int32))
+                return h2, (kc2, vc2)
+
+            h2, (ck2, cv2) = jax.lax.scan(step, h, tuple(stacked) + (ck, cv))
+            return h2, ck2, cv2
+
+        out, ck_new, cv_new = dispatch.apply(
+            raw, hidden, pos, kv_cache.k, kv_cache.v, *self._stacked(),
+            op_name="gpt_stacked_decoder_cached")
+        kv_cache.k._set_value(ck_new._value)
+        kv_cache.v._set_value(cv_new._value)
+        return out
+
+    def forward(self, hidden: Tensor, n_micro: int = 1, kv_cache=None,
+                cache_index=None) -> Tensor:
         """hidden: [B, S, H]. With a pp axis > 1, splits B into n_micro
-        microbatches and pipelines; else scans layers."""
+        microbatches and pipelines; else scans layers.  With ``kv_cache``
+        (serving), runs the cached decode scan instead."""
         from ..ops import dispatch
         from ..distributed.fleet.meta_parallel import pp_spmd
+
+        if kv_cache is not None:
+            return self._forward_cached(hidden, kv_cache, cache_index)
 
         cfg = self._cfg
         block, with_dropout = self._block_fn()
@@ -524,10 +761,11 @@ class GPTStackedDecoder(Layer):
                               op_name="gpt_stacked_decoder")
 
 
-class GPTStackedForPretraining(Layer):
+class GPTStackedForPretraining(Layer, GenerationMixin):
     """Flagship perf model: embeddings + stacked/pipelined decoder + tied
     LM head. Single-chip it scans; on a dp×sp×mp×pp mesh it runs the full
-    hybrid-parallel SPMD program."""
+    hybrid-parallel SPMD program.  Serving: ``generate()`` over a stacked
+    [L, B, H, max_seq, D] donated KV cache."""
 
     def __init__(self, cfg: GPTConfig, n_micro: int = 1):
         super().__init__()
@@ -538,13 +776,17 @@ class GPTStackedForPretraining(Layer):
         self.final_ln = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_eps)
 
     def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None,
-                labels: Optional[Tensor] = None) -> Tensor:
+                labels: Optional[Tensor] = None, kv_cache=None,
+                cache_index=None) -> Tensor:
         """Without ``labels``: returns [B, S, V] logits.  With ``labels``:
         returns the scalar LM loss through the fused linear+cross-entropy
         head (chunked over tokens, logits never fully materialized — the
         HBM-friendly path; see F.fused_linear_cross_entropy)."""
+        if kv_cache is not None and position_ids is None:
+            position_ids = _cache_position_ids(input_ids, _as_pos(cache_index))
         h = self.embeddings(input_ids, position_ids)
-        h = self.decoder(h, n_micro=self.n_micro)
+        h = self.decoder(h, n_micro=self.n_micro, kv_cache=kv_cache,
+                         cache_index=cache_index)
         h = self.final_ln(h)
         w = self.embeddings.word_embeddings.weight
         if labels is not None:
@@ -553,6 +795,17 @@ class GPTStackedForPretraining(Layer):
             cdt = _amp_state.dtype if _amp_state.enabled else None
             return F.fused_linear_cross_entropy(h, w, labels, compute_dtype=cdt)
         return ops.matmul(h, w, transpose_y=True)
+
+    # -- GenerationMixin cache contract ------------------------------------
+    def new_kv_cache(self, batch_size: int, max_seq: int,
+                     dtype: str = "bfloat16") -> KVCache:
+        cfg = self.config
+        return KVCache(cfg.num_layers, batch_size, cfg.num_heads, max_seq,
+                       cfg.head_dim, dtype=dtype, stacked=True)
+
+    def _cached_lm_logits(self, input_ids, kv_cache, cache_index):
+        return self.forward(input_ids, kv_cache=kv_cache,
+                            cache_index=cache_index)
 
 
 class GPTPretrainingCriterion(Layer):

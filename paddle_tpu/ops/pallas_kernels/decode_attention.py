@@ -1,0 +1,208 @@
+"""Decode attention on TPU — single-query flash-decode over a KV cache.
+
+The serving analog of ``flash_attention.py``: autoregressive decode issues
+ONE query per (batch, head) against a preallocated ``[B, H, max_seq, D]``
+cache of which only the first ``length`` positions are valid.  The training
+flash kernel is the wrong tool here (its q axis is blocked at >=128 rows);
+decode throughput on TPU is dominated by a specialized q-len-1 kernel over
+the cache (PAPERS.md: "Ragged Paged Attention", arxiv 2604.15464).
+
+Kernel shape:
+- grid ``(B*H, n_kv)`` — KV blocked over ``max_seq``; online-softmax
+  accumulation (running max m, denominator l, fp32 acc) across KV blocks.
+- the single query row is sublane-broadcast to 8 rows so every block/
+  scratch shape is tile-legal ((8, 128) fp32 tiling); the MXU pass for a
+  [8, D] x [D, block_kv] dot costs the same as [1, D], so nothing is lost.
+- ``length`` is a scalar-prefetch argument: the KV index maps clamp
+  blocks past ``length`` to the boundary block (repeated indices elide
+  the DMA) and ``pl.when`` skips their compute — decode at position p
+  both reads AND computes O(p) cache, not O(max_seq).
+- positions >= length inside the boundary block are masked to -inf before
+  the softmax (the length mask).
+
+CPU (and shape-ineligible calls) fall back to the numerically-identical
+XLA expression, same eligibility pattern as ``flash_attention.py``.  The
+kernel is forward-only: decode never differentiates through the cache.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# jax renamed TPUCompilerParams -> CompilerParams across versions; accept both
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
+NEG_INF = np.float32(-1e30)
+
+from .flash_attention import _on_tpu  # noqa: E402  (shared platform gate)
+
+
+def decode_shape_supported(max_seq: int, head_dim: int) -> bool:
+    """The ONE eligibility gate for this kernel (mirrors
+    flash_attention.shape_supported so callers can't drift): the cache's
+    seq axis divisible into 128-multiple KV blocks, head dim a 64
+    multiple."""
+    return max_seq >= 128 and max_seq % 128 == 0 and head_dim % 64 == 0
+
+
+def _dot(a, b, dims):
+    """MXU dot, fp32 accumulation; same precision discipline as the flash
+    kernel's _dot (HIGHEST only when both operands are fp32 — under
+    "highest" Mosaic rejects bf16 operands)."""
+    fp32 = (jnp.dtype(a.dtype) == jnp.float32
+            and jnp.dtype(b.dtype) == jnp.float32)
+    return jax.lax.dot_general(
+        a, b, (dims, ((), ())),
+        precision=(jax.lax.Precision.HIGHEST if fp32
+                   else jax.lax.Precision.DEFAULT),
+        preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
+                   *, scale, block_kv, n_kv):
+    kv_i = pl.program_id(1)
+    length = len_ref[0]
+
+    @pl.when(kv_i == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+
+    # runtime block skip: a KV block starting at/after `length` holds no
+    # valid positions — decode at position p touches O(p) cache
+    @pl.when(kv_i * block_kv < length)
+    def _body():
+        q = q_ref[0]                                # [8, D] (row-broadcast)
+        k = k_ref[0]                                # [block_kv, D]
+        v = v_ref[0]
+        s = _dot(q, k, ((1,), (1,))) * np.float32(scale)   # [8, block_kv]
+        cols = kv_i * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(cols < length, s, NEG_INF)
+
+        m_prev = m_sc[:, :1]                        # [8, 1]
+        l_prev = l_sc[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        l_cur = jnp.sum(p, axis=-1, keepdims=True)
+        alpha = jnp.exp(m_prev - m_new)
+        acc_sc[...] = acc_sc[...] * alpha + _dot(p.astype(v.dtype), v,
+                                                 ((1,), (0,)))
+        m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[...] = jnp.broadcast_to(l_new := alpha * l_prev + l_cur,
+                                     l_sc.shape)
+
+    @pl.when(kv_i == n_kv - 1)
+    def _finish():
+        l = l_sc[:, :1]
+        l_safe = jnp.where(l == 0.0, np.float32(1.0), l)
+        o_ref[0] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
+
+
+def _pick_block_kv(s: int) -> int:
+    b = min(512, s)
+    while s % b:
+        b //= 2
+    return max(b, 128) if s % max(b, 128) == 0 else b
+
+
+def _decode_pallas(q, k, v, length, scale, interpret=False):
+    """q: [BH, 8, D] (row-broadcast query), k/v: [BH, S, D],
+    length: scalar int32 -> [BH, 8, D].  ``interpret=True`` runs the
+    kernel through the Pallas interpreter (CPU numerics check).
+
+    ``length`` rides as a scalar-prefetch argument so the KV index maps
+    can see it BEFORE each DMA is issued: blocks past the valid length are
+    clamped to the boundary block, and Pallas elides copies whose block
+    index repeats the previous grid step's — so a decode at position p
+    streams O(p) cache from HBM, not O(max_seq).  (A pl.when alone would
+    only skip the compute; BlockSpec copies fire regardless.)"""
+    bh, s, d = k.shape
+    block_kv = _pick_block_kv(s)
+    n_kv = s // block_kv
+    kernel = functools.partial(_decode_kernel, scale=scale,
+                               block_kv=block_kv, n_kv=n_kv)
+    len_arr = jnp.reshape(length, (1,)).astype(jnp.int32)
+
+    def kv_index(b, ki, len_ref):
+        last = jnp.maximum((len_ref[0] - 1) // block_kv, 0)
+        return (b, jnp.minimum(ki, last), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, n_kv),
+        in_specs=[
+            pl.BlockSpec((1, 8, d), lambda b, ki, len_ref: (b, 0, 0)),
+            pl.BlockSpec((1, block_kv, d), kv_index),
+            pl.BlockSpec((1, block_kv, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 8, d), lambda b, ki, len_ref: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((8, d), jnp.float32),
+            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((8, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, 8, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(len_arr, q, k, v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def decode_attention(q, k_cache, v_cache, length, *, sm_scale=None):
+    """Single-query attention over a preallocated KV cache.
+
+    q:        [B, H, D]   — the ONE new query per (batch, head)
+    k_cache:  [B, H, S, D] (S = max_seq, preallocated)
+    v_cache:  [B, H, S, D]
+    length:   scalar int — number of valid cache positions (traced OK)
+    returns   [B, H, D]
+
+    Routes to the Pallas flash-decode kernel on TPU when the cache shape
+    is eligible, else the XLA expression (identical numerics).
+    """
+    b, h, s, d = k_cache.shape
+    scale = float(sm_scale if sm_scale is not None else 1.0 / (d ** 0.5))
+    q = q.astype(k_cache.dtype)
+    if _on_tpu() and decode_shape_supported(s, d):
+        # sublane-broadcast the query row to 8 so blocks are tile-legal
+        q8 = jnp.broadcast_to(q.reshape(b * h, 1, d), (b * h, 8, d))
+        out = _decode_pallas(q8, k_cache.reshape(b * h, s, d),
+                             v_cache.reshape(b * h, s, d),
+                             length, scale)
+        return out[:, 0, :].reshape(b, h, d)
+    return _xla_decode_reference(q, k_cache, v_cache, length, scale)
+
+
+def _xla_decode_reference(q, k_cache, v_cache, length, scale):
+    """jnp-composed reference: masked single-query attention, fp32
+    softmax (the fallback AND the parity oracle for tpu_smoke)."""
+    s = jnp.einsum("bhd,bhsd->bhs", q, k_cache,
+                   preferred_element_type=jnp.float32) * np.float32(scale)
+    valid = jnp.arange(k_cache.shape[2]) < length
+    s = jnp.where(valid[None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhs,bhsd->bhd", p.astype(q.dtype), v_cache)

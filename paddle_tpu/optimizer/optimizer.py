@@ -180,8 +180,18 @@ class Optimizer:
         return g_raw
 
     def clear_grad(self, set_to_zero=False):
+        """Drop (or zero) accumulated gradients (reference
+        Optimizer.clear_grad / clear_gradients).  ``set_to_zero=True``
+        writes a zeros-like gradient instead of unbinding — the next
+        backward ACCUMULATES into it (reference set_to_zero semantics,
+        where the grad tensor keeps its buffer); params that never had a
+        grad stay grad-less either way."""
         for p in self._parameter_list:
-            p.grad = None
+            if set_to_zero and p.grad is not None:
+                # in place: cached references to the grad Tensor see zeros
+                p.grad._set_value(jnp.zeros_like(p.grad._value))
+            else:
+                p.grad = None
 
     clear_gradients = clear_grad
 

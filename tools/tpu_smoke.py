@@ -65,6 +65,23 @@ def main() -> int:
                                     - y.astype(jnp.float32)).max())
                 assert err < 0.05, f"bwd causal={causal} err={err}"
 
+    # -- decode attention (q-len-1 flash-decode) vs jnp reference --------
+    def decode_attention():
+        from paddle_tpu.ops.pallas_kernels import decode_attention as da
+        B, H, S, D = 2, 4, 512, 64
+        q = jnp.array(rng.randn(B, H, D), jnp.bfloat16)
+        k = jnp.array(rng.randn(B, H, S, D), jnp.bfloat16)
+        v = jnp.array(rng.randn(B, H, S, D), jnp.bfloat16)
+        assert da.decode_shape_supported(S, D)
+        # boundary lengths: single position, inside a block, block edge, full
+        for length in (1, 5, 127, 128, 200, 512):
+            ln = jnp.int32(length)
+            got = da.decode_attention(q, k, v, ln).astype(jnp.float32)
+            want = da._xla_decode_reference(
+                q, k, v, ln, 0.125).astype(jnp.float32)
+            err = float(jnp.abs(got - want).max())
+            assert err < 0.05, f"len={length} err={err}"
+
     # -- fused AdamW slab kernel vs composed update ----------------------
     def fused_adamw():
         from paddle_tpu.ops.pallas_kernels.fused_adamw import fused_adamw_update
@@ -108,6 +125,7 @@ def main() -> int:
                 assert err < 0.05, f"{fn_name} {part} err={err}"
 
     check("flash_attention", flash)
+    check("decode_attention", decode_attention)
     check("fused_adamw", fused_adamw)
     check("rms_norm", rms_norm)
 
