@@ -1,0 +1,37 @@
+"""Static analysis of traced programs (Graph Lint).
+
+``analysis.lint(fn, *args)`` walks the jaxpr of any traceable function and
+returns findings with stable codes (GL001-GL007), severities, and eqn
+provenance; ``FLAGS_graph_lint`` / ``PADDLE_TPU_GRAPH_LINT=1`` lints every
+``jit.to_static`` program at compile time; ``tools/graph_lint.py`` is the
+CI gate over the bench models.  See docs/graph_lint.md.
+"""
+from .codes import (  # noqa: F401
+    CODES,
+    SEVERITY_RANK,
+    GateReason,
+    decode_gate_reason,
+    flash_gate_reason,
+    misaligned_dims,
+)
+from .graph_lint import (  # noqa: F401
+    Baseline,
+    Finding,
+    LintConfig,
+    LintReport,
+    churn_findings,
+    clear_reports,
+    lint,
+    lint_jaxpr,
+    lint_static_program,
+    reports,
+    set_announce,
+)
+
+__all__ = [
+    "CODES", "SEVERITY_RANK", "GateReason", "decode_gate_reason",
+    "flash_gate_reason", "misaligned_dims",
+    "Baseline", "Finding", "LintConfig", "LintReport", "churn_findings",
+    "clear_reports", "lint", "lint_jaxpr", "lint_static_program", "reports",
+    "set_announce",
+]

@@ -1,0 +1,132 @@
+"""Graph Lint finding codes, severities, and the shared TPU tiling rules.
+
+This module is deliberately dependency-free (no jax import): the Pallas
+kernel eligibility gates (`ops/pallas_kernels/flash_attention.py`,
+`decode_attention.py`) import it at kernel-module import time, and the
+linter (`analysis/graph_lint.py`) uses the SAME rules — so a shape the
+kernels reject for tiling reasons and a shape the linter flags as
+tile-misaligned are described by one definition, with one code (GL002).
+
+Codes are stable API: baselines (`tools/graph_lint_baseline.json`) and CI
+wrappers key on them.  Adding a pass means adding a code HERE first (see
+docs/graph_lint.md "how to add a pass").
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+__all__ = [
+    "CODES", "SEVERITY_RANK", "TILE_SUBLANE", "TILE_LANE",
+    "misaligned_dims", "GateReason", "flash_gate_reason",
+    "decode_gate_reason",
+]
+
+# code -> (short name, default severity).  Severities: "error" (correctness
+# or a hard perf cliff), "warning" (perf/memory hazard worth a human look),
+# "info" (advisory; never fails the CI gate).
+CODES = {
+    "GL001": ("dtype-promotion", "error"),
+    "GL002": ("tile-misalignment", "warning"),
+    "GL003": ("host-sync", "error"),
+    "GL004": ("donation-miss", "warning"),
+    "GL005": ("dead-code", "warning"),
+    "GL006": ("intermediate-blowup", "warning"),
+    "GL007": ("retrace-churn", "warning"),
+}
+
+SEVERITY_RANK = {"error": 3, "warning": 2, "info": 1}
+
+# The TPU vector-register tile for fp32: 8 sublanes x 128 lanes.  A dim
+# smaller than one tile is padded once and is not actionable; a LARGER dim
+# that is not a tile multiple wastes a partial tile per row/column of
+# tiles, so only dims beyond the tile size count as misaligned.
+TILE_SUBLANE = 8
+TILE_LANE = 128
+
+
+def misaligned_dims(shape) -> List[Tuple[int, int, int]]:
+    """(axis, dim, tile) for each trailing dim of ``shape`` that exceeds
+    its (8, 128) tile but is not a multiple of it."""
+    out = []
+    n = len(shape)
+    if n >= 1:
+        d = int(shape[-1])
+        if d > TILE_LANE and d % TILE_LANE:
+            out.append((n - 1, d, TILE_LANE))
+    if n >= 2:
+        d = int(shape[-2])
+        if d > TILE_SUBLANE and d % TILE_SUBLANE:
+            out.append((n - 2, d, TILE_SUBLANE))
+    return out
+
+
+class GateReason:
+    """Structured 'why the Pallas kernel was not used' carrying the lint
+    code — the one formatting both the kernels' fallback logs and the
+    linter's GL002 findings use."""
+
+    __slots__ = ("code", "kernel", "detail")
+
+    def __init__(self, code: str, kernel: str, detail: str):
+        self.code = code
+        self.kernel = kernel
+        self.detail = detail
+
+    def __str__(self) -> str:
+        name = CODES.get(self.code, ("", ""))[0]
+        return f"[{self.code} {name}] {self.kernel}: {self.detail}"
+
+    def __repr__(self) -> str:
+        return f"GateReason({self.code!r}, {self.kernel!r}, {self.detail!r})"
+
+
+def _attention_gate(seq_len: int, head_dim: int, kernel: str,
+                    seq_name: str) -> Optional[GateReason]:
+    problems = []
+    if seq_len < TILE_LANE or seq_len % TILE_LANE:
+        problems.append(
+            f"{seq_name}={seq_len} is not a {TILE_LANE}-multiple >= "
+            f"{TILE_LANE} (KV blocking)")
+    if head_dim % 64:
+        problems.append(f"head_dim={head_dim} is not a 64-multiple "
+                        "(MXU contraction width)")
+    if not problems:
+        return None
+    return GateReason("GL002", kernel, "; ".join(problems))
+
+
+def flash_gate_reason(seq_len: int, head_dim: int) -> Optional[GateReason]:
+    """None when the training flash kernel accepts the shape, else the
+    GL002-coded reason it falls back to XLA."""
+    return _attention_gate(seq_len, head_dim, "flash_attention", "seq_len")
+
+
+def decode_gate_reason(max_seq: int, head_dim: int) -> Optional[GateReason]:
+    """None when the q-len-1 flash-decode kernel accepts the cache shape,
+    else the GL002-coded reason it falls back to XLA."""
+    return _attention_gate(max_seq, head_dim, "decode_attention", "max_seq")
+
+
+# one line per DISTINCT reason (kernel + shape) per process: a decode loop
+# hitting the gate every step must not spam stderr.  Bounded: a varlen
+# workload probing a new unaligned length per batch would otherwise grow
+# the set (and the log) forever — past the cap the gate saturates silently
+# (same discipline as core/op_cache's _SHAPE_KEY_CAP).
+_SEEN_FALLBACKS: set = set()
+_SEEN_FALLBACKS_CAP = 64
+
+
+def note_fallback(reason: GateReason, stream=None):
+    """Record a kernel's XLA fallback with its structured reason, once per
+    distinct (kernel, detail) up to a cap.  The Pallas eligibility gates
+    call this on TPU hosts so a silently-slower fallback is visible in
+    stderr with the same GL002 formatting the linter uses."""
+    key = str(reason)
+    if key in _SEEN_FALLBACKS or len(_SEEN_FALLBACKS) >= _SEEN_FALLBACKS_CAP:
+        return
+    _SEEN_FALLBACKS.add(key)
+    import sys
+
+    (stream or sys.stderr).write(
+        f"[paddle_tpu.graph_lint] {reason}; falling back to the XLA "
+        "expression\n")

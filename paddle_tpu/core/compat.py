@@ -1,0 +1,70 @@
+"""Version compatibility shims over the jax API surface.
+
+``jax.shard_map`` (with ``axis_names=`` naming the MANUAL axes and
+``check_vma=``) only exists on newer jax; older releases ship
+``jax.experimental.shard_map.shard_map`` whose ``auto=`` parameter is the
+complement (the axes left to GSPMD) and whose replication check is called
+``check_rep``.  Every shard_map call site in the package goes through
+:func:`shard_map` so the package runs unmodified on either API.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+__all__ = ["shard_map", "pcast", "axis_size"]
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` when available, else the classic
+    ``psum(1, axis)`` idiom (a compile-time constant under shard_map)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast(x, axis_names, to="varying"):
+    """``jax.lax.pcast`` when available (the varying/replicated cast the
+    new-API replication checker wants), identity otherwise — the old
+    experimental shard_map runs these bodies with ``check_rep=False``,
+    where the distinction is not tracked."""
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axis_names, to=to)
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None,
+              check_vma: Optional[bool] = None):
+    """``jax.shard_map`` when available, else the experimental one with
+    ``axis_names`` translated to its complementary ``auto=`` set.
+
+    ``axis_names``: the axes the body handles manually (None = all of
+    them).  ``check_vma``: the replication check (None = jax's default,
+    except on the experimental API with partial-manual axes, where the
+    check does not support ``auto`` and is disabled).
+    """
+    if hasattr(jax, "shard_map"):
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = frozenset(axis_names)
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    partial_manual = (axis_names is not None
+                      and frozenset(mesh.axis_names) - frozenset(axis_names))
+    # The experimental `auto=` (the complement of axis_names) is not usable
+    # here: its eager impl raises NotImplementedError and its lowering
+    # emits a PartitionId op SPMD partitioning rejects.  Run FULLY manual
+    # instead — axes the body does not touch see replicated data (specs
+    # that do not mention them), so results are identical; the only loss
+    # is GSPMD auto-partitioning of the body math over those axes.
+    check_rep = False if (check_vma is False or partial_manual) else True
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_rep, auto=frozenset())

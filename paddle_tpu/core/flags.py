@@ -66,5 +66,13 @@ define_flag("FLAGS_eager_op_cache_size", 1024,
 define_flag("FLAGS_eager_cache_log",
             False, "dump eager op-cache dispatch counters at process exit")
 define_flag("FLAGS_use_bf16_matmul", False, "force bf16 matmul accumulation")
+# Graph Lint: lint every jit.to_static program at compile time
+# (paddle_tpu/analysis). PADDLE_TPU_GRAPH_LINT=1 is the documented alias;
+# FLAGS_graph_lint in the environment still takes precedence via the
+# standard env initialisation above.
+define_flag("FLAGS_graph_lint",
+            os.environ.get("PADDLE_TPU_GRAPH_LINT", "").lower()
+            in ("1", "true", "yes", "on"),
+            "run the jaxpr graph linter on every compiled to_static program")
 define_flag("FLAGS_log_level", 0, "framework VLOG level")
 define_flag("FLAGS_benchmark", False, "block on every op for timing")

@@ -77,6 +77,13 @@ _lock = threading.RLock()
 _cache: "OrderedDict[Tuple, _Entry]" = OrderedDict()
 _stats: Dict[str, Dict[str, Any]] = {}
 
+# per-op distinct input-aval signatures, for the graph linter's GL007
+# retrace-churn pass (and users): how many distinct shape keys each op was
+# dispatched under, visible WITHOUT enabling any logging.  Bounded per op —
+# past the cap the count saturates (the churn verdict is long since in).
+_SHAPE_KEY_CAP = 512
+_shape_keys: Dict[str, set] = {}
+
 
 class _Entry:
     """One compiled dispatch artifact.  ``fn`` is the jitted callable
@@ -276,6 +283,9 @@ def acquire(op_name: str, raw_fn: Callable, fwd: Callable, raws, attrs,
             fb = st["fallbacks"]
             fb[reason] = fb.get(reason, 0) + 1
             return None
+        sk = _shape_keys.setdefault(op_name, set())
+        if len(sk) < _SHAPE_KEY_CAP:
+            sk.add(key[2])  # the input avals slot of the cache key
         entry = _cache.get(key)
         if entry is not None:
             _cache.move_to_end(key)
@@ -348,10 +358,13 @@ def count_bwd(op_name: str, jitted: bool):
 # ---------------------------------------------------------------------------
 
 def stats() -> Dict[str, Dict[str, Any]]:
-    """Per-op dispatch counters (deep copy)."""
+    """Per-op dispatch counters (deep copy).  ``shape_keys`` is the number
+    of distinct input-aval signatures the op was dispatched under (the
+    GL007 retrace-churn signal; saturates at the internal cap)."""
     with _lock:
         return {
-            name: {**st, "fallbacks": dict(st["fallbacks"])}
+            name: {**st, "fallbacks": dict(st["fallbacks"]),
+                   "shape_keys": len(_shape_keys.get(name, ()))}
             for name, st in _stats.items()
         }
 
@@ -359,6 +372,7 @@ def stats() -> Dict[str, Dict[str, Any]]:
 def reset_stats():
     with _lock:
         _stats.clear()
+        _shape_keys.clear()
 
 
 def summary() -> Dict[str, Any]:
@@ -394,6 +408,7 @@ def clear(reset: bool = False):
         _family.clear()
         if reset:
             _stats.clear()
+            _shape_keys.clear()
 
 
 def log_stats(stream=None, top: int = 20):

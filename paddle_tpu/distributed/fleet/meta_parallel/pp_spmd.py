@@ -37,6 +37,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ... import mesh as _mesh
+from ....core import compat as _compat
 
 __all__ = ["scan_blocks", "pipeline_blocks", "stacked_param_sharding"]
 
@@ -169,7 +170,7 @@ def pipeline_blocks(block_fn: Callable, stacked: Sequence, x_micro, *,
 
         # zeros are pp-invariant; the scan carry becomes pp-varying (each
         # stage computes different activations), so pcast the initial carry
-        varying = lambda z: jax.lax.pcast(z, (pp_axis,), to="varying")  # noqa: E731
+        varying = lambda z: _compat.pcast(z, (pp_axis,), to="varying")  # noqa: E731
         state = varying(jnp.zeros_like(x_local[0]))
         outputs = varying(jnp.zeros_like(x_local))
         # phase-wrap buffer (interleave only): device 0 parks activations
@@ -227,7 +228,7 @@ def pipeline_blocks(block_fn: Callable, stacked: Sequence, x_micro, *,
         tuple(PartitionSpec(pp_axis, *nd(s)) for s in stacked),
         PartitionSpec(),  # microbatches replicated over pp (dp/sp stay auto)
     )
-    fn = jax.shard_map(
+    fn = _compat.shard_map(
         spmd,
         mesh=mesh,
         in_specs=in_specs,

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .....core import compat as _compat
 from .....distributed import mesh as _mesh
 from .....nn.layer import Layer
 from .....ops import dispatch as _dispatch
@@ -210,7 +211,7 @@ class MoELayer(Layer):
                 overflow = jax.lax.psum(overflow, "ep")
                 return yt.reshape(xr_l.shape), aux, overflow
 
-            return jax.shard_map(
+            return _compat.shard_map(
                 per_shard, mesh=mesh,
                 in_specs=(P("ep"), P("ep"), P("ep"), P("ep"), P("ep"),
                           P("ep")),

@@ -110,6 +110,7 @@ class _CompiledEntry:
         "gen_threshold",
         "stale_ordinals",
         "_scout_result",
+        "lint_report",
     )
 
     def __init__(self):
@@ -132,6 +133,14 @@ class _CompiledEntry:
         self.n_args = 0
         self.gen_threshold = 0
         self._scout_result = None
+        # LintReport from the FLAGS_graph_lint compile hook (None when the
+        # flag is off or the lint itself failed)
+        self.lint_report = None
+
+
+# every StaticFunction ever built (weak): the GL007 retrace-churn pass
+# reads each fn's code-cache size to spot shape-churning to_static calls
+_STATIC_REGISTRY: "weakref.WeakSet[StaticFunction]" = weakref.WeakSet()
 
 
 class StaticFunction:
@@ -147,6 +156,7 @@ class StaticFunction:
         self._fn = convert_to_static(fn)
         self._cache: Dict[Any, _CompiledEntry] = {}
         functools.update_wrapper(self, fn)
+        _STATIC_REGISTRY.add(self)
 
     @property
     def code_cache(self):
@@ -449,6 +459,9 @@ class StaticFunction:
         # the trace rebuilds arg Tensors from raw values — preserve each
         # arg's stop_gradient so differentiating w.r.t. an input works
         arg_sgs = [t.stop_gradient for t in arg_list]
+        arg_structs = [
+            jax.ShapeDtypeStruct(tuple(t._value.shape), t._value.dtype)
+            for t in arg_list]
         del arg_list
 
         def pure_fn(raw_args, raw_mut, raw_ro):
@@ -527,6 +540,39 @@ class StaticFunction:
                     t.grad = g
 
         entry.jitted = jax.jit(pure_fn, donate_argnums=(1,))
+        self._maybe_lint(entry, pure_fn, arg_structs)
+
+    def _maybe_lint(self, entry, pure_fn, arg_structs):
+        """FLAGS_graph_lint / PADDLE_TPU_GRAPH_LINT=1: lint the program
+        being installed (one extra abstract trace — zero compute) and
+        stash the report on the entry + the analysis report registry."""
+        from ..core import flags as _flags
+
+        try:
+            if not _flags.flag("FLAGS_graph_lint"):
+                return
+        except KeyError:  # pragma: no cover - flags registry always has it
+            return
+        from .. import analysis as _analysis
+
+        name = getattr(self._fn, "__name__", None) or "to_static_fn"
+        mk = lambda t: jax.ShapeDtypeStruct(  # noqa: E731
+            tuple(t._value.shape), t._value.dtype)
+        try:
+            entry.lint_report = _analysis.lint_static_program(
+                pure_fn, arg_structs,
+                [mk(t) for t in entry.mut_caps],
+                [mk(t) for t in entry.ro_caps],
+                program=name)
+        except Exception as e:  # noqa: BLE001 — lint must never break compile
+            sys.stderr.write(
+                f"[paddle_tpu.graph_lint] lint of '{name}' failed: "
+                f"{type(e).__name__}: {e}\n")
+
+    def lint_reports(self):
+        """LintReports of every compiled entry (FLAGS_graph_lint runs)."""
+        return [e.lint_report for e in self._cache.values()
+                if e.lint_report is not None]
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):

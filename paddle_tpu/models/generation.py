@@ -237,6 +237,12 @@ class _DecodeEngine:
         are per prompt shape; decode is always exactly one)."""
         return len(self.prefill.code_cache) + len(self.decode.code_cache)
 
+    def lint_reports(self):
+        """Graph-lint reports of every compiled prefill/decode program
+        (populated when FLAGS_graph_lint / PADDLE_TPU_GRAPH_LINT=1 was on
+        at compile time; see docs/graph_lint.md)."""
+        return self.prefill.lint_reports() + self.decode.lint_reports()
+
 
 # each cached engine pins a full KV cache in HBM; bound how many distinct
 # (batch, max_seq, dtype, sampling-topology) combinations stay resident

@@ -614,17 +614,18 @@ class GPTStackedDecoder(Layer):
                     a.astype(cdt) for a in (qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b)
                 )
             b, s, hidden = h.shape
-            x = ln(h, l1g, l1b)
-            if cdt is not None:
-                x = x.astype(cdt)
+            # the fp32 LayerNorm output returns to the WEIGHT dtype before
+            # every projection (== cdt under AMP O1; == the storage dtype
+            # for a pure-bf16 model outside auto_cast) — otherwise jax
+            # silently promotes the bf16 weights and the matmuls leave the
+            # bf16 MXU path (graph_lint GL001)
+            x = ln(h, l1g, l1b).astype(qkvw.dtype)
             qkv = (x @ qkvw + qkvb).reshape(b, s, 3, nh, hd)
             q, k, v = (jnp.swapaxes(qkv[:, :, i], 1, 2) for i in range(3))  # [B,N,S,D]
             out = sdpa(q, k, v, k1, s)                      # [B,N,S,D]
             out = jnp.swapaxes(out, 1, 2).reshape(b, s, hidden)
-            h = h + drop(out @ pw + pb, hid_p, k2).astype(h.dtype)
-            y = ln(h, l2g, l2b)
-            if cdt is not None:
-                y = y.astype(cdt)
+            h = h + drop(out.astype(pw.dtype) @ pw + pb, hid_p, k2).astype(h.dtype)
+            y = ln(h, l2g, l2b).astype(f1w.dtype)
             y = jax.nn.gelu(y @ f1w + f1b, approximate=True) @ f2w + f2b
             return h + drop(y, hid_p, k3).astype(h.dtype)
 
@@ -655,19 +656,19 @@ class GPTStackedDecoder(Layer):
                     a.astype(cdt) for a in (qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b)
                 )
             b, s, hidden = h.shape
-            x = ln(h, l1g, l1b)
-            if cdt is not None:
-                x = x.astype(cdt)
+            # fp32 LayerNorm output returns to the weight dtype before the
+            # projections — generate() runs OUTSIDE auto_cast, so without
+            # this a pure-bf16 model decodes with every matmul silently
+            # promoted to fp32 (graph_lint GL001; serving hot path)
+            x = ln(h, l1g, l1b).astype(qkvw.dtype)
             qkv = (x @ qkvw + qkvb).reshape(b, s, 3, nh, hd)
             q, k, v = (jnp.swapaxes(qkv[:, :, i], 1, 2) for i in range(3))
             out, kc, vc = _raw_attend_with_cache(
                 q, k, v, kc, vc, pos, head_dim=hd, use_flash=use_flash,
                 pos_is_zero=pos_is_zero)
             out = jnp.swapaxes(out, 1, 2).reshape(b, s, hidden)
-            h = h + (out @ pw + pb).astype(h.dtype)
-            y = ln(h, l2g, l2b)
-            if cdt is not None:
-                y = y.astype(cdt)
+            h = h + (out.astype(pw.dtype) @ pw + pb).astype(h.dtype)
+            y = ln(h, l2g, l2b).astype(f1w.dtype)
             y = jax.nn.gelu(y @ f1w + f1b, approximate=True) @ f2w + f2b
             return h + y.astype(h.dtype), kc, vc
 

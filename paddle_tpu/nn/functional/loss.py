@@ -418,6 +418,38 @@ def square_error_cost(input, label):  # noqa: A002
     return dispatch.apply(lambda a, b: jnp.square(a - b), input, label, op_name="square_error_cost")
 
 
+@jax.custom_vjp
+def _lm_head_dot(h, w):
+    """Chunk logits ``h [c, H] x w [V, H] -> fp32 [c, V]`` with a backward
+    that casts the fp32 cotangent down to the operand dtype BEFORE the
+    dW/dh contractions.  jax's derived vjp would contract fp32 d_logits
+    against the bf16 operands directly — a silent mixed-dtype promotion
+    that pushes both backward matmuls off the bf16 MXU path (graph_lint
+    GL001; the owned flash kernel applies the same ``ds.astype(q.dtype)``
+    discipline).  fp32 operands are untouched (the cast is a no-op)."""
+    return jax.lax.dot_general(h, w, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _lm_head_dot_fwd(h, w):
+    return _lm_head_dot(h, w), (h, w)
+
+
+def _lm_head_dot_bwd(res, g):
+    h, w = res
+    gh = g.astype(h.dtype)
+    gw = g.astype(w.dtype)
+    # dh [c, H] = g [c, V] . w [V, H];  dw [V, H] = g^T [V, c] . h [c, H]
+    dh = jax.lax.dot_general(gh, w, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    dw = jax.lax.dot_general(gw, h, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return dh.astype(h.dtype), dw.astype(w.dtype)
+
+
+_lm_head_dot.defvjp(_lm_head_dot_fwd, _lm_head_dot_bwd)
+
+
 def fused_linear_cross_entropy(hidden, weight, labels, *, chunk_tokens=2048,
                                compute_dtype=None, reduction="mean"):
     """LM-head matmul + softmax cross entropy without materializing the full
@@ -457,10 +489,7 @@ def fused_linear_cross_entropy(hidden, weight, labels, *, chunk_tokens=2048,
         @jax.checkpoint
         def chunk_loss(hx, lx):
             # fp32 accumulation on the MXU out of low-precision operands
-            logits = jax.lax.dot_general(
-                hx.astype(cdt), wt, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # [c, V]
+            logits = _lm_head_dot(hx.astype(cdt), wt)  # [c, V] fp32
             lse = jax.scipy.special.logsumexp(logits, axis=-1)
             picked = jnp.take_along_axis(logits, lx[:, None], axis=-1)[:, 0]
             return lse - picked  # [c]

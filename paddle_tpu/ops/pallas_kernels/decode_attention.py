@@ -44,12 +44,26 @@ NEG_INF = np.float32(-1e30)
 from .flash_attention import _on_tpu  # noqa: E402  (shared platform gate)
 
 
+def decode_shape_unsupported_reason(max_seq: int, head_dim: int):
+    """``None`` when the kernel accepts the cache shape, else the
+    structured GL002-coded reason (shared with the graph linter)."""
+    from ...analysis.codes import decode_gate_reason
+
+    return decode_gate_reason(max_seq, head_dim)
+
+
 def decode_shape_supported(max_seq: int, head_dim: int) -> bool:
     """The ONE eligibility gate for this kernel (mirrors
     flash_attention.shape_supported so callers can't drift): the cache's
     seq axis divisible into 128-multiple KV blocks, head dim a 64
-    multiple."""
-    return max_seq >= 128 and max_seq % 128 == 0 and head_dim % 64 == 0
+    multiple.  On TPU hosts an ineligible cache shape is reported once
+    per shape with its GL002 reason instead of silently falling back."""
+    reason = decode_shape_unsupported_reason(max_seq, head_dim)
+    if reason is not None and _on_tpu():
+        from ...analysis.codes import note_fallback
+
+        note_fallback(reason)
+    return reason is None
 
 
 def _dot(a, b, dims):

@@ -42,12 +42,29 @@ def _on_tpu() -> bool:
         return False
 
 
+def shape_unsupported_reason(seq_len: int, head_dim: int):
+    """``None`` when the kernel accepts the shape, else the structured
+    GL002-coded :class:`analysis.codes.GateReason` it falls back for —
+    the SAME rule and formatting the graph linter reports, so a kernel
+    fallback and a lint finding describe one hazard identically."""
+    from ...analysis.codes import flash_gate_reason
+
+    return flash_gate_reason(seq_len, head_dim)
+
+
 def shape_supported(seq_len: int, head_dim: int) -> bool:
     """The ONE eligibility gate for this kernel (kept here so callers —
     nn/functional/attention.py and the stacked GPT block — can't drift):
     seqlen divisible by the 128-multiple blocks, head dim a 64 multiple
-    (validated on TPU at d=64 and d=128)."""
-    return seq_len >= 128 and seq_len % 128 == 0 and head_dim % 64 == 0
+    (validated on TPU at d=64 and d=128).  On TPU hosts an ineligible
+    shape is reported once per shape with its GL002 reason instead of
+    silently taking the slower XLA expression."""
+    reason = shape_unsupported_reason(seq_len, head_dim)
+    if reason is not None and _on_tpu():
+        from ...analysis.codes import note_fallback
+
+        note_fallback(reason)
+    return reason is None
 
 
 NEG_INF = np.float32(-1e30)

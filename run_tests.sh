@@ -3,6 +3,10 @@
 #
 # Default: the FAST set (deselects @pytest.mark.slow — multi-minute XLA
 # compiles).  Pass --all to run everything (CI budget), or any pytest args.
+#
+# A graph-lint gate runs first (tools/graph_lint.py --baseline on CPU —
+# the bench-model programs must not grow NEW findings; see
+# docs/graph_lint.md).  PADDLE_TPU_SKIP_LINT_GATE=1 skips it.
 export JAX_PLATFORMS=cpu
 export PYTHONPATH=$(python - << 'PY'
 import os
@@ -11,6 +15,15 @@ PY
 )
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 export JAX_COMPILATION_CACHE_DIR=/tmp/paddle_tpu_jax_cache
+
+if [ -z "$PADDLE_TPU_SKIP_LINT_GATE" ]; then
+    echo "run_tests: graph-lint gate (tools/graph_lint.py --baseline)"
+    python "$(dirname "$0")/tools/graph_lint.py" --baseline || {
+        rc=$?
+        echo "run_tests: graph-lint gate FAILED (rc=$rc)"
+        exit $rc
+    }
+fi
 
 if [ "$1" = "--all" ]; then
     shift

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Graph Lint CLI: lint the bench models' compiled programs.
+
+Builds scaled-down stand-ins of the bench workloads (same graph structure
+and dtype regime as bench.py's pure-bf16 rungs — a bf16-decorated stacked
+GPT) and lints every compiled program:
+
+- ``train``:  the fused fwd+bwd+AdamW train step (jit.to_static)
+- ``decode``: the decode engine's prefill + decode programs (generate())
+- ``churn``:  the GL007 runtime pass over dispatch/op-cache/trace counters
+
+Findings are compared against a committed baseline-suppression file
+(``tools/graph_lint_baseline.json``) so CI fails only on NEW findings at
+or above the failure severity (default: warning; "info" findings are
+printed but never gate).
+
+Exit codes:
+  0  no new findings (everything clean or baseline-suppressed)
+  1  new findings at/above the failure severity
+  2  internal error (the lint itself failed — NOT a lint finding)
+
+Runs on CPU (JAX_PLATFORMS=cpu; the jaxpr is platform-independent) or on a
+real TPU host unchanged.  ``--inject gl001`` / ``--inject gl004`` add a
+deliberately-hazardous test model to prove the gate trips (exit 1) with
+the right code and eqn provenance.
+
+Usage:
+  python tools/graph_lint.py --baseline           # the CI gate
+  python tools/graph_lint.py                      # strict (no baseline)
+  python tools/graph_lint.py --write-baseline     # refresh the baseline
+  python tools/graph_lint.py --baseline --inject gl001   # must exit 1
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import traceback
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "graph_lint_baseline.json")
+
+# the scaled-down bench stand-in: tiny dims, but the SAME program structure
+# (stacked scan + remat, fused CE head, donated state, decode engine) and
+# the same pure-bf16 dtype regime as bench.py's headline rungs.  Fixed
+# shapes keep finding fingerprints stable for the baseline.
+_TRAIN_BATCH, _TRAIN_SEQ = 2, 64
+_DEC_BATCH, _DEC_PROMPT, _DEC_NEW, _DEC_MAXSEQ = 2, 8, 3, 128
+
+
+def _build_model(pt, cfg):
+    pt.seed(0)
+    from paddle_tpu.models import GPTStackedForPretraining
+
+    model = GPTStackedForPretraining(cfg)
+    # bench pure-bf16 regime: bf16 params + bf16 moments (amp O2 decorate,
+    # adam multi_precision=False) — the dtype discipline under lint
+    pt.amp.decorate(model, level="O2", dtype="bfloat16")
+    return model
+
+
+def _lint_train(pt, np):
+    from paddle_tpu.models import gpt_tiny
+
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    model = _build_model(pt, cfg)
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters(),
+                             multi_precision=False)
+    rng = np.random.RandomState(0)
+    ids = pt.to_tensor(
+        rng.randint(0, cfg.vocab_size, (_TRAIN_BATCH, _TRAIN_SEQ)),
+        dtype="int64")
+    labels = pt.to_tensor(
+        rng.randint(0, cfg.vocab_size, (_TRAIN_BATCH, _TRAIN_SEQ)),
+        dtype="int64")
+
+    @pt.jit.to_static
+    def train_step(ids, labels):
+        with pt.amp.auto_cast(enable=True, level="O1", dtype="bfloat16"):
+            loss = model(ids, labels=labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train_step(ids, labels)  # compile -> the FLAGS_graph_lint hook lints
+
+
+def _lint_decode(pt, np):
+    from paddle_tpu.models import gpt_tiny
+
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    model = _build_model(pt, cfg)
+    model.eval()
+    rng = np.random.RandomState(1)
+    prompt = pt.to_tensor(
+        rng.randint(0, cfg.vocab_size, (_DEC_BATCH, _DEC_PROMPT)),
+        dtype="int64")
+    model.generate(prompt, max_new_tokens=_DEC_NEW,
+                   max_seq_len=_DEC_MAXSEQ, cache_dtype="bfloat16")
+
+
+def _inject(analysis, code: str):
+    """A deliberately-hazardous test model per code: proves the gate exits
+    1 with the right GL code and eqn provenance."""
+    import jax
+    import jax.numpy as jnp
+
+    code = code.lower()
+    if code == "gl001":
+        def promoted_matmul(x, w):
+            # the hazard under test: bf16 activations silently upcast to
+            # fp32 before the contraction
+            return x.astype(jnp.float32) @ w
+
+        return analysis.lint(
+            promoted_matmul,
+            jax.ShapeDtypeStruct((256, 256), jnp.bfloat16),
+            jax.ShapeDtypeStruct((256, 256), jnp.float32),
+            program="inject:gl001")
+    if code == "gl004":
+        def cache_update_no_donation(cache, x):
+            # a KV-cache-shaped buffer updated but NOT donated
+            return cache.at[:, :, 0, :].set(x), x.sum()
+
+        return analysis.lint(
+            cache_update_no_donation,
+            jax.ShapeDtypeStruct((4, 8, 128, 64), jnp.float32),  # 1 MiB
+            jax.ShapeDtypeStruct((4, 8, 64), jnp.float32),
+            program="inject:gl004")
+    raise ValueError(f"unknown --inject code {code!r} "
+                     "(supported: gl001, gl004)")
+
+
+def run(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graph_lint.py",
+        description="Lint the bench models' compiled programs "
+                    "(docs/graph_lint.md)")
+    ap.add_argument("--baseline", nargs="?", const=DEFAULT_BASELINE,
+                    default=None, metavar="PATH",
+                    help="suppress findings recorded in PATH "
+                         f"(default {os.path.relpath(DEFAULT_BASELINE, _REPO)})")
+    ap.add_argument("--write-baseline", nargs="?", const=DEFAULT_BASELINE,
+                    default=None, metavar="PATH",
+                    help="write current gate-relevant findings to PATH "
+                         "(keeps existing justifications) and exit 0")
+    ap.add_argument("--targets", default="train,decode,churn",
+                    help="comma list of train,decode,churn,none "
+                         "(default: all)")
+    ap.add_argument("--inject", action="append", default=[],
+                    metavar="CODE", help="add a deliberately-hazardous test "
+                    "model (gl001|gl004); the gate must exit 1")
+    ap.add_argument("--fail-on", default="warning",
+                    choices=("info", "warning", "error"),
+                    help="minimum severity that fails the gate")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON lines on stdout")
+    args = ap.parse_args(argv)
+
+    try:
+        import numpy as np
+
+        import paddle_tpu as pt
+        from paddle_tpu import analysis
+
+        pt.set_flags({"FLAGS_graph_lint": True})
+        # the hook announces findings to stderr as programs compile; this
+        # CLI renders the collected reports itself — don't print twice
+        analysis.set_announce(False)
+        analysis.clear_reports()
+
+        targets = [t for t in args.targets.split(",") if t]
+        known = {"train", "decode", "churn", "none"}
+        for t in targets:
+            if t not in known:
+                raise ValueError(f"unknown target {t!r} (expected "
+                                 f"{sorted(known - {'none'})})")
+        if "train" in targets:
+            _lint_train(pt, np)
+        if "decode" in targets:
+            _lint_decode(pt, np)
+
+        all_reports = list(analysis.reports())
+        if "churn" in targets:
+            all_reports.append(analysis.churn_findings())
+        for code in args.inject:
+            all_reports.append(_inject(analysis, code))
+
+        findings = [f for rep in all_reports for f in rep.findings]
+        gate = [f for f in findings
+                if f.rank >= analysis.SEVERITY_RANK[args.fail_on]]
+
+        if args.write_baseline:
+            baseline = (analysis.Baseline.load(args.write_baseline)
+                        if os.path.exists(args.write_baseline)
+                        else analysis.Baseline())
+            fresh = analysis.Baseline()
+            for f in gate:
+                fresh.add(f, baseline.suppressions.get(
+                    f.fingerprint, "TODO: justify"))
+            fresh.save(args.write_baseline)
+            print(f"graph_lint: wrote {len(fresh.suppressions)} "
+                  f"suppression(s) to {args.write_baseline}")
+            return 0
+
+        baseline = (analysis.Baseline.load(args.baseline)
+                    if args.baseline else analysis.Baseline())
+        new = baseline.filter_new(gate)
+
+        if args.json:
+            for f in findings:
+                print(json.dumps({
+                    "code": f.code, "severity": f.severity,
+                    "program": f.program, "primitive": f.primitive,
+                    "message": f.message, "provenance": f.provenance,
+                    "fingerprint": f.fingerprint,
+                    "new": not baseline.suppresses(f),
+                }))
+        else:
+            for rep in all_reports:
+                print(rep.render())
+        n_sup = sum(1 for f in gate if baseline.suppresses(f))
+        print(f"graph_lint: {len(findings)} finding(s) over "
+              f"{len(all_reports)} program(s); {n_sup} baseline-suppressed; "
+              f"{len(new)} NEW at/above '{args.fail_on}'")
+        if new:
+            print("graph_lint: NEW findings:")
+            for f in new:
+                print("  " + f.render())
+            return 1
+        return 0
+    except SystemExit:
+        raise
+    except Exception:
+        traceback.print_exc()
+        print("graph_lint: internal error (exit 2)")
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(run())

@@ -124,10 +124,44 @@ def main() -> int:
                                     - want.astype(jnp.float32)).max())
                 assert err < 0.05, f"{fn_name} {part} err={err}"
 
+    # -- graph lint on-chip: the analyzer sees the same jaxprs the TPU
+    # compiles; a hazardous graph must be flagged and a clean one must not
+    # (the same GL001 case the CLI's --inject gate uses) -----------------
+    def graph_lint():
+        from paddle_tpu import analysis
+
+        def promoted(x, w):
+            return x.astype(jnp.float32) @ w
+
+        rep = analysis.lint(promoted,
+                            jnp.zeros((256, 256), jnp.bfloat16),
+                            jnp.zeros((256, 256), jnp.float32))
+        assert any(f.code == "GL001" for f in rep.findings), \
+            "bf16->fp32 promoted matmul not flagged"
+        from paddle_tpu.analysis import graph_lint as _gl
+        if _gl._src_info is not None:  # provenance is best-effort
+            assert rep.findings[0].provenance, "finding lost eqn provenance"
+
+        def clean(x, w):
+            return x @ w
+
+        rep = analysis.lint(clean,
+                            jnp.zeros((256, 256), jnp.bfloat16),
+                            jnp.zeros((256, 256), jnp.bfloat16))
+        assert not [f for f in rep.findings if f.code == "GL001"], \
+            "clean bf16 matmul falsely flagged"
+        # the kernel gates report GL002-coded reasons on this TPU host
+        from paddle_tpu.ops.pallas_kernels.flash_attention import (
+            shape_unsupported_reason,
+        )
+        r = shape_unsupported_reason(100, 48)
+        assert r is not None and r.code == "GL002"
+
     check("flash_attention", flash)
     check("decode_attention", decode_attention)
     check("fused_adamw", fused_adamw)
     check("rms_norm", rms_norm)
+    check("graph_lint", graph_lint)
 
     if failures:
         print(f"tpu_smoke: FAILED: {failures}")
