@@ -168,6 +168,7 @@ from . import text  # noqa: E402,F401
 from . import onnx  # noqa: E402,F401
 from . import device  # noqa: E402,F401
 from . import analysis  # noqa: E402,F401
+from . import checkpoint  # noqa: E402,F401
 from . import version  # noqa: E402,F401
 from .version import __version__  # noqa: E402,F401
 from . import regularizer  # noqa: E402,F401

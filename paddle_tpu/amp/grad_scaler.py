@@ -53,16 +53,22 @@ class AmpScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        inv = 1.0 / self._scale
-        found = False
-        for p in optimizer._parameter_list:
-            if p.grad is None:
-                continue
-            g = p.grad._value.astype(jnp.float32) * inv._value
-            if not bool(jnp.isfinite(g).all()):
-                found = True
-            p.grad._set_value(g.astype(p.grad._value.dtype))
-        self._found_inf = found
+        # fused path (reference check_finite_and_unscale kernel): ONE
+        # jitted program scales every grad and reduces finiteness into a
+        # single flag — one host sync total, not one per gradient
+        from ..checkpoint.sentry import unscale_and_check
+
+        dispatch.note_read(self._scale)
+        grads = [p.grad for p in optimizer._parameter_list
+                 if p.grad is not None]
+        if not grads:
+            self._found_inf = False
+            return
+        new_raw, finite = unscale_and_check(
+            [g._value for g in grads], self._scale._value)
+        for g, raw in zip(grads, new_raw):
+            g._set_value(raw)
+        self._found_inf = not bool(finite)
 
     def step(self, optimizer):
         if not self._enable:

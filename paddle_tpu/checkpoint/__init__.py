@@ -1,0 +1,21 @@
+"""Fault-tolerant training state: crash-consistent atomic checkpoints,
+async snapshotting, preemption-safe exit, and a jitted bad-step sentry.
+
+The recovery half of fleet elastic's failure story (detection lives in
+``distributed/fleet/elastic``).  See docs/checkpointing.md.
+"""
+from .manager import (  # noqa: F401
+    CheckpointError,
+    CheckpointInfo,
+    CheckpointManager,
+)
+from .preemption import GracefulExit, PreemptionHandler  # noqa: F401
+from .sentry import BadStepSentry, all_finite, tree_all_finite  # noqa: F401
+from .state import TrainState, to_host  # noqa: F401
+
+__all__ = [
+    "CheckpointManager", "CheckpointInfo", "CheckpointError",
+    "TrainState", "to_host",
+    "BadStepSentry", "all_finite", "tree_all_finite",
+    "PreemptionHandler", "GracefulExit",
+]

@@ -1,0 +1,134 @@
+"""Bad-step sentry: one fused all-finite reduction over the grad pytree.
+
+The reference detects loss-scale overflow with check_finite_and_unscale
+(paddle/phi/kernels/check_finite_and_unscale_kernel.cu) — ONE kernel over
+all grads.  The eager analog here had degraded to a Python loop with one
+``bool(jnp.isfinite(g).all())`` host sync PER GRADIENT; this module
+restores the fused design: a single jitted reduction over the whole list
+(jit caches per shape/dtype structure, so steady-state training reuses one
+compiled program and pays exactly one host sync).
+
+``BadStepSentry`` builds skip/rollback policy on top: non-finite steps are
+skipped and counted, and after N consecutive bad steps the training state
+is rolled back to the last valid checkpoint.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["all_finite", "tree_all_finite", "unscale_and_check",
+           "BadStepSentry"]
+
+
+@jax.jit
+def tree_all_finite(leaves):
+    """Fused finiteness reduction over a pytree of arrays — one scalar
+    bool out, no per-leaf host syncs.  Non-float leaves (int/bool indices
+    riding in the tree) are finite by construction and skipped at trace
+    time."""
+    flags = [jnp.isfinite(l).all() for l in jax.tree_util.tree_leaves(leaves)
+             if jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)]
+    if not flags:
+        return jnp.asarray(True)
+    return functools.reduce(jnp.logical_and, flags)
+
+
+def all_finite(values) -> bool:
+    """Host-side convenience: True iff every float leaf in ``values``
+    (Tensors, arrays, nested containers) is finite.  Exactly one device
+    round-trip regardless of how many leaves."""
+    from ..tensor import Tensor
+
+    leaves = [v._value if isinstance(v, Tensor) else v
+              for v in jax.tree_util.tree_leaves(
+                  values, is_leaf=lambda x: isinstance(x, Tensor))]
+    if not leaves:
+        return True
+    return bool(tree_all_finite(leaves))
+
+
+@jax.jit
+def unscale_and_check(grads, scale):
+    """GradScaler.unscale_ fused body: multiply every grad by 1/scale in
+    fp32, cast back to each grad's dtype, and reduce finiteness of the
+    SCALED fp32 values into one flag.  Returns (new_grads, finite_flag)."""
+    inv = 1.0 / scale.astype(jnp.float32)
+    scaled = [g.astype(jnp.float32) * inv for g in grads]
+    flags = [jnp.isfinite(s).all() for s in scaled]
+    finite = functools.reduce(jnp.logical_and, flags) if flags else jnp.asarray(True)
+    return [s.astype(g.dtype) for s, g in zip(scaled, grads)], finite
+
+
+class BadStepSentry:
+    """Skip non-finite optimizer steps; roll back after a run of them.
+
+    Usage (raw loop)::
+
+        sentry = BadStepSentry(manager=mgr, train_state=ts, max_consecutive_bad=3)
+        loss.backward()
+        sentry.guard_step(opt)      # steps only when all grads are finite
+        opt.clear_grad()
+
+    ``guard_step`` costs one fused device reduction + one host sync — the
+    same price GradScaler already pays for dynamic loss scaling.  On
+    rollback the last VALID checkpoint is restored through
+    (manager, train_state), or a custom ``on_rollback`` callback runs.
+    """
+
+    def __init__(self, max_consecutive_bad: int = 3, manager=None,
+                 train_state=None,
+                 on_rollback: Optional[Callable[[], Any]] = None):
+        if max_consecutive_bad < 1:
+            raise ValueError("max_consecutive_bad must be >= 1")
+        self.max_consecutive_bad = max_consecutive_bad
+        self.manager = manager
+        self.train_state = train_state
+        self.on_rollback = on_rollback
+        self.stats = {"steps": 0, "good_steps": 0, "bad_steps": 0,
+                      "consecutive_bad": 0, "rollbacks": 0}
+
+    def _grads(self, optimizer) -> List[Any]:
+        return [p.grad._value for p in optimizer._parameter_list
+                if p.grad is not None]
+
+    def grads_finite(self, optimizer) -> bool:
+        grads = self._grads(optimizer)
+        if not grads:
+            return True
+        return bool(tree_all_finite(grads))
+
+    def guard_step(self, optimizer) -> bool:
+        """optimizer.step() iff the grad pytree is all-finite; returns
+        whether the step was applied.  Counts bad steps and triggers
+        rollback after ``max_consecutive_bad`` in a row."""
+        self.stats["steps"] += 1
+        if self.grads_finite(optimizer):
+            self.stats["good_steps"] += 1
+            self.stats["consecutive_bad"] = 0
+            optimizer.step()
+            return True
+        self.stats["bad_steps"] += 1
+        self.stats["consecutive_bad"] += 1
+        if self.stats["consecutive_bad"] >= self.max_consecutive_bad:
+            self.rollback()
+        return False
+
+    def rollback(self):
+        """Restore the last valid checkpoint (or run on_rollback)."""
+        self.stats["consecutive_bad"] = 0
+        if self.on_rollback is not None:
+            self.on_rollback()
+            self.stats["rollbacks"] += 1
+            return
+        if self.manager is None or self.train_state is None:
+            return
+        info = self.manager.latest()
+        if info is None:
+            return
+        tree, _ = self.manager.restore(info)
+        self.train_state.restore(tree)
+        self.stats["rollbacks"] += 1

@@ -1,0 +1,83 @@
+"""Full resumable training state.
+
+Captures everything a preempted job needs to continue bit-deterministically:
+model ``state_dict``, ``Optimizer.state_dict()`` (incl. accumulators, aux
+scalars like Adam's beta powers, and the LR_Scheduler), GradScaler dynamic
+state, the global RNG key, and the dataloader position (epoch / step /
+sampler epoch).  ``capture()`` returns a pickle-friendly tree of numpy
+leaves (the host snapshot CheckpointManager writes); ``restore()`` pushes a
+tree back into the live objects so ``train(k); resume; train(N-k)`` matches
+``train(N)`` exactly.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = ["TrainState", "to_host"]
+
+
+def to_host(obj):
+    """Device tree -> host tree: Tensor / jax.Array leaves become numpy
+    (the device_get boundary of async snapshotting); containers and plain
+    scalars pass through."""
+    from ..tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._value)
+    if isinstance(obj, dict):
+        return {k: to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(to_host(v) for v in obj)
+    if hasattr(obj, "__array__") and not isinstance(obj, np.ndarray):
+        return np.asarray(obj)
+    return obj
+
+
+class TrainState:
+    """Binds the live training objects whose state a checkpoint spans.
+
+    ``model`` is an nn.Layer (or anything with state_dict/set_state_dict);
+    ``optimizer``/``scaler`` are optional; ``include_rng`` snapshots the
+    global generator key (paddle_tpu.get_rng_state).
+    """
+
+    def __init__(self, model=None, optimizer=None, scaler=None,
+                 include_rng: bool = True):
+        self.model = model
+        self.optimizer = optimizer
+        self.scaler = scaler
+        self.include_rng = include_rng
+
+    def capture(self, position: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Host snapshot of all bound state.  ``position`` is the trainer's
+        dataloader cursor, e.g. {"epoch": e, "step": s, "sampler_epoch": e}
+        — stored verbatim and handed back by restore()."""
+        tree: Dict[str, Any] = {"position": dict(position or {})}
+        if self.model is not None:
+            tree["model"] = to_host(dict(self.model.state_dict()))
+        if self.optimizer is not None:
+            tree["optimizer"] = to_host(self.optimizer.state_dict())
+        if self.scaler is not None:
+            tree["scaler"] = to_host(self.scaler.state_dict())
+        if self.include_rng:
+            from ..ops.random import get_rng_state
+
+            tree["rng"] = np.asarray(get_rng_state()._value)
+        return tree
+
+    def restore(self, tree: Dict[str, Any]) -> Dict[str, Any]:
+        """Push a captured tree back into the bound objects; returns the
+        stored dataloader position dict."""
+        if self.model is not None and "model" in tree:
+            self.model.set_state_dict(tree["model"])
+        if self.optimizer is not None and "optimizer" in tree:
+            self.optimizer.set_state_dict(tree["optimizer"])
+        if self.scaler is not None and "scaler" in tree:
+            self.scaler.load_state_dict(tree["scaler"])
+        if self.include_rng and "rng" in tree:
+            from ..ops.random import set_rng_state
+
+            set_rng_state(tree["rng"])
+        return dict(tree.get("position", {}))

@@ -214,6 +214,67 @@ class Engine:
         return outs
 
     # -- checkpointing (reference dist_saver.py DistributedSaver) ----------
+    def _checkpoint_root(self, directory: str) -> str:
+        """Per-host checkpoint root: on a multi-host job every process
+        commits its own addressable shard under host-<i>/ (each host's
+        manager stays single-writer; restore reads the local host's dir —
+        the reference DistributedSaver's rank-suffixed files, lifted to
+        whole atomic directories)."""
+        import jax
+
+        if jax.process_count() > 1:
+            return f"{directory}/host-{jax.process_index():05d}"
+        return directory
+
+    def checkpoint_manager(self, directory, keep_last_k=None,
+                           async_save=None):
+        """The Engine's CheckpointManager + TrainState pair for
+        ``directory`` (cached per directory — a directory must have ONE
+        writer).  ``keep_last_k``/``async_save`` default to None = "keep
+        the manager's current setting"; an explicit value updates the
+        cached manager rather than being silently dropped."""
+        from ...checkpoint import CheckpointManager, TrainState
+
+        cache = getattr(self, "_ckpt_managers", None)
+        if cache is None:
+            cache = self._ckpt_managers = {}
+        key = directory
+        if key not in cache:
+            cache[key] = (
+                CheckpointManager(
+                    self._checkpoint_root(directory),
+                    keep_last_k=3 if keep_last_k is None else keep_last_k,
+                    async_save=True if async_save is None else async_save),
+                TrainState(self._model, self._optimizer),
+            )
+        else:
+            manager = cache[key][0]
+            if keep_last_k is not None:
+                manager._keep = max(int(keep_last_k), 1)
+            if async_save is not None:
+                manager._async = bool(async_save)
+        return cache[key]
+
+    def save_checkpoint(self, directory, step, epoch=0, blocking=None,
+                        keep_last_k=None):
+        """Crash-consistent save of model+optimizer (+LR scheduler, RNG)
+        through CheckpointManager — atomic commit, async writer, keep-K."""
+        manager, state = self.checkpoint_manager(directory,
+                                                 keep_last_k=keep_last_k)
+        manager.save(state.capture(position={"epoch": epoch, "step": step}),
+                     step=step, epoch=epoch, blocking=blocking)
+        return manager
+
+    def load_checkpoint(self, directory):
+        """Restore the newest VALID checkpoint under ``directory``;
+        returns its position dict, or None when nothing valid exists."""
+        manager, state = self.checkpoint_manager(directory)
+        info = manager.latest()
+        if info is None:
+            return None
+        tree, _ = manager.restore(info)
+        return state.restore(tree)
+
     def save(self, path, training=True):
         from ...framework.io import save
 

@@ -173,6 +173,25 @@ class ElasticManager:
             except Exception:
                 continue
 
+    # -- checkpoint/restart integration ---------------------------------
+    def chain_on_change(self, callback: Callable[[List[int]], None]):
+        """Append ``callback`` to the membership-change notification (the
+        restart contract): existing on_change fires first, then the new
+        one.  This is how a checkpoint.PreemptionHandler plugs in —
+        ``mgr.chain_on_change(handler.as_elastic_on_change())`` makes any
+        membership change request checkpoint-then-clean-exit at the next
+        step boundary.  Callbacks registered here run under the same
+        delivery serialization (and retry-on-failure) as the original."""
+        with self._notify_lock:
+            prev = self._on_change
+
+            def chained(membership):
+                if prev is not None:
+                    prev(membership)
+                callback(membership)
+
+            self._on_change = chained
+
     # -- reference-API surface ------------------------------------------
     def health(self) -> str:
         n = len(self.alive_nodes())

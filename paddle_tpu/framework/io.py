@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import tempfile
 from typing import Any
 
 import jax.numpy as jnp
@@ -56,14 +57,50 @@ class _TensorPayload:
 
 
 def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs):
+    """Atomic save: serialize to a temp file in the target directory,
+    fsync, then ``os.replace`` over the final path.  A crash (or a
+    serialization error) mid-write can no longer leave a truncated file at
+    ``path`` — the previous content, if any, survives intact."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+    payload = pickle.dumps(_to_serializable(obj), protocol=protocol)
+    fd, tmp = tempfile.mkstemp(
+        dir=d or ".", prefix=os.path.basename(path) + ".tmp-")
+    try:
+        # mkstemp creates 0600; restore the perms a plain open() would
+        # have produced (existing file's mode, else umask default) so the
+        # atomic rename doesn't silently lock out other readers
+        try:
+            mode = os.stat(path).st_mode & 0o777
+        except OSError:
+            um = os.umask(0)
+            os.umask(um)
+            mode = 0o666 & ~um
+        os.chmod(tmp, mode)
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load(path: str, return_numpy: bool = False, **configs):
     with open(path, "rb") as f:
-        obj = pickle.load(f)
+        try:
+            obj = pickle.load(f)
+        except (EOFError, pickle.UnpicklingError, ValueError) as e:
+            raise RuntimeError(
+                f"checkpoint file {path!r} is truncated or corrupt "
+                f"({type(e).__name__}: {e}); it was probably written by a "
+                "process that crashed mid-save with a pre-atomic-write "
+                "paddle_tpu — re-save it, or fall back to an older "
+                "checkpoint (CheckpointManager.latest() does this "
+                "automatically)") from e
     return _from_serializable(obj, return_numpy=return_numpy)

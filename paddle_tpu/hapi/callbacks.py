@@ -53,13 +53,100 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq=1, save_dir=None):
+    """Crash-consistent checkpointing wired to checkpoint.CheckpointManager
+    (reference hapi/callbacks.py ModelCheckpoint was a bare model.save).
+
+    ``save_freq`` counts epochs (default) or steps (``save_freq_unit=
+    "step"``); ``keep_last_k`` bounds retention; saves are async (the fit
+    loop never blocks on disk).  With a ``preemption_handler``
+    (checkpoint.PreemptionHandler), a SIGTERM/SIGINT arriving mid-epoch
+    saves synchronously at the next step boundary and stops training
+    cleanly.  ``Model.fit(resume=True)`` restores the newest VALID
+    checkpoint from ``save_dir`` before the first epoch.
+    """
+
+    def __init__(self, save_freq=1, save_dir=None, save_freq_unit="epoch",
+                 keep_last_k=3, async_save=True, preemption_handler=None):
+        if save_freq_unit not in ("epoch", "step"):
+            raise ValueError("save_freq_unit must be 'epoch' or 'step'")
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.save_freq_unit = save_freq_unit
+        self.keep_last_k = keep_last_k
+        self.async_save = async_save
+        self.preemption_handler = preemption_handler
+        self._manager = None
+        self._state = None
+        self._global_step = 0
+        self._epoch = 0
+        self.stop_training = False
+        self.preempted = False
+
+    def _ensure(self):
+        if self._manager is None and self.save_dir:
+            from ..checkpoint import CheckpointManager, TrainState
+
+            self._manager = CheckpointManager(
+                self.save_dir, keep_last_k=self.keep_last_k,
+                async_save=self.async_save)
+            net = getattr(self.model, "network", self.model)
+            opt = getattr(self.model, "_optimizer", None)
+            self._state = TrainState(net, opt)
+        return self._manager
+
+    @property
+    def manager(self):
+        return self._ensure()
+
+    @property
+    def train_state(self):
+        self._ensure()
+        return self._state
+
+    def _save(self, epoch, batch, epoch_done, blocking=False, meta=None):
+        pos = {"epoch": epoch, "batch": batch, "epoch_done": epoch_done,
+               "step": self._global_step}
+        self._manager.save(self._state.capture(position=pos),
+                           step=self._global_step, epoch=epoch,
+                           meta=meta, blocking=blocking)
+
+    def on_train_begin(self, logs=None):
+        self._ensure()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        if self._ensure() is None:
+            return
+        h = self.preemption_handler
+        if h is not None and h.requested:
+            # step boundary of the preemption contract: save NOW
+            # (synchronously — the process is about to exit) and stop
+            self._save(self._epoch, step, epoch_done=False, blocking=True,
+                       meta={"preempted": True})
+            self.preempted = True
+            self.stop_training = True
+            return
+        if (self.save_freq_unit == "step"
+                and self._global_step % self.save_freq == 0):
+            self._save(self._epoch, step, epoch_done=False)
 
     def on_epoch_end(self, epoch, logs=None):
-        if self.save_dir and epoch % self.save_freq == 0:
-            self.model.save(f"{self.save_dir}/epoch_{epoch}")
+        if self._ensure() is None:
+            return
+        if self.preempted:
+            # the preemption save (epoch_done=False, mid-epoch cursor) is
+            # the resume point; an epoch-done save here would displace it
+            # and resume would skip the rest of the interrupted epoch
+            return
+        if self.save_freq_unit == "epoch" and epoch % self.save_freq == 0:
+            self._save(epoch, -1, epoch_done=True)
+
+    def on_train_end(self, logs=None):
+        if self._manager is not None:
+            self._manager.wait()
 
 
 class LRScheduler(Callback):

@@ -106,12 +106,19 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, resume=False):
         cbs: List[Callback] = list(callbacks or [])
         if verbose and not any(isinstance(c, ProgBarLogger) for c in cbs):
             cbs.append(ProgBarLogger(log_freq=log_freq, verbose=verbose))
         for c in cbs:
             c.set_model(self)
+        # resume=True: restore the newest VALID checkpoint before the
+        # first epoch (written by a ModelCheckpoint callback, or found
+        # under save_dir), then continue the epoch/step cursor from it
+        start_epoch, skip_batches = 0, 0
+        if resume:
+            start_epoch, skip_batches = self._resume_from_checkpoint(
+                cbs, save_dir)
         self.network.train()
         for c in cbs:
             c.on_train_begin()
@@ -120,10 +127,12 @@ class Model:
         # num_iters ends the WHOLE fit, not just the current epoch
         # (reference hapi/model.py:2364 sets stop_training)
         stop = False
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             for c in cbs:
                 c.on_epoch_begin(epoch)
             for step, batch in enumerate(train_data):
+                if epoch == start_epoch and step < skip_batches:
+                    continue  # replay past the resumed mid-epoch cursor
                 for c in cbs:
                     c.on_train_batch_begin(step)
                 loss = self._train_step(*_to_tensors(batch))
@@ -133,6 +142,10 @@ class Model:
                     c.on_train_batch_end(step, {"loss": lv})
                 it += 1
                 if num_iters is not None and it >= num_iters:
+                    stop = True
+                    break
+                if any(getattr(c, "stop_training", False) for c in cbs):
+                    # step-boundary stop (preempted ModelCheckpoint)
                     stop = True
                     break
             logs = {"loss": history[-1] if history else float("nan")}
@@ -153,6 +166,37 @@ class Model:
         for c in cbs:
             c.on_train_end()
         return {"loss": history}
+
+    def _resume_from_checkpoint(self, cbs, save_dir):
+        """Restore the newest valid checkpoint (ModelCheckpoint callback's
+        manager, else one rooted at save_dir); returns (start_epoch,
+        batches_to_skip_in_start_epoch)."""
+        from .callbacks import ModelCheckpoint as _MC
+
+        ckpt_cb = next((c for c in cbs if isinstance(c, _MC)), None)
+        if ckpt_cb is not None:
+            ckpt_cb.set_model(self)
+            manager, state = ckpt_cb.manager, ckpt_cb.train_state
+        elif save_dir:
+            from ..checkpoint import CheckpointManager, TrainState
+
+            manager = CheckpointManager(save_dir)
+            state = TrainState(self.network, self._optimizer)
+        else:
+            manager = None
+        if manager is None:
+            return 0, 0
+        info = manager.latest()
+        if info is None:
+            return 0, 0  # nothing valid on disk: cold start
+        tree, _ = manager.restore(info)
+        pos = state.restore(tree)
+        if ckpt_cb is not None:
+            ckpt_cb._global_step = int(pos.get("step", 0))
+        epoch = int(pos.get("epoch", 0))
+        if pos.get("epoch_done", True):
+            return epoch + 1, 0
+        return epoch, int(pos.get("batch", -1)) + 1
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_samples=None):

@@ -217,5 +217,15 @@ class Optimizer:
                     store[id(p)]._set_value(
                         v._value if isinstance(v, Tensor) else jnp.asarray(v)
                     )
+        # aux scalars (Adam/Adamax beta-power accumulators): state_dict()
+        # always saved these, but restore dropped them — a resumed Adam run
+        # silently restarted bias correction at t=0, breaking deterministic
+        # resume.
+        for k, t in self._aux_state.items():
+            key = f"aux_{k}"
+            if key in state_dict:
+                v = state_dict[key]
+                t._set_value(
+                    v._value if isinstance(v, Tensor) else jnp.asarray(v))
         if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])

@@ -7,6 +7,10 @@
 # A graph-lint gate runs first (tools/graph_lint.py --baseline on CPU —
 # the bench-model programs must not grow NEW findings; see
 # docs/graph_lint.md).  PADDLE_TPU_SKIP_LINT_GATE=1 skips it.
+#
+# A checkpoint crash-injection gate runs next (tools/crash_gate.py —
+# a writer killed at any pipeline stage must never corrupt latest(); see
+# docs/checkpointing.md).  PADDLE_TPU_SKIP_CRASH_GATE=1 skips it.
 export JAX_PLATFORMS=cpu
 export PYTHONPATH=$(python - << 'PY'
 import os
@@ -21,6 +25,15 @@ if [ -z "$PADDLE_TPU_SKIP_LINT_GATE" ]; then
     python "$(dirname "$0")/tools/graph_lint.py" --baseline || {
         rc=$?
         echo "run_tests: graph-lint gate FAILED (rc=$rc)"
+        exit $rc
+    }
+fi
+
+if [ -z "$PADDLE_TPU_SKIP_CRASH_GATE" ]; then
+    echo "run_tests: checkpoint crash-injection gate (tools/crash_gate.py)"
+    python "$(dirname "$0")/tools/crash_gate.py" || {
+        rc=$?
+        echo "run_tests: crash-injection gate FAILED (rc=$rc)"
         exit $rc
     }
 fi

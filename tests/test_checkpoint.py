@@ -1,0 +1,694 @@
+"""Crash-consistent checkpointing: atomic io, manager crash injection,
+async writer, bit-deterministic resume, bad-step sentry, preemption, and
+hapi/Engine integration (ISSUE 4; reference dist_saver.py + fleet elastic
+restart contract)."""
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.checkpoint import (
+    BadStepSentry,
+    CheckpointError,
+    CheckpointManager,
+    GracefulExit,
+    PreemptionHandler,
+    TrainState,
+    all_finite,
+)
+from paddle_tpu.checkpoint.manager import MANIFEST_NAME, PAYLOAD_NAME
+
+
+# ---------------------------------------------------------------------------
+# framework.io atomic save/load
+# ---------------------------------------------------------------------------
+
+class TestAtomicIO:
+    def test_truncated_file_raises_clear_error(self, tmp_path):
+        path = str(tmp_path / "m.pdparams")
+        pt.save({"w": pt.to_tensor(np.arange(1000, dtype=np.float32))}, path)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+        with pytest.raises(RuntimeError, match="truncated or corrupt"):
+            pt.load(path)
+
+    def test_failed_save_preserves_previous_content(self, tmp_path):
+        path = str(tmp_path / "m.pdparams")
+        pt.save({"v": 1}, path)
+
+        class Boom:
+            def __reduce__(self):
+                raise RuntimeError("mid-serialization crash")
+
+        with pytest.raises(RuntimeError, match="mid-serialization"):
+            pt.save({"v": 2, "bad": Boom()}, path)
+        # the old file is intact and no temp junk was left behind
+        assert pt.load(path)["v"] == 1
+        leftovers = [n for n in os.listdir(tmp_path) if ".tmp" in n]
+        assert leftovers == []
+
+    def test_roundtrip_tensors(self, tmp_path):
+        path = str(tmp_path / "t.pd")
+        t = pt.to_tensor(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+        pt.save({"a": t, "n": 5}, path)
+        out = pt.load(path)
+        np.testing.assert_array_equal(out["a"].numpy(), t.numpy())
+        assert out["n"] == 5
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager: crash injection, validation fallback, retention
+# ---------------------------------------------------------------------------
+
+def _tree(step):
+    return {"w": np.full((8,), float(step), np.float32), "step": step}
+
+
+class TestManagerCrashConsistency:
+    INJECTION_POINTS = ("after_tmpdir", "mid_payload", "after_payload",
+                       "before_manifest", "before_commit")
+
+    @pytest.mark.parametrize("point", INJECTION_POINTS)
+    def test_interrupted_write_never_selected(self, tmp_path, point):
+        """A writer killed at ANY stage leaves garbage latest() skips."""
+        m = CheckpointManager(str(tmp_path), async_save=False)
+        m.save(_tree(1), step=1)
+
+        def boom(p):
+            if p == point:
+                raise KeyboardInterrupt(f"crash at {p}")
+
+        m._fault_hook = boom
+        with pytest.raises(KeyboardInterrupt):
+            m.save(_tree(2), step=2)
+        m._fault_hook = None
+        info = m.latest()
+        assert info is not None and info.step == 1
+        tree, manifest = m.restore(info)
+        np.testing.assert_array_equal(tree["w"], _tree(1)["w"])
+        assert manifest["step"] == 1
+
+    def test_hand_truncated_payload_falls_back(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_save=False)
+        m.save(_tree(1), step=1)
+        m.save(_tree(2), step=2)
+        p = tmp_path / "ckpt-00000002" / PAYLOAD_NAME
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+        assert m.latest().step == 1
+
+    def test_corrupt_payload_byte_falls_back(self, tmp_path):
+        """Same size, flipped byte: only the digest catches it."""
+        m = CheckpointManager(str(tmp_path), async_save=False)
+        m.save(_tree(1), step=1)
+        m.save(_tree(2), step=2)
+        p = tmp_path / "ckpt-00000002" / PAYLOAD_NAME
+        raw = bytearray(p.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        p.write_bytes(bytes(raw))
+        assert m.latest().step == 1
+
+    def test_corrupt_manifest_falls_back(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_save=False)
+        m.save(_tree(1), step=1)
+        m.save(_tree(2), step=2)
+        (tmp_path / "ckpt-00000002" / MANIFEST_NAME).write_text("{not json")
+        assert m.latest().step == 1
+
+    def test_missing_manifest_falls_back(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_save=False)
+        m.save(_tree(1), step=1)
+        m.save(_tree(2), step=2)
+        os.unlink(tmp_path / "ckpt-00000002" / MANIFEST_NAME)
+        assert m.latest().step == 1
+
+    def test_no_valid_checkpoint(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_save=False)
+        assert m.latest() is None
+        with pytest.raises(CheckpointError, match="no valid checkpoint"):
+            m.restore()
+
+    def test_stale_tmp_dirs_cleaned_on_init(self, tmp_path):
+        stale = tmp_path / ".tmp-ckpt-00000009-99999-deadbeef"
+        stale.mkdir()
+        (stale / PAYLOAD_NAME).write_bytes(b"partial")
+        m = CheckpointManager(str(tmp_path), async_save=False)
+        assert not stale.exists()
+        assert m.latest() is None
+
+    def test_keep_last_k_retention(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep_last_k=2, async_save=False)
+        for s in range(1, 6):
+            m.save(_tree(s), step=s)
+        steps = [c.step for c in m.checkpoints()]
+        assert steps == [5, 4]
+
+    def test_gc_never_deletes_only_valid(self, tmp_path):
+        """Newest checkpoints corrupted: GC must keep the older valid one
+        (it is the fallback) while sweeping the invalid garbage."""
+        m0 = CheckpointManager(str(tmp_path), keep_last_k=3, async_save=False)
+        m0.save(_tree(1), step=1)
+        m0.save(_tree(2), step=2)
+        m0.save(_tree(3), step=3)
+        for s in (2, 3):
+            p = tmp_path / f"ckpt-0000000{s}" / PAYLOAD_NAME
+            raw = bytearray(p.read_bytes())
+            raw[0] ^= 0xFF
+            p.write_bytes(bytes(raw))
+        m = CheckpointManager(str(tmp_path), keep_last_k=1, async_save=False)
+        m._gc()
+        assert m.latest().step == 1
+        assert not (tmp_path / "ckpt-00000002").exists()
+        assert not (tmp_path / "ckpt-00000003").exists()
+
+    def test_resave_same_step(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_save=False)
+        m.save(_tree(1), step=1)
+        m.save({"w": np.zeros(3, np.float32)}, step=1)
+        tree, _ = m.restore()
+        assert tree["w"].shape == (3,)
+
+    def test_failed_write_leaves_no_staging_dir(self, tmp_path):
+        """Transient writer errors (ENOSPC-class) must not leak
+        full-payload .tmp dirs over a long-lived trainer."""
+        m = CheckpointManager(str(tmp_path), async_save=False)
+        m._fault_hook = lambda p: (_ for _ in ()).throw(OSError("disk full"))
+        with pytest.raises(OSError):
+            m.save(_tree(1), step=1)
+        assert [n for n in os.listdir(tmp_path) if n.startswith(".tmp")] == []
+
+    def test_step_ordering_beyond_zero_pad(self, tmp_path):
+        """Steps past 8 digits must still order numerically, not
+        lexicographically."""
+        m = CheckpointManager(str(tmp_path), keep_last_k=2,
+                              async_save=False)
+        m.save(_tree(1), step=99999999)
+        m.save(_tree(2), step=100000000)
+        assert m.latest().step == 100000000
+        assert [c.step for c in m.checkpoints()] == [100000000, 99999999]
+
+
+class TestAsyncWriter:
+    def test_async_save_and_wait(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_save=True)
+        m.save(_tree(1), step=1)
+        m.wait()
+        assert m.latest().step == 1
+
+    def test_writer_error_reraised_on_next_call(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), async_save=True)
+        m._fault_hook = lambda p: (_ for _ in ()).throw(OSError("disk full"))
+        m.save(_tree(1), step=1)  # returns immediately; writer dies
+        with pytest.raises(CheckpointError, match="disk full"):
+            m.wait()
+        m._fault_hook = None
+        m.save(_tree(2), step=2)  # error was consumed; next save is clean
+        m.wait()
+        assert m.latest().step == 2
+
+    def test_at_most_one_inflight(self, tmp_path):
+        """A second save() drains the first write before starting."""
+        m = CheckpointManager(str(tmp_path), async_save=True)
+        release = threading.Event()
+        entered = threading.Event()
+        order = []
+
+        def hook(p):
+            if p == "before_commit":
+                entered.set()
+                order.append("blocked")
+                release.wait(timeout=10)
+
+        m._fault_hook = hook
+        m.save(_tree(1), step=1)
+        assert entered.wait(timeout=10)  # writer is parked at the commit
+        m._fault_hook = None
+        threading.Timer(0.3, release.set).start()
+        t0 = time.monotonic()
+        m.save(_tree(2), step=2)  # must join the blocked writer first
+        assert time.monotonic() - t0 > 0.1
+        m.wait()
+        assert order == ["blocked"]
+        assert [c.step for c in m.checkpoints()] == [2, 1]
+
+    def test_async_step_overhead_small(self, tmp_path):
+        """Acceptance micro-check: the step path pays only the host
+        snapshot — serialization+fsync happen off-thread.  (Full numbers:
+        tools/ckpt_bench.py.)"""
+        state = {"w": np.random.RandomState(0).randn(256, 256).astype(np.float32)}
+        n = 20
+        t0 = time.perf_counter()
+        for _ in range(n):
+            np.sum(state["w"])  # stand-in train step
+        base = time.perf_counter() - t0
+        m = CheckpointManager(str(tmp_path), keep_last_k=2, async_save=True)
+        t0 = time.perf_counter()
+        for s in range(n):
+            np.sum(state["w"])
+            m.save(dict(state), step=s)
+        with_ckpt = time.perf_counter() - t0
+        m.wait()
+        # generous CI bound: the non-blocking save path must not cost
+        # orders of magnitude over the bare loop
+        assert with_ckpt < base + 5.0
+        assert m.latest() is not None
+
+
+# ---------------------------------------------------------------------------
+# TrainState: bit-deterministic resume on a GPT train loop
+# ---------------------------------------------------------------------------
+
+def _gpt_setup(seed=7):
+    from paddle_tpu.models import (
+        GPTForPretraining, GPTPretrainingCriterion, gpt_tiny,
+    )
+
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    rng = np.random.RandomState(0)
+    ids = pt.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)), dtype="int64")
+    labels = pt.to_tensor(rng.randint(0, cfg.vocab_size, (2, 16)), dtype="int64")
+    crit = GPTPretrainingCriterion(cfg)
+    pt.seed(seed)
+    m = GPTForPretraining(cfg)
+    opt = pt.optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    return cfg, m, opt, crit, ids, labels
+
+
+def _gpt_step(m, opt, crit, ids, labels):
+    loss = crit(m(ids), labels)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    return float(loss)
+
+
+class TestDeterministicResume:
+    def test_gpt_resume_bitwise(self, tmp_path):
+        """train(6) == train(3); checkpoint; restore into a FRESH model;
+        train(3) — losses bitwise identical (params, Adam moments + beta
+        powers, RNG all restored)."""
+        _, m, opt, crit, ids, labels = _gpt_setup()
+        ref = [_gpt_step(m, opt, crit, ids, labels) for _ in range(6)]
+
+        _, m2, o2, crit, ids, labels = _gpt_setup()
+        pre = [_gpt_step(m2, o2, crit, ids, labels) for _ in range(3)]
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(TrainState(m2, o2).capture(position={"step": 3}), step=3)
+        mgr.wait()
+
+        _, m3, o3, crit, ids, labels = _gpt_setup(seed=999)  # different init
+        tree, _ = mgr.restore()
+        pos = TrainState(m3, o3).restore(tree)
+        assert pos == {"step": 3}
+        post = [_gpt_step(m3, o3, crit, ids, labels) for _ in range(3)]
+        assert pre == ref[:3]
+        assert post == ref[3:]  # exact float equality — bitwise resume
+
+    def test_adam_aux_state_roundtrip(self):
+        """Adam's beta-power accumulators must survive
+        state_dict/set_state_dict (they were saved but never restored)."""
+        lin = pt.nn.Linear(4, 4)
+        opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=lin.parameters())
+        x = pt.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(3):
+            loss = lin(x).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        sd = opt.state_dict()
+        assert "aux_0" in sd
+        lin2 = pt.nn.Linear(4, 4)
+        opt2 = pt.optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=lin2.parameters())
+        opt2.set_state_dict(sd)
+        np.testing.assert_array_equal(
+            np.asarray(opt2._aux_state[0]._value),
+            np.asarray(opt._aux_state[0]._value))
+        np.testing.assert_array_equal(
+            np.asarray(opt2._aux_state[1]._value),
+            np.asarray(opt._aux_state[1]._value))
+
+    def test_scaler_and_scheduler_state_roundtrip(self, tmp_path):
+        from paddle_tpu.amp import GradScaler
+        from paddle_tpu.optimizer.lr import CosineAnnealingDecay
+
+        lin = pt.nn.Linear(4, 4)
+        sched = CosineAnnealingDecay(learning_rate=0.1, T_max=10)
+        opt = pt.optimizer.AdamW(learning_rate=sched,
+                                 parameters=lin.parameters())
+        scaler = GradScaler(init_loss_scaling=128.0)
+        x = pt.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(4):
+            loss = scaler.scale(lin(x).mean())
+            loss.backward()
+            scaler.step(opt)
+            opt.clear_grad()
+            sched.step()
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        st = TrainState(lin, opt, scaler=scaler)
+        mgr.save(st.capture(), step=4)
+
+        lin2 = pt.nn.Linear(4, 4)
+        sched2 = CosineAnnealingDecay(learning_rate=0.1, T_max=10)
+        opt2 = pt.optimizer.AdamW(learning_rate=sched2,
+                                  parameters=lin2.parameters())
+        scaler2 = GradScaler(init_loss_scaling=2.0**15)
+        tree, _ = mgr.restore()
+        TrainState(lin2, opt2, scaler=scaler2).restore(tree)
+        assert sched2.last_epoch == sched.last_epoch
+        assert sched2.last_lr == sched.last_lr
+        assert scaler2.get_loss_scaling() == scaler.get_loss_scaling()
+
+    def test_rng_state_restored(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        pt.seed(42)
+        pt.rand([4])  # advance the stream
+        st = TrainState(include_rng=True)
+        mgr.save(st.capture(), step=1)
+        a = pt.rand([8]).numpy()
+        tree, _ = mgr.restore()
+        st.restore(tree)
+        b = pt.rand([8]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Bad-step sentry + fused GradScaler check
+# ---------------------------------------------------------------------------
+
+class TestSentry:
+    def test_all_finite(self):
+        assert all_finite([np.ones(3), pt.to_tensor(np.zeros((2, 2)))])
+        assert not all_finite([np.ones(3), np.array([1.0, np.nan])])
+        assert not all_finite([np.array([np.inf])])
+        assert all_finite([np.array([1, 2, 3])])  # ints are always finite
+        assert all_finite([])
+
+    def test_guard_step_skips_nan(self):
+        import jax.numpy as jnp
+
+        lin = pt.nn.Linear(4, 4)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+        sentry = BadStepSentry(max_consecutive_bad=10)
+        x = pt.to_tensor(np.ones((2, 4), np.float32))
+        lin(x).mean().backward()
+        w0 = np.asarray(lin.weight._value).copy()
+        for p in opt._parameter_list:
+            if p.grad is not None:
+                p.grad._set_value(p.grad._value * jnp.nan)
+        assert sentry.guard_step(opt) is False
+        np.testing.assert_array_equal(np.asarray(lin.weight._value), w0)
+        assert sentry.stats["bad_steps"] == 1
+        opt.clear_grad()
+        lin(x).mean().backward()
+        assert sentry.guard_step(opt) is True
+        assert sentry.stats["consecutive_bad"] == 0
+        assert not np.array_equal(np.asarray(lin.weight._value), w0)
+
+    def test_rollback_after_n_bad_steps(self, tmp_path):
+        import jax.numpy as jnp
+
+        lin = pt.nn.Linear(4, 4)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        state = TrainState(lin, opt)
+        mgr.save(state.capture(), step=1)
+        good_w = np.asarray(lin.weight._value).copy()
+        # poison the live params so a rollback is observable
+        lin.weight._set_value(lin.weight._value + 100.0)
+        sentry = BadStepSentry(max_consecutive_bad=3, manager=mgr,
+                               train_state=state)
+        x = pt.to_tensor(np.ones((2, 4), np.float32))
+        for _ in range(3):
+            lin(x).mean().backward()
+            for p in opt._parameter_list:
+                if p.grad is not None:
+                    p.grad._set_value(p.grad._value * jnp.nan)
+            assert sentry.guard_step(opt) is False
+            opt.clear_grad()
+        assert sentry.stats["rollbacks"] == 1
+        assert sentry.stats["bad_steps"] == 3
+        np.testing.assert_array_equal(np.asarray(lin.weight._value), good_w)
+
+    def test_grad_scaler_fused_semantics(self):
+        """The fused unscale keeps the reference bookkeeping: NaN grads
+        set found_inf, skip the step, and halve the dynamic scale."""
+        import jax.numpy as jnp
+        from paddle_tpu.amp import GradScaler
+
+        lin = pt.nn.Linear(4, 4)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+        scaler = GradScaler(init_loss_scaling=256.0)
+        x = pt.to_tensor(np.ones((2, 4), np.float32))
+        # good step: grads get unscaled by 1/scale
+        scaler.scale(lin(x).mean()).backward()
+        g_scaled = np.asarray(
+            next(p.grad for p in opt._parameter_list
+                 if p.grad is not None)._value).copy()
+        scaler.unscale_(opt)
+        assert scaler._found_inf is False
+        g_unscaled = np.asarray(
+            next(p.grad for p in opt._parameter_list
+                 if p.grad is not None)._value)
+        np.testing.assert_allclose(g_unscaled, g_scaled / 256.0, rtol=1e-6)
+        opt.clear_grad()
+        # bad step: nan grad -> found_inf, param frozen, scale halved
+        scaler.scale(lin(x).mean()).backward()
+        for p in opt._parameter_list:
+            if p.grad is not None:
+                p.grad._set_value(p.grad._value * jnp.nan)
+        w0 = np.asarray(lin.weight._value).copy()
+        scaler.step(opt)
+        np.testing.assert_array_equal(np.asarray(lin.weight._value), w0)
+        assert scaler.get_loss_scaling() == 128.0
+        opt.clear_grad()
+
+    def test_grad_scaler_no_grads(self):
+        from paddle_tpu.amp import GradScaler
+
+        lin = pt.nn.Linear(4, 4)
+        opt = pt.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+        scaler = GradScaler(init_loss_scaling=256.0)
+        scaler.unscale_(opt)  # nothing accumulated: no crash, no found_inf
+        assert scaler._found_inf is False
+
+
+# ---------------------------------------------------------------------------
+# Preemption: SIGTERM -> checkpoint at step boundary -> clean exit
+# ---------------------------------------------------------------------------
+
+class TestPreemption:
+    def test_signal_sets_request_and_uninstall_restores(self):
+        prev = signal.getsignal(signal.SIGTERM)
+        h = PreemptionHandler()
+        with h:
+            assert not h.requested
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.time() + 5
+            while not h.requested and time.time() < deadline:
+                time.sleep(0.01)
+            assert h.requested
+        assert signal.getsignal(signal.SIGTERM) is prev
+
+    def test_checkpoint_and_exit(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), async_save=False)
+        lin = pt.nn.Linear(2, 2)
+        state = TrainState(lin)
+        h = PreemptionHandler()
+        # not requested: no-op
+        h.checkpoint_and_exit_if_requested(mgr, state, step=1)
+        assert mgr.latest() is None
+        h.request()
+        with pytest.raises(SystemExit) as exc:
+            h.checkpoint_and_exit_if_requested(mgr, state, step=7, epoch=2)
+        assert exc.value.code == 0
+        info = mgr.latest()
+        assert info.step == 7 and info.epoch == 2
+        assert info.manifest["meta"]["preempted"] is True
+        tree, _ = mgr.restore(info)
+        assert tree["position"] == {"epoch": 2, "step": 7}
+
+    def test_elastic_on_change_requests_checkpoint(self):
+        """Membership change through ElasticManager.chain_on_change fires
+        the preemption request (the restart half of the contract)."""
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        class FakeStore:
+            def __init__(self):
+                self.kv = {}
+
+            def add(self, k, v):
+                self.kv[k] = self.kv.get(k, 0) + v
+                return self.kv[k]
+
+            def check(self, k):
+                return k in self.kv
+
+        store = FakeStore()
+        user_calls = []
+        mgr = ElasticManager(store, rank=0, nnodes=2, max_nodes=2,
+                             ttl=60.0, interval=60.0,
+                             on_change=lambda m: user_calls.append(m))
+        h = PreemptionHandler()
+        mgr.chain_on_change(h.as_elastic_on_change())
+        store.add("elastic/beat/0", 1)
+        mgr.alive_nodes()          # first computation: recorded silently
+        store.add("elastic/beat/1", 1)
+        assert sorted(mgr.alive_nodes()) == [0, 1]  # change -> both fire
+        assert user_calls == [[0, 1]]
+        assert h.requested
+
+
+# ---------------------------------------------------------------------------
+# hapi Model.fit: ModelCheckpoint wiring + resume=True
+# ---------------------------------------------------------------------------
+
+def _hapi_setup(seed=3):
+    pt.seed(seed)
+    net = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.ReLU(),
+                           pt.nn.Linear(8, 1))
+    model = pt.Model(net)
+    opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                             parameters=net.parameters())
+    model.prepare(optimizer=opt, loss=pt.nn.MSELoss())
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(4, 4).astype(np.float32),
+             rng.randn(4, 1).astype(np.float32)) for _ in range(5)]
+    return model, data
+
+
+class TestHapiResume:
+    def test_epoch_resume_matches_straight_run(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import ModelCheckpoint
+
+        ref_model, data = _hapi_setup()
+        ref = ref_model.fit(data, epochs=4, verbose=0)["loss"]
+
+        model, data = _hapi_setup()
+        cb = ModelCheckpoint(save_dir=str(tmp_path), save_freq=1,
+                             keep_last_k=2)
+        first = model.fit(data, epochs=2, verbose=0, callbacks=[cb])["loss"]
+
+        model2, data = _hapi_setup(seed=99)  # different init — must not matter
+        cb2 = ModelCheckpoint(save_dir=str(tmp_path))
+        rest = model2.fit(data, epochs=4, verbose=0, callbacks=[cb2],
+                          resume=True)["loss"]
+        assert first == ref[:len(first)]
+        assert rest == ref[len(first):]
+
+    def test_mid_epoch_step_resume(self, tmp_path):
+        """Preempt after batch 2 of epoch 0 (step-freq checkpoints);
+        resume replays the remaining batches — losses match a straight
+        2-epoch run exactly."""
+        from paddle_tpu.hapi.callbacks import Callback, ModelCheckpoint
+
+        ref_model, data = _hapi_setup()
+        ref = ref_model.fit(data, epochs=2, verbose=0)["loss"]
+
+        model, data = _hapi_setup()
+        h = PreemptionHandler()  # not installed: driven programmatically
+
+        class PreemptAt(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                if step == 2:
+                    h.request()
+
+        cb = ModelCheckpoint(save_dir=str(tmp_path), save_freq=1,
+                             save_freq_unit="step", preemption_handler=h)
+        # preempting callback runs BEFORE the checkpoint callback so the
+        # request is visible at the same step boundary
+        out = model.fit(data, epochs=2, verbose=0,
+                        callbacks=[PreemptAt(), cb])["loss"]
+        assert cb.preempted
+        assert len(out) == 3  # stopped after batch index 2
+        assert out == ref[:3]
+
+        model2, data = _hapi_setup(seed=123)
+        cb2 = ModelCheckpoint(save_dir=str(tmp_path), save_freq=1,
+                              save_freq_unit="step")
+        rest = model2.fit(data, epochs=2, verbose=0, callbacks=[cb2],
+                          resume=True)["loss"]
+        assert rest == ref[3:]
+
+    def test_preemption_survives_epoch_unit_checkpointing(self, tmp_path):
+        """With the DEFAULT epoch-unit checkpointing, a preemption save
+        must not be displaced by the epoch-end save fit fires on the stop
+        path — resume must continue mid-epoch, not skip to the next."""
+        from paddle_tpu.hapi.callbacks import Callback, ModelCheckpoint
+
+        ref_model, data = _hapi_setup()
+        ref = ref_model.fit(data, epochs=2, verbose=0)["loss"]
+
+        model, data = _hapi_setup()
+        h = PreemptionHandler()
+
+        class PreemptAt(Callback):
+            def on_train_batch_end(self, step, logs=None):
+                if step == 1:
+                    h.request()
+
+        cb = ModelCheckpoint(save_dir=str(tmp_path), save_freq=1,
+                             preemption_handler=h)  # epoch-unit default
+        out = model.fit(data, epochs=2, verbose=0,
+                        callbacks=[PreemptAt(), cb])["loss"]
+        assert cb.preempted and len(out) == 2
+
+        model2, data = _hapi_setup(seed=77)
+        cb2 = ModelCheckpoint(save_dir=str(tmp_path))
+        rest = model2.fit(data, epochs=2, verbose=0, callbacks=[cb2],
+                          resume=True)["loss"]
+        assert rest == ref[2:]
+
+    def test_resume_with_no_checkpoint_is_cold_start(self, tmp_path):
+        model, data = _hapi_setup()
+        out = model.fit(data, epochs=1, verbose=0, save_dir=None,
+                        resume=True)["loss"]
+        assert len(out) == len(data)
+
+
+# ---------------------------------------------------------------------------
+# auto_parallel Engine: save/load through the manager
+# ---------------------------------------------------------------------------
+
+class TestEngineCheckpoint:
+    def test_engine_checkpoint_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.auto_parallel import Engine
+
+        pt.seed(5)
+        net = pt.nn.Linear(4, 2)
+        opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=net.parameters())
+        eng = Engine(model=net, loss=pt.nn.MSELoss(), optimizer=opt)
+        rng = np.random.RandomState(0)
+        data = [(rng.randn(4, 4).astype(np.float32),
+                 rng.randn(4, 2).astype(np.float32)) for _ in range(3)]
+        eng.fit(data, epochs=1, verbose=0)
+        eng.save_checkpoint(str(tmp_path), step=3, epoch=0, blocking=True)
+        w = np.asarray(net.weight._value).copy()
+
+        pt.seed(50)
+        net2 = pt.nn.Linear(4, 2)
+        opt2 = pt.optimizer.AdamW(learning_rate=1e-2,
+                                  parameters=net2.parameters())
+        eng2 = Engine(model=net2, loss=pt.nn.MSELoss(), optimizer=opt2)
+        pos = eng2.load_checkpoint(str(tmp_path))
+        assert pos == {"epoch": 0, "step": 3}
+        np.testing.assert_array_equal(np.asarray(net2.weight._value), w)
+
+    def test_engine_load_checkpoint_empty_dir(self, tmp_path):
+        from paddle_tpu.distributed.auto_parallel import Engine
+
+        net = pt.nn.Linear(2, 2)
+        eng = Engine(model=net)
+        assert eng.load_checkpoint(str(tmp_path)) is None

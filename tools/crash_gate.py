@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Fast crash-injection gate for the checkpoint subsystem.
+
+Simulates a writer crash at EVERY stage of the checkpoint write pipeline
+(staging dir created, mid-payload, payload complete, pre-manifest,
+pre-rename) plus post-commit corruption (truncated payload, flipped byte,
+mangled manifest) and asserts the invariant the whole subsystem rests on:
+
+    latest() NEVER selects a partial/corrupt checkpoint, and restore()
+    from the surviving checkpoint reproduces the saved state exactly.
+
+Runs in a few seconds on CPU; wired into run_tests.sh before the suite
+(PADDLE_TPU_SKIP_CRASH_GATE=1 skips).  Exit codes: 0 gate passed, 1 an
+injected crash broke crash consistency, 2 internal error.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+
+def _state(step: int):
+    rng = np.random.RandomState(step)
+    return {"w": rng.randn(64, 64).astype(np.float32), "step": step}
+
+
+def run_gate(verbose: bool = True) -> int:
+    from paddle_tpu.checkpoint import CheckpointManager
+    from paddle_tpu.checkpoint.manager import MANIFEST_NAME, PAYLOAD_NAME
+
+    points = ("after_tmpdir", "mid_payload", "after_payload",
+              "before_manifest", "before_commit")
+    failures = []
+    root = tempfile.mkdtemp(prefix="ckpt_crash_gate_")
+    try:
+        # -- crash mid-write at every pipeline stage ---------------------
+        for point in points:
+            d = os.path.join(root, f"crash-{point}")
+            m = CheckpointManager(d, async_save=False)
+            m.save(_state(1), step=1)
+
+            def boom(p, _point=point):
+                if p == _point:
+                    raise KeyboardInterrupt(f"injected crash at {_point}")
+
+            m._fault_hook = boom
+            try:
+                m.save(_state(2), step=2)
+                failures.append(f"{point}: injected crash did not fire")
+                continue
+            except KeyboardInterrupt:
+                pass
+            m._fault_hook = None
+            info = m.latest()
+            if info is None or info.step != 1:
+                failures.append(f"{point}: latest()={info} (want step 1)")
+                continue
+            tree, _ = m.restore(info)
+            if not np.array_equal(tree["w"], _state(1)["w"]):
+                failures.append(f"{point}: restored state diverged")
+            elif verbose:
+                print(f"crash_gate: {point}: OK (fell back to step 1)")
+
+        # -- post-commit corruption --------------------------------------
+        def corrupt_truncate(p):
+            with open(p, "r+b") as f:
+                f.truncate(os.path.getsize(p) // 2)
+
+        def corrupt_flip(p):
+            with open(p, "r+b") as f:
+                raw = bytearray(f.read())
+                raw[len(raw) // 2] ^= 0xFF
+                f.seek(0)
+                f.write(raw)
+
+        def corrupt_manifest(p):
+            mp = os.path.join(os.path.dirname(p), MANIFEST_NAME)
+            with open(mp, "w") as f:
+                f.write("{broken json")
+
+        for name, corrupt in (("truncate", corrupt_truncate),
+                              ("flip_byte", corrupt_flip),
+                              ("manifest", corrupt_manifest)):
+            d = os.path.join(root, f"corrupt-{name}")
+            m = CheckpointManager(d, async_save=False)
+            m.save(_state(1), step=1)
+            m.save(_state(2), step=2)
+            corrupt(os.path.join(d, "ckpt-00000002", PAYLOAD_NAME))
+            info = m.latest()
+            if info is None or info.step != 1:
+                failures.append(f"{name}: latest()={info} (want step 1)")
+            else:
+                tree, _ = m.restore(info)
+                if not np.array_equal(tree["w"], _state(1)["w"]):
+                    failures.append(f"{name}: restored state diverged")
+                elif verbose:
+                    print(f"crash_gate: corrupt/{name}: OK")
+
+        # -- async writer error surfacing --------------------------------
+        d = os.path.join(root, "async-error")
+        m = CheckpointManager(d, async_save=True)
+        m._fault_hook = lambda p: (_ for _ in ()).throw(OSError("disk full"))
+        m.save(_state(1), step=1)
+        try:
+            m.wait()
+            failures.append("async: writer error was swallowed")
+        except Exception as e:  # noqa: BLE001
+            if "disk full" not in str(e):
+                failures.append(f"async: wrong error surfaced: {e!r}")
+            elif verbose:
+                print("crash_gate: async writer error re-raised: OK")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    if failures:
+        print("crash_gate: FAILED:\n  " + "\n  ".join(failures))
+        return 1
+    print("crash_gate: all injection points crash-consistent")
+    return 0
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        return run_gate()
+    except Exception as e:  # noqa: BLE001
+        print(f"crash_gate: internal error: {e!r}")
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

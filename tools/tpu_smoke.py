@@ -157,11 +157,51 @@ def main() -> int:
         r = shape_unsupported_reason(100, 48)
         assert r is not None and r.code == "GL002"
 
+    # -- checkpoint: save -> corrupt -> fallback -> resume ON-CHIP (the
+    # sentry's fused all-finite reduction and the device_get snapshot
+    # boundary both run against real TPU arrays here) --------------------
+    def checkpoint():
+        import shutil
+        import tempfile
+
+        from paddle_tpu.checkpoint import (
+            CheckpointManager, all_finite, tree_all_finite,
+        )
+        from paddle_tpu.checkpoint.manager import PAYLOAD_NAME
+
+        d = tempfile.mkdtemp(prefix="tpu_smoke_ckpt_")
+        try:
+            m = CheckpointManager(d, async_save=False)
+            w1 = jnp.array(rng.randn(128, 128), jnp.bfloat16)
+            m.save({"w": np.asarray(w1.astype(jnp.float32))}, step=1)
+            m.save({"w": np.zeros((128, 128), np.float32)}, step=2)
+            # corrupt the newest payload: digest validation must skip it
+            p = f"{d}/ckpt-00000002/{PAYLOAD_NAME}"
+            with open(p, "r+b") as f:
+                raw = bytearray(f.read())
+                raw[len(raw) // 2] ^= 0xFF
+                f.seek(0)
+                f.write(raw)
+            info = m.latest()
+            assert info is not None and info.step == 1, f"latest={info}"
+            tree, _ = m.restore(info)
+            err = float(jnp.abs(jnp.asarray(tree["w"])
+                                - w1.astype(jnp.float32)).max())
+            assert err == 0.0, f"resume diverged err={err}"
+            # fused finiteness reduction on-device: one compiled program
+            good = [jnp.ones((64, 64), jnp.bfloat16),
+                    jnp.ones((8,), jnp.float32)]
+            assert bool(tree_all_finite(good))
+            assert not all_finite(good + [jnp.array([jnp.nan])])
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
     check("flash_attention", flash)
     check("decode_attention", decode_attention)
     check("fused_adamw", fused_adamw)
     check("rms_norm", rms_norm)
     check("graph_lint", graph_lint)
+    check("checkpoint", checkpoint)
 
     if failures:
         print(f"tpu_smoke: FAILED: {failures}")
