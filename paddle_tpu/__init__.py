@@ -163,6 +163,7 @@ from . import sparse  # noqa: E402,F401
 from . import audio  # noqa: E402,F401
 from . import quantization  # noqa: E402,F401
 from . import inference  # noqa: E402,F401
+from . import serving  # noqa: E402,F401
 from . import geometric  # noqa: E402,F401
 from . import text  # noqa: E402,F401
 from . import onnx  # noqa: E402,F401

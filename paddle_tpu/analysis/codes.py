@@ -18,7 +18,7 @@ from typing import List, Optional, Tuple
 __all__ = [
     "CODES", "SEVERITY_RANK", "TILE_SUBLANE", "TILE_LANE",
     "misaligned_dims", "GateReason", "flash_gate_reason",
-    "decode_gate_reason",
+    "decode_gate_reason", "paged_gate_reason",
 ]
 
 # code -> (short name, default severity).  Severities: "error" (correctness
@@ -105,6 +105,16 @@ def decode_gate_reason(max_seq: int, head_dim: int) -> Optional[GateReason]:
     """None when the q-len-1 flash-decode kernel accepts the cache shape,
     else the GL002-coded reason it falls back to XLA."""
     return _attention_gate(max_seq, head_dim, "decode_attention", "max_seq")
+
+
+def paged_gate_reason(page_size: int, head_dim: int) -> Optional[GateReason]:
+    """None when the paged decode-attention kernel accepts the block-pool
+    shape, else the GL002-coded reason it falls back to the XLA gather
+    reference.  A KV page is one kernel block, so the same tiling rules
+    apply to ``page_size`` that the contiguous decode kernel applies to its
+    KV blocking of ``max_seq``."""
+    return _attention_gate(page_size, head_dim, "paged_attention",
+                           "page_size")
 
 
 # one line per DISTINCT reason (kernel + shape) per process: a decode loop

@@ -236,10 +236,22 @@ def _shape_of(v):
     return tuple(getattr(aval, "shape", ()))
 
 
+def _dtype_name(dt) -> str:
+    """np.dtype name, tolerating jax EXTENDED dtypes (e.g. the typed RNG
+    key 'key<fry>' a sampling decode program captures) that np.dtype
+    cannot interpret — those fall through as their string form and simply
+    never match any numeric-dtype rule."""
+    if dt is None:
+        return "?"
+    try:
+        return np.dtype(dt).name
+    except TypeError:
+        return str(dt)
+
+
 def _fmt_aval(v) -> str:
-    dt = _dtype_of(v)
     shape = ",".join(str(d) for d in _shape_of(v))
-    name = np.dtype(dt).name if dt is not None else "?"
+    name = _dtype_name(_dtype_of(v))
     short = {"float32": "f32", "float64": "f64", "float16": "f16",
              "bfloat16": "bf16", "int32": "i32", "int64": "i64",
              "bool": "b1", "complex64": "c64", "complex128": "c128"}
@@ -339,9 +351,9 @@ def _walk(jaxpr: "_jcore.Jaxpr", ctx: _Ctx, depth: int = 0):
                 src = _dtype_of(eqn.invars[0])
                 dst = eqn.params.get("new_dtype")
                 if (src is not None and dst is not None
-                        and np.dtype(src).name in ("bfloat16", "float16")
-                        and np.dtype(dst).name == "float32"):
-                    promoted[eqn.outvars[0]] = (np.dtype(src).name, prov)
+                        and _dtype_name(src) in ("bfloat16", "float16")
+                        and _dtype_name(dst) == "float32"):
+                    promoted[eqn.outvars[0]] = (_dtype_name(src), prov)
             elif prim in _LAYOUT_PRIMS:
                 for v in eqn.invars:
                     if _is_var(v) and v in promoted:
@@ -368,7 +380,7 @@ def _walk(jaxpr: "_jcore.Jaxpr", ctx: _Ctx, depth: int = 0):
                 # promoted inside the op — the same silent hazard.  Skipped
                 # when the explicit-upcast branch already blamed this eqn
                 # (one root cause must not mint two fingerprints).
-                names = [np.dtype(d).name if d is not None else ""
+                names = [_dtype_name(d) if d is not None else ""
                          for d in (_dtype_of(eqn.invars[0]),
                                    _dtype_of(eqn.invars[1]))]
                 if not upcast_flagged and "float32" in names and any(
@@ -387,13 +399,13 @@ def _walk(jaxpr: "_jcore.Jaxpr", ctx: _Ctx, depth: int = 0):
                         primitive=prim, provenance=prov)
             for v in eqn.outvars:
                 dt = _dtype_of(v)
-                if dt is not None and np.dtype(dt).name in ("float64",
-                                                            "complex128"):
+                if dt is not None and _dtype_name(dt) in ("float64",
+                                                          "complex128"):
                     ctx.add(
                         "GL001",
                         f"'{prim}' produces {_fmt_aval(v)} — an x64 leak "
                         "(f64 has no TPU fast path and doubles bytes)",
-                        detail=f"x64:{prim}:{np.dtype(dt).name}",
+                        detail=f"x64:{prim}:{_dtype_name(dt)}",
                         primitive=prim, provenance=prov)
 
         if "GL002" in cfg.passes and prim in (_DOT_PRIMS | _REDUCE_PRIMS):

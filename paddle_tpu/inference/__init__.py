@@ -66,6 +66,12 @@ class Config:
         self._switches: Dict[str, object] = {}
         self._causal_lm_model = None
         self._decode_opts: Optional[Dict[str, object]] = None
+        self._serving_opts: Optional[Dict[str, object]] = None
+        # ONE ServingEngine (and page pool) per Config, shared by every
+        # Predictor created from it — the reference PredictorPool contract
+        # ("N predictors sharing one program"), paged edition
+        self._serving_engine = None
+        self._serving_lock = __import__("threading").Lock()
 
     def set_model(self, prog_file, params_file=None):
         self._model_prefix = prog_file
@@ -90,6 +96,11 @@ class Config:
         """Switch ``Predictor.run`` to autoregressive decode: input handle
         x0 takes int64 prompt ids [B, S0]; output handle out0 returns
         [B, S0 + max_new_tokens] generated ids."""
+        if self._serving_opts is not None:
+            raise RuntimeError(
+                "enable_causal_lm_decode and enable_serving_mode are "
+                "mutually exclusive — pick the single-shot decode path or "
+                "the paged continuous-batching engine")
         self._decode_opts = dict(
             max_new_tokens=int(max_new_tokens), do_sample=bool(do_sample),
             temperature=float(temperature), top_k=int(top_k), top_p=top_p,
@@ -99,6 +110,53 @@ class Config:
 
     def causal_lm_decode_enabled(self) -> bool:
         return self._decode_opts is not None
+
+    def enable_serving_mode(self, max_new_tokens: int = 32,
+                            num_slots: int = 4, page_size: int = 128,
+                            max_context: Optional[int] = None,
+                            num_pages: Optional[int] = None,
+                            cache_dtype: str = "bfloat16",
+                            prefill_chunk: Optional[int] = None,
+                            do_sample: bool = False,
+                            temperature: float = 1.0, top_k: int = 0,
+                            top_p: float = 1.0,
+                            eos_token_id: Optional[int] = None):
+        """Switch ``Predictor.run`` to the continuous-batching serving
+        engine (paged KV cache; docs/serving.md): each prompt row becomes
+        a request through the SHARED engine, so concurrent predictors
+        batch against each other instead of serializing whole generate()
+        calls.  Mutually exclusive with ``enable_causal_lm_decode`` (the
+        single-shot contiguous-cache path)."""
+        if self._decode_opts is not None:
+            raise RuntimeError(
+                "enable_serving_mode and enable_causal_lm_decode are "
+                "mutually exclusive — pick the paged continuous-batching "
+                "engine or the single-shot decode path")
+        self._serving_opts = dict(
+            max_new_tokens=int(max_new_tokens), num_slots=int(num_slots),
+            page_size=int(page_size), max_context=max_context,
+            num_pages=num_pages, cache_dtype=str(cache_dtype),
+            prefill_chunk=prefill_chunk, do_sample=bool(do_sample),
+            temperature=float(temperature), top_k=int(top_k),
+            top_p=float(top_p), eos_token_id=eos_token_id)
+        return self
+
+    def serving_mode_enabled(self) -> bool:
+        return self._serving_opts is not None
+
+    def _get_serving_engine(self):
+        """The Config-shared ServingEngine, built on first use."""
+        with self._serving_lock:
+            if self._serving_engine is None:
+                from ..serving import ServingEngine
+
+                o = self._serving_opts
+                self._serving_engine = ServingEngine(
+                    self._causal_lm_model, num_slots=o["num_slots"],
+                    page_size=o["page_size"], max_context=o["max_context"],
+                    num_pages=o["num_pages"], cache_dtype=o["cache_dtype"],
+                    prefill_chunk=o["prefill_chunk"])
+            return self._serving_engine
 
     def model_dir(self):
         return self._model_prefix
@@ -148,6 +206,8 @@ class Config:
                  "compiler: XLA (StableHLO program from jit.save)"]
         if self._decode_opts is not None:
             lines.append(f"causal_lm_decode: {self._decode_opts}")
+        if self._serving_opts is not None:
+            lines.append(f"serving_mode: {self._serving_opts}")
         lines += [f"{k}: {v}" for k, v in self._switches.items()]
         return "\n".join(lines)
 
@@ -187,18 +247,23 @@ class Predictor:
     def __init__(self, config: Config):
         self._config = config
         self._causal_lm = config._causal_lm_model
-        if config.causal_lm_decode_enabled() and self._causal_lm is None:
+        if ((config.causal_lm_decode_enabled()
+             or config.serving_mode_enabled())
+                and self._causal_lm is None):
             raise RuntimeError(
-                "enable_causal_lm_decode() needs a live model: saved "
-                "StableHLO programs are single static-shape calls and "
-                "cannot run the autoregressive loop; attach the model with "
+                "enable_causal_lm_decode()/enable_serving_mode() need a "
+                "live model: saved StableHLO programs are single "
+                "static-shape calls and cannot run the autoregressive "
+                "loop; attach the model with "
                 "Config.set_causal_lm_model(model)")
-        if self._causal_lm is not None and not config.causal_lm_decode_enabled():
+        if (self._causal_lm is not None
+                and not config.causal_lm_decode_enabled()
+                and not config.serving_mode_enabled()):
             raise RuntimeError(
-                "set_causal_lm_model() without enable_causal_lm_decode(): "
-                "decode options must be chosen explicitly (max_new_tokens, "
-                "sampling, cache dtype) — call "
-                "Config.enable_causal_lm_decode(...) before create_predictor")
+                "set_causal_lm_model() without enable_causal_lm_decode() "
+                "or enable_serving_mode(): decode options must be chosen "
+                "explicitly (max_new_tokens, sampling, cache dtype) — "
+                "call one of them before create_predictor")
         if self._causal_lm is not None:
             if not hasattr(self._causal_lm, "generate"):
                 raise RuntimeError(
@@ -257,7 +322,9 @@ class Predictor:
         else:
             ctx = contextlib.nullcontext()
         with ctx:
-            if self._causal_lm is not None:
+            if self._config.serving_mode_enabled():
+                out = self._run_serving(args[0])
+            elif self._causal_lm is not None:
                 opts = self._config._decode_opts or {}
                 out = self._causal_lm.generate(args[0], **opts)
             else:
@@ -268,6 +335,40 @@ class Predictor:
         if inputs is not None:
             return [_FrameworkTensor(v) for v in self._outputs.values()]
         return True
+
+    def _run_serving(self, ids):
+        """Serving mode: each prompt row becomes a request through the
+        Config-shared continuous-batching engine; this thread steps the
+        engine until ITS requests finish (other predictors' requests ride
+        in the same batched step).  Rows that stop early on eos are padded
+        with the eos id — the generate() output convention."""
+        o = self._config._serving_opts
+        eng = self._config._get_serving_engine()
+        from ..serving import SamplingParams
+
+        sp = SamplingParams(do_sample=o["do_sample"],
+                            temperature=o["temperature"],
+                            top_k=o["top_k"], top_p=o["top_p"])
+        prompts = np.asarray(
+            ids._value if isinstance(ids, _FrameworkTensor) else ids,
+            np.int64)
+        if prompts.ndim == 1:
+            prompts = prompts[None, :]
+        reqs = [eng.submit(row, o["max_new_tokens"], sampling=sp,
+                           eos_token_id=o["eos_token_id"])
+                for row in prompts]
+        while not all(r.finished for r in reqs):
+            eng.step()
+        n = o["max_new_tokens"]
+        out = np.empty((len(reqs), prompts.shape[1] + n), np.int64)
+        for i, r in enumerate(reqs):
+            toks = list(r.tokens)
+            pad = r.eos_token_id if r.eos_token_id is not None else 0
+            toks += [pad] * (n - len(toks))
+            out[i] = np.concatenate([r.prompt, np.asarray(toks, np.int64)])
+        from ..tensor import to_tensor
+
+        return to_tensor(out, dtype="int64")
 
     def clear_intermediate_tensor(self):
         pass
@@ -291,12 +392,73 @@ def get_num_bytes_of_data_type(dtype) -> int:
 
 
 class PredictorPool:
-    """reference api PredictorPool: N predictors sharing one program."""
+    """reference api PredictorPool: N predictors sharing one program.
+
+    Sharing semantics (docs/decoding.md "PredictorPool and threads"):
+    every predictor wraps the SAME Config — one live model, one decode
+    engine cache / serving engine.  A single Predictor is NOT safe for
+    concurrent ``run()`` (its input/output handle dicts are per-call
+    state); distinct predictors are.  ``acquire``/``release`` hand out
+    exclusive predictors with that guarantee; ``retrive(idx)`` remains
+    the reference's unmanaged accessor — callers indexing the same slot
+    from two threads get the races they ask for."""
 
     def __init__(self, config: Config, size: int = 1):
+        import queue as _queue
+        import threading as _threading
+
+        if size < 1:
+            raise ValueError(f"PredictorPool size must be >= 1, got {size}")
         self._predictors = [Predictor(config) for _ in range(size)]
+        self._free: "_queue.Queue[Predictor]" = _queue.Queue()
+        for p in self._predictors:
+            self._free.put(p)
+        self._out_lock = _threading.Lock()
+        self._out: set = set()
+
+    @property
+    def size(self) -> int:
+        return len(self._predictors)
 
     def retrive(self, idx: int) -> Predictor:  # (sic) reference spelling
         return self._predictors[idx]
 
     retrieve = retrive
+
+    def acquire(self, timeout: Optional[float] = None) -> Predictor:
+        """Exclusive predictor; blocks until one is free.  Pair with
+        ``release`` (or use the ``predictor()`` context manager)."""
+        import queue as _queue
+
+        try:
+            p = self._free.get(timeout=timeout)
+        except _queue.Empty:
+            raise TimeoutError(
+                f"no free predictor after {timeout}s (pool size "
+                f"{len(self._predictors)})") from None
+        with self._out_lock:
+            self._out.add(id(p))
+        return p
+
+    def release(self, predictor: Predictor):
+        with self._out_lock:
+            if id(predictor) not in self._out:
+                raise ValueError(
+                    "release() of a predictor that is not checked out "
+                    "(double release, or not from acquire())")
+            self._out.discard(id(predictor))
+        self._free.put(predictor)
+
+    def predictor(self, timeout: Optional[float] = None):
+        """``with pool.predictor() as p: p.run(...)``"""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            p = self.acquire(timeout=timeout)
+            try:
+                yield p
+            finally:
+                self.release(p)
+
+        return _ctx()

@@ -32,6 +32,7 @@ Model contract: a model mixes in :class:`GenerationMixin` and implements
 """
 from __future__ import annotations
 
+import threading
 from typing import List, Optional
 
 import jax
@@ -55,7 +56,38 @@ __all__ = [
 ]
 
 
-class KVCache:
+class _KVBuffers:
+    """Shared buffer bookkeeping for KV caches exposing ``k``/``v`` (+
+    ``stacked``): size accounting and eager release.  Used by both the
+    contiguous :class:`KVCache` and the serving page pool
+    (``serving.paged_cache.PagedKVCache``) so release semantics cannot
+    drift between them."""
+
+    def _tensors(self) -> List[Tensor]:
+        return ([self.k, self.v] if self.stacked
+                else list(self.k) + list(self.v))
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(np.prod(t._value.shape)) * t._value.dtype.itemsize
+                   for t in self._tensors())
+
+    def release(self):
+        """Delete the cache's device buffers NOW.  Dropping the python
+        refs leaves HBM release to GC timing — and compiled step closures
+        keep the Tensors alive anyway; jax's ``Array.delete()`` frees the
+        buffers eagerly.  The cache is unusable afterwards."""
+        for t in self._tensors():
+            v = t._value
+            delete = getattr(v, "delete", None)
+            if delete is not None:
+                try:
+                    delete()
+                except Exception:  # noqa: BLE001 — already deleted/donated
+                    pass
+
+
+class KVCache(_KVBuffers):
     """Preallocated static-shape KV cache.
 
     ``stacked=False``: per-layer Tensor pairs ``k[i]/v[i]`` of shape
@@ -97,12 +129,6 @@ class KVCache:
             raise ValueError("layer() is for the per-layer cache layout; "
                              "the stacked cache is scanned whole")
         return self.k[i], self.v[i]
-
-    @property
-    def nbytes(self) -> int:
-        ts = [self.k, self.v] if self.stacked else list(self.k) + list(self.v)
-        return sum(int(np.prod(t._value.shape)) * t._value.dtype.itemsize
-                   for t in ts)
 
 
 # ---------------------------------------------------------------------------
@@ -204,6 +230,15 @@ class _DecodeEngine:
         self.do_sample = do_sample
         self.top_k = top_k
         self.use_top_p = use_top_p
+        # one generate() at a time per engine: the compiled steps mutate
+        # the SHARED cache, so concurrent callers (PredictorPool threads)
+        # must serialize per engine — distinct engines run concurrently.
+        # `released` flips under the lock when eviction deletes the cache
+        # buffers; a caller that raced the eviction (engine looked up, lock
+        # not yet taken) sees it and fetches a fresh engine instead of
+        # dispatching into deleted arrays.
+        self.lock = threading.RLock()
+        self.released = False
 
         def prefill_step(ids, temperature, top_p):
             _TRACE_COUNTS["prefill"] += 1
@@ -243,6 +278,19 @@ class _DecodeEngine:
         at compile time; see docs/graph_lint.md)."""
         return self.prefill.lint_reports() + self.decode.lint_reports()
 
+    def release(self):
+        """Free the engine's KV-cache HBM eagerly (LRU eviction /
+        clear_decode_cache): the compiled step closures pin the cache
+        Tensors, so without an explicit ``delete()`` the buffers wait on
+        GC.  Taking ``self.lock`` first means an in-flight generate() on
+        this engine finishes its loop before the buffers vanish under it
+        (the evictor blocks, it does not corrupt); ``released`` tells a
+        caller that looked the engine up just before the eviction to
+        retry with a fresh one."""
+        with self.lock:
+            self.cache.release()
+            self.released = True
+
 
 # each cached engine pins a full KV cache in HBM; bound how many distinct
 # (batch, max_seq, dtype, sampling-topology) combinations stay resident
@@ -252,22 +300,29 @@ _MAX_ENGINES = 4
 def _engine_for(model, batch: int, max_seq: int, cache_dtype: str, *,
                 do_sample: bool, top_k: int, use_top_p: bool) -> _DecodeEngine:
     # model.__dict__ directly: Layer.__setattr__ must not see cache Tensors
-    # (they are serving state, not parameters/buffers)
-    engines = model.__dict__.setdefault("_decode_engines", {})
-    key = (batch, max_seq, str(cache_dtype), bool(do_sample), int(top_k),
-           bool(use_top_p))
-    eng = engines.pop(key, None)
-    if eng is None:
-        while len(engines) >= _MAX_ENGINES:
-            # LRU: dict order is move-to-back-on-use; dropping the engine
-            # releases its cache HBM (the only strong refs live here)
-            old_key = next(iter(engines))
-            del engines[old_key]
-        cache = model.new_kv_cache(batch, max_seq, dtype=cache_dtype)
-        eng = _DecodeEngine(model, cache, do_sample=do_sample, top_k=top_k,
-                            use_top_p=use_top_p)
-    engines[key] = eng  # (re)insert at the back = most recently used
-    return eng
+    # (they are serving state, not parameters/buffers).  dict.setdefault is
+    # atomic, so concurrent first calls agree on one lock/registry.
+    lock = model.__dict__.setdefault("_decode_engines_lock",
+                                     threading.Lock())
+    with lock:
+        engines = model.__dict__.setdefault("_decode_engines", {})
+        key = (batch, max_seq, str(cache_dtype), bool(do_sample), int(top_k),
+               bool(use_top_p))
+        eng = engines.pop(key, None)
+        if eng is not None and eng.released:
+            eng = None        # buffers already deleted: build a fresh one
+        if eng is None:
+            while len(engines) >= _MAX_ENGINES:
+                # LRU: dict order is move-to-back-on-use; evicting the
+                # engine deletes its cache buffers explicitly (the compiled
+                # step closures would otherwise pin them until GC)
+                old_key = next(iter(engines))
+                engines.pop(old_key).release()
+            cache = model.new_kv_cache(batch, max_seq, dtype=cache_dtype)
+            eng = _DecodeEngine(model, cache, do_sample=do_sample,
+                                top_k=top_k, use_top_p=use_top_p)
+        engines[key] = eng  # (re)insert at the back = most recently used
+        return eng
 
 
 def generate(model, input_ids, max_new_tokens: int = 32, *,
@@ -313,43 +368,56 @@ def generate(model, input_ids, max_new_tokens: int = 32, *,
         raise ValueError("temperature must be > 0 when do_sample=True")
 
     use_top_p = do_sample and top_p is not None
-    eng = _engine_for(model, b, max_seq, cache_dtype,
-                      do_sample=do_sample, top_k=int(top_k or 0),
-                      use_top_p=use_top_p)
-
     temp_t = to_tensor(np.float32(temperature))
     top_p_t = to_tensor(np.float32(top_p if top_p is not None else 1.0))
 
-    # generation is an eval-time graph: dropout must not trace in
-    was_training = model.training
-    if was_training:
-        model.eval()
-    try:
-        tok, last = eng.prefill(ids, temp_t, top_p_t)
-        toks: List[Tensor] = [tok]
-        logit_steps: List[Tensor] = [last] if return_logits else []
-        pos = to_tensor(np.int32(s0))
-        done = None
-        if eos_token_id is not None:
-            done = np.asarray(tok.numpy()) == eos_token_id
-        for _ in range(max_new_tokens - 1):
-            if done is not None and bool(done.all()) and not return_logits:
-                # every row finished: pad the remaining steps host-side
-                # instead of decoding.  (With return_logits the loop keeps
-                # decoding so every returned row is a REAL model
-                # distribution — zero-padded rows would silently read as
-                # uniform to a perplexity/logprob consumer.)
-                toks.append(ops.full_like(tok, eos_token_id))
+    # generation is an eval-time graph: dropout must not trace in.
+    # eng.lock: the compiled steps mutate the engine's shared cache, so a
+    # second thread on the same request shape serializes here instead of
+    # interleaving decode steps through one cache (PredictorPool threads).
+    # The retry loop closes the lookup->lock window: an engine evicted in
+    # between flips `released` under its lock, and we fetch a fresh one
+    # instead of dispatching into deleted cache buffers.
+    while True:
+        eng = _engine_for(model, b, max_seq, cache_dtype,
+                          do_sample=do_sample, top_k=int(top_k or 0),
+                          use_top_p=use_top_p)
+        with eng.lock:
+            if eng.released:
                 continue
-            tok, pos, last = eng.decode(tok, pos, temp_t, top_p_t)
-            toks.append(tok)
-            if return_logits:
-                logit_steps.append(last)
-            if done is not None:
-                done = done | (np.asarray(tok.numpy()) == eos_token_id)
-    finally:
-        if was_training:
-            model.train()
+            was_training = model.training
+            if was_training:
+                model.eval()
+            try:
+                tok, last = eng.prefill(ids, temp_t, top_p_t)
+                toks: List[Tensor] = [tok]
+                logit_steps: List[Tensor] = [last] if return_logits else []
+                pos = to_tensor(np.int32(s0))
+                done = None
+                if eos_token_id is not None:
+                    done = np.asarray(tok.numpy()) == eos_token_id
+                for _ in range(max_new_tokens - 1):
+                    if done is not None and bool(done.all()) \
+                            and not return_logits:
+                        # every row finished: pad the remaining steps
+                        # host-side instead of decoding.  (With
+                        # return_logits the loop keeps decoding so every
+                        # returned row is a REAL model distribution —
+                        # zero-padded rows would silently read as uniform
+                        # to a perplexity/logprob consumer.)
+                        toks.append(ops.full_like(tok, eos_token_id))
+                        continue
+                    tok, pos, last = eng.decode(tok, pos, temp_t, top_p_t)
+                    toks.append(tok)
+                    if return_logits:
+                        logit_steps.append(last)
+                    if done is not None:
+                        done = done | (np.asarray(tok.numpy())
+                                       == eos_token_id)
+            finally:
+                if was_training:
+                    model.train()
+            break
 
     gen = ops.stack(toks, axis=1)                               # [B, N]
     if eos_token_id is not None:
@@ -379,5 +447,14 @@ class GenerationMixin:
         return generate(self, input_ids, max_new_tokens, **kwargs)
 
     def clear_decode_cache(self):
-        """Drop every cached decode engine (and its KV-cache HBM)."""
-        self.__dict__.pop("_decode_engines", None)
+        """Drop every cached decode engine AND delete its KV-cache device
+        buffers eagerly (the compiled step closures would otherwise pin
+        the HBM until GC collects the whole engine graph)."""
+        lock = self.__dict__.get("_decode_engines_lock")
+        engines = (self.__dict__.pop("_decode_engines", None)
+                   if lock is None else None)
+        if lock is not None:
+            with lock:
+                engines = self.__dict__.pop("_decode_engines", None)
+        for eng in (engines or {}).values():
+            eng.release()

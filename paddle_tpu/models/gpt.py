@@ -166,9 +166,16 @@ def _as_pos(cache_index) -> Tensor:
 
 
 def _cache_position_ids(input_ids: Tensor, pos: Tensor) -> Tensor:
-    """position_ids [B, S] = cache position offset + arange(S)."""
+    """position_ids [B, S] = cache position offset + arange(S).
+
+    ``pos`` is a scalar on the single-request decode path and a per-slot
+    vector ``[B]`` on the continuous-batching paged path (every slot sits
+    at its own position)."""
     s = input_ids.shape[-1]
-    rel = ops.arange(0, s, dtype="int64") + pos.astype("int64")
+    rel = ops.arange(0, s, dtype="int64")
+    if len(pos.shape) == 1:
+        return ops.unsqueeze(pos.astype("int64"), 1) + ops.unsqueeze(rel, 0)
+    rel = rel + pos.astype("int64")
     return ops.expand(ops.unsqueeze(rel, 0), list(input_ids.shape))
 
 
@@ -284,6 +291,91 @@ def _attend_with_cache(q: Tensor, k: Tensor, v: Tensor, ck_t: Tensor,
     return out
 
 
+def _raw_attend_paged(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
+                      page_size):
+    """Raw (traced) paged cache write + attend for continuous batching.
+
+    qh/kh/vh: [S, N, C, D] head-major fresh projections (S decode slots);
+    pkr/pvr: [P, N, page_size, D] global page pools; tables: [S, max_pages]
+    int32 page tables; posr: [S] traced per-slot positions.  Returns
+    (out [S, N, C, D], new_k_pool, new_v_pool).
+
+    Every write translates an absolute position through the page table:
+    position p of slot s lands at ``pool[tables[s, p//page_size], :,
+    p%page_size]``.  Inactive slots and prefill padding carry null-page
+    table entries, so their writes sink into page 0 (never validly read).
+    C == 1 is the batched decode step: scatter one token per slot, then
+    the paged flash-decode kernel (XLA gather fallback off-TPU) over each
+    slot's own pages.  C > 1 is chunked prefill for one admitted request:
+    the chunk scatters into (possibly non-contiguous) pages and attends
+    over the whole gathered context with an absolute-position causal mask,
+    so earlier chunks stay visible — the paged analog of the contiguous
+    chunked-prefill path."""
+    from ..ops.pallas_kernels.paged_attention import (
+        gather_pages, paged_attention,
+    )
+
+    s_, nh, c, d = qh.shape
+    max_pages = tables.shape[1]
+    scale = float(1.0 / np.sqrt(head_dim))
+    pos = posr.astype(jnp.int32)
+    tbl = tables.astype(jnp.int32)
+    abs_pos = pos[:, None] + jax.lax.broadcasted_iota(
+        jnp.int32, (s_, c), 1)                               # [S, C]
+    # the clip is defensive: the engine sizes max_ctx to a chunk multiple
+    # so prefill padding never runs past the table (see serving/engine.py)
+    page_slot = jnp.clip(abs_pos // page_size, 0, max_pages - 1)
+    page_ids = jnp.take_along_axis(tbl, page_slot, axis=1)   # [S, C]
+    offs = abs_pos % page_size
+    # advanced indices split by the head slice: result dims [S, C, N, D]
+    pk2 = pkr.at[page_ids, :, offs, :].set(
+        jnp.transpose(kh, (0, 2, 1, 3)).astype(pkr.dtype))
+    pv2 = pvr.at[page_ids, :, offs, :].set(
+        jnp.transpose(vh, (0, 2, 1, 3)).astype(pvr.dtype))
+    if c == 1:
+        out = paged_attention(qh[:, :, 0, :], pk2, pv2, tbl, pos + 1,
+                              sm_scale=scale)
+        out = out[:, :, None, :].astype(qh.dtype)
+    else:
+        # chunked prefill: queries at absolute positions p..p+C-1 attend to
+        # every written position <= their own across the gathered pages
+        ck = gather_pages(pk2, tbl)                          # [S, N, ctx, D]
+        cv = gather_pages(pv2, tbl)
+        scores = jnp.einsum("snqd,snkd->snqk", qh.astype(ck.dtype), ck,
+                            preferred_element_type=jnp.float32) * scale
+        cols = jax.lax.broadcasted_iota(
+            jnp.int32, (s_, c, ck.shape[2]), 2)
+        mask = cols <= abs_pos[:, :, None]
+        scores = jnp.where(mask[:, None, :, :], scores,
+                           jnp.asarray(-1e9, scores.dtype))
+        att = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("snqk,snkd->snqd", att.astype(cv.dtype),
+                         cv).astype(qh.dtype)
+    return out, pk2, pv2
+
+
+def _attend_paged(q: Tensor, k: Tensor, v: Tensor, pk_t: Tensor,
+                  pv_t: Tensor, tables: Tensor, pos: Tensor,
+                  cfg: GPTConfig) -> Tensor:
+    """Tensor-level paged attention for the layered decoder.  q/k/v:
+    [S, C, nh, hd]; mutates the pool Tensors in place (mutation-logged, so
+    jit.to_static donates them to the compiled serving step)."""
+    page_size = int(pk_t.shape[-2])
+
+    def raw(qr, kr, vr, pkr, pvr, tbl, posr):
+        qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (qr, kr, vr))
+        out, pk2, pv2 = _raw_attend_paged(
+            qh, kh, vh, pkr, pvr, tbl, posr,
+            head_dim=cfg.head_dim, page_size=page_size)
+        return jnp.swapaxes(out, 1, 2), pk2, pv2
+
+    out, pk_new, pv_new = ops.dispatch.apply(
+        raw, q, k, v, pk_t, pv_t, tables, pos, op_name="paged_attention")
+    pk_t._set_value(pk_new._value)
+    pv_t._set_value(pv_new._value)
+    return out
+
+
 class GPTEmbeddings(Layer):
     def __init__(self, cfg: GPTConfig):
         super().__init__()
@@ -326,7 +418,8 @@ class GPTAttention(Layer):
         self.dropout = Dropout(cfg.hidden_dropout)
 
     def forward(self, x: Tensor, attn_mask: Optional[Tensor] = None,
-                layer_kv=None, cache_index=None) -> Tensor:
+                layer_kv=None, cache_index=None,
+                page_tables: Optional[Tensor] = None) -> Tensor:
         cfg = self._cfg
         b, s = x.shape[0], x.shape[1]
         nh, hd = cfg.num_heads, cfg.head_dim
@@ -346,8 +439,14 @@ class GPTAttention(Layer):
                     "write pad positions into the cache — right-pad or "
                     "serve per-sequence")
             ck_t, cv_t = layer_kv
-            out = _attend_with_cache(q, k, v, ck_t, cv_t,
-                                     _as_pos(cache_index), cfg)
+            if page_tables is not None:
+                # continuous-batching path: page-table-translated write
+                # into the global pool, paged decode-attention kernel
+                out = _attend_paged(q, k, v, ck_t, cv_t, page_tables,
+                                    _as_pos(cache_index), cfg)
+            else:
+                out = _attend_with_cache(q, k, v, ck_t, cv_t,
+                                         _as_pos(cache_index), cfg)
         # sequence-parallel causal attention runs as a ring over 'sp'
         # (K/V rotate via ppermute; online-softmax merge) — the S axis stays
         # sharded instead of being all-gathered for the score matmul
@@ -400,9 +499,10 @@ class GPTDecoderLayer(Layer):
         self.mlp = GPTMLP(cfg)
 
     def forward(self, x: Tensor, attn_mask: Optional[Tensor] = None,
-                layer_kv=None, cache_index=None) -> Tensor:
+                layer_kv=None, cache_index=None,
+                page_tables: Optional[Tensor] = None) -> Tensor:
         x = x + self.attn(self.ln1(x), attn_mask, layer_kv=layer_kv,
-                          cache_index=cache_index)
+                          cache_index=cache_index, page_tables=page_tables)
         x = x + self.mlp(self.ln2(x))
         return _seq_shard(x, self._cfg)
 
@@ -421,16 +521,29 @@ class GPTModel(Layer):
 
     def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None,
                 attn_mask: Optional[Tensor] = None, kv_cache=None,
-                cache_index=None) -> Tensor:
+                cache_index=None,
+                page_tables: Optional[Tensor] = None) -> Tensor:
+        paged = bool(getattr(kv_cache, "paged", False))
+        if paged and page_tables is None:
+            raise ValueError("a paged KV cache needs page_tables "
+                             "([B, max_pages] int32 pool page ids)")
         pos = _as_pos(cache_index) if kv_cache is not None else None
         if kv_cache is not None and position_ids is None:
             position_ids = _cache_position_ids(input_ids, pos)
+            if paged:
+                # prefill padding may carry positions past the table; the
+                # write already sinks them into the null page — keep the
+                # embedding lookup in range too
+                position_ids = ops.clip(
+                    position_ids, min=0,
+                    max=self.config.max_position_embeddings - 1)
         h = self.embeddings(input_ids, position_ids)
         k = self.config.recompute_interval
         for i, layer in enumerate(self.layers):
             if kv_cache is not None:
                 h = layer(h, attn_mask, layer_kv=kv_cache.layer(i),
-                          cache_index=pos)
+                          cache_index=pos,
+                          page_tables=page_tables if paged else None)
             elif k and (i % k == 0) and self.training:
                 h = recompute(layer, h, attn_mask)
             else:
@@ -453,9 +566,11 @@ class GPTForPretraining(Layer, GenerationMixin):
 
     def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None,
                 attn_mask: Optional[Tensor] = None, kv_cache=None,
-                cache_index=None) -> Tensor:
+                cache_index=None,
+                page_tables: Optional[Tensor] = None) -> Tensor:
         h = self.gpt(input_ids, position_ids, attn_mask,
-                     kv_cache=kv_cache, cache_index=cache_index)
+                     kv_cache=kv_cache, cache_index=cache_index,
+                     page_tables=page_tables)
         w = self.gpt.embeddings.word_embeddings.weight  # [V, H]
         logits = ops.matmul(h, w, transpose_y=True)     # [B, S, V]
         return logits
@@ -470,6 +585,23 @@ class GPTForPretraining(Layer, GenerationMixin):
     def _cached_lm_logits(self, input_ids, kv_cache, cache_index):
         return self.forward(input_ids, kv_cache=kv_cache,
                             cache_index=cache_index)
+
+    # -- ServingEngine paged-cache contract --------------------------------
+    def new_paged_kv_cache(self, num_pages: int, page_size: int,
+                           dtype: str = "bfloat16"):
+        from ..serving.paged_cache import PagedKVCache
+
+        cfg = self.config
+        return PagedKVCache(cfg.num_layers, num_pages, cfg.num_heads,
+                            page_size, cfg.head_dim, dtype=dtype,
+                            stacked=False)
+
+    def _paged_lm_logits(self, input_ids, paged_cache, page_tables,
+                         positions):
+        """[B, S, V] logits over the paged pool: ``positions`` is the
+        per-slot position vector [B], ``page_tables`` [B, max_pages]."""
+        return self.forward(input_ids, kv_cache=paged_cache,
+                            cache_index=positions, page_tables=page_tables)
 
 
 class GPTStackedDecoder(Layer):
@@ -674,6 +806,74 @@ class GPTStackedDecoder(Layer):
 
         return block
 
+    def _paged_block_fn(self, page_size: int):
+        """Paged decode-block body: like _cached_block_fn but threading the
+        global page pool + page tables — (params, h, k_pool, v_pool,
+        tables, pos) -> (h, k_pool, v_pool).  Inference-only; AMP casts
+        follow _block_fn's discipline (matmuls in amp dtype, LayerNorm
+        fp32, fp32 LN output cast back to the weight dtype)."""
+        cfg = self._cfg
+        nh, hd = cfg.num_heads, cfg.head_dim
+        eps = cfg.layer_norm_eps
+        from ..amp.auto_cast import _amp_state
+
+        cdt = _amp_state.dtype if (_amp_state.enabled
+                                   and _amp_state.level == "O1") else None
+
+        def ln(x, g, b):
+            return _ln_f32(x, g, b, eps)
+
+        def block(p, h, kc, vc, tbl, pos):
+            (l1g, l1b, qkvw, qkvb, pw, pb, l2g, l2b, f1w, f1b, f2w, f2b) = p
+            if cdt is not None:
+                qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b = (
+                    a.astype(cdt) for a in (qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b)
+                )
+            b, s, hidden = h.shape
+            x = ln(h, l1g, l1b).astype(qkvw.dtype)
+            qkv = (x @ qkvw + qkvb).reshape(b, s, 3, nh, hd)
+            q, k, v = (jnp.swapaxes(qkv[:, :, i], 1, 2) for i in range(3))
+            out, kc, vc = _raw_attend_paged(
+                q, k, v, kc, vc, tbl, pos, head_dim=hd, page_size=page_size)
+            out = jnp.swapaxes(out, 1, 2).reshape(b, s, hidden)
+            h = h + (out.astype(pw.dtype) @ pw + pb).astype(h.dtype)
+            y = ln(h, l2g, l2b).astype(f1w.dtype)
+            y = jax.nn.gelu(y @ f1w + f1b, approximate=True) @ f2w + f2b
+            return h + y.astype(h.dtype), kc, vc
+
+        return block
+
+    def _forward_paged(self, hidden: Tensor, paged_cache, page_tables,
+                       cache_index) -> Tensor:
+        """Serving step over the stacked parameters with a STACKED
+        [L, P, H, page_size, D] page pool: lax.scan carries the hidden
+        state and scans the per-layer pool slices as xs/ys, exactly like
+        _forward_cached scans the contiguous cache.  The updated pool is
+        written back in place (mutation-logged -> donated under
+        jit.to_static)."""
+        from ..ops import dispatch
+
+        pos = _as_pos(cache_index)
+        block = self._paged_block_fn(int(paged_cache.page_size))
+
+        def raw(h, posr, tbl, pk, pv, *stacked):
+            def step(carry, xs):
+                params, kc, vc = xs[:-2], xs[-2], xs[-1]
+                h2, kc2, vc2 = block(params, carry, kc, vc,
+                                     tbl.astype(jnp.int32),
+                                     posr.astype(jnp.int32))
+                return h2, (kc2, vc2)
+
+            h2, (pk2, pv2) = jax.lax.scan(step, h, tuple(stacked) + (pk, pv))
+            return h2, pk2, pv2
+
+        out, pk_new, pv_new = dispatch.apply(
+            raw, hidden, pos, page_tables, paged_cache.k, paged_cache.v,
+            *self._stacked(), op_name="gpt_stacked_decoder_paged")
+        paged_cache.k._set_value(pk_new._value)
+        paged_cache.v._set_value(pv_new._value)
+        return out
+
     def _forward_cached(self, hidden: Tensor, kv_cache, cache_index) -> Tensor:
         """Decode/prefill over the stacked parameters with a STACKED
         [L, B, H, max_seq, D] cache: lax.scan carries the hidden state and
@@ -704,14 +904,21 @@ class GPTStackedDecoder(Layer):
         return out
 
     def forward(self, hidden: Tensor, n_micro: int = 1, kv_cache=None,
-                cache_index=None) -> Tensor:
+                cache_index=None,
+                page_tables: Optional[Tensor] = None) -> Tensor:
         """hidden: [B, S, H]. With a pp axis > 1, splits B into n_micro
         microbatches and pipelines; else scans layers.  With ``kv_cache``
-        (serving), runs the cached decode scan instead."""
+        (serving), runs the cached decode scan instead — the paged scan
+        when the cache is a PagedKVCache."""
         from ..ops import dispatch
         from ..distributed.fleet.meta_parallel import pp_spmd
 
         if kv_cache is not None:
+            if getattr(kv_cache, "paged", False):
+                if page_tables is None:
+                    raise ValueError("a paged KV cache needs page_tables")
+                return self._forward_paged(hidden, kv_cache, page_tables,
+                                           cache_index)
             return self._forward_cached(hidden, kv_cache, cache_index)
 
         cfg = self._cfg
@@ -778,16 +985,21 @@ class GPTStackedForPretraining(Layer, GenerationMixin):
 
     def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None,
                 labels: Optional[Tensor] = None, kv_cache=None,
-                cache_index=None) -> Tensor:
+                cache_index=None,
+                page_tables: Optional[Tensor] = None) -> Tensor:
         """Without ``labels``: returns [B, S, V] logits.  With ``labels``:
         returns the scalar LM loss through the fused linear+cross-entropy
         head (chunked over tokens, logits never fully materialized — the
         HBM-friendly path; see F.fused_linear_cross_entropy)."""
         if kv_cache is not None and position_ids is None:
             position_ids = _cache_position_ids(input_ids, _as_pos(cache_index))
+            if getattr(kv_cache, "paged", False):
+                position_ids = ops.clip(
+                    position_ids, min=0,
+                    max=self.config.max_position_embeddings - 1)
         h = self.embeddings(input_ids, position_ids)
         h = self.decoder(h, n_micro=self.n_micro, kv_cache=kv_cache,
-                         cache_index=cache_index)
+                         cache_index=cache_index, page_tables=page_tables)
         h = self.final_ln(h)
         w = self.embeddings.word_embeddings.weight
         if labels is not None:
@@ -807,6 +1019,21 @@ class GPTStackedForPretraining(Layer, GenerationMixin):
     def _cached_lm_logits(self, input_ids, kv_cache, cache_index):
         return self.forward(input_ids, kv_cache=kv_cache,
                             cache_index=cache_index)
+
+    # -- ServingEngine paged-cache contract --------------------------------
+    def new_paged_kv_cache(self, num_pages: int, page_size: int,
+                           dtype: str = "bfloat16"):
+        from ..serving.paged_cache import PagedKVCache
+
+        cfg = self.config
+        return PagedKVCache(cfg.num_layers, num_pages, cfg.num_heads,
+                            page_size, cfg.head_dim, dtype=dtype,
+                            stacked=True)
+
+    def _paged_lm_logits(self, input_ids, paged_cache, page_tables,
+                         positions):
+        return self.forward(input_ids, kv_cache=paged_cache,
+                            cache_index=positions, page_tables=page_tables)
 
 
 class GPTPretrainingCriterion(Layer):

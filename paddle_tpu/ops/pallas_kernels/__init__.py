@@ -5,4 +5,5 @@ The reference keeps its hot ops as handwritten CUDA
 Pallas kernels compiled through Mosaic for the TPU's MXU/VMEM.
 """
 from . import decode_attention  # noqa: F401  (module: decode_attention.decode_attention)
+from . import paged_attention  # noqa: F401  (module: paged_attention.paged_attention)
 from .rms_norm import fused_add_layer_norm, fused_add_rms_norm  # noqa: F401

@@ -1,0 +1,35 @@
+"""Continuous-batching serving engine over a paged KV cache.
+
+The serving analog of the reference's fused_multi_transformer serving stack,
+TPU-native: one fixed-shape compiled decode step serves an ever-changing
+request mix (PAPERS.md: "Ragged Paged Attention", arxiv 2604.15464).
+
+- :mod:`paged_cache` — the global KV page pool (``PagedKVCache``) and the
+  free-list ``BlockAllocator`` (page 0 reserved as the null page);
+- :mod:`scheduler` — fixed decode slots, admission with up-front page
+  reservation (out-of-pages admission backpressures into the queue),
+  immediate page free on retirement;
+- :mod:`engine` — ``ServingEngine`` / ``RequestQueue``: request lifecycle
+  (SUBMITTED -> PREFILL -> DECODE -> DONE), chunked prefill into pages,
+  ONE donated retrace-free jitted decode step over all slots, per-request
+  sampling, streaming token callbacks, per-step metrics.
+
+See docs/serving.md.
+"""
+from .engine import (  # noqa: F401
+    Request,
+    RequestQueue,
+    RequestState,
+    SamplingParams,
+    ServingEngine,
+    serve_trace_counts,
+    reset_serve_trace_counts,
+)
+from .paged_cache import NULL_PAGE, BlockAllocator, PagedKVCache  # noqa: F401
+from .scheduler import Scheduler, Slot  # noqa: F401
+
+__all__ = [
+    "Request", "RequestQueue", "RequestState", "SamplingParams",
+    "ServingEngine", "serve_trace_counts", "reset_serve_trace_counts",
+    "NULL_PAGE", "BlockAllocator", "PagedKVCache", "Scheduler", "Slot",
+]

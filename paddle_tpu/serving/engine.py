@@ -1,0 +1,562 @@
+"""ServingEngine: continuous batching over the paged KV cache.
+
+One engine serves an arbitrary stream of requests with TWO compiled
+programs (greedy traffic — the common case) for the whole lifetime of the
+process, plus two more only if sampling requests ever arrive:
+
+- **prefill** — ``[1, prefill_chunk]`` ids for one admitted request,
+  page-table-translated writes into its reserved pages (chunked prompts
+  reuse the same program per chunk; the final chunk samples the first
+  generated token from the last real position's logits);
+- **decode** — ONE donated, retrace-free step over ALL slots at once:
+  ``[num_slots]`` last tokens + per-slot positions/page tables/sampling
+  params in, next tokens out.  Inactive slots ride along masked (null-page
+  table rows, position 0) so the step's shapes never change as requests
+  arrive and finish — zero retraces under churn, asserted by
+  ``serve_trace_counts()`` exactly like ``models/generation``.
+
+Each phase has a greedy variant (pure argmax — no full-vocab sort,
+softmax, or RNG traffic on the hot path) and a sampling variant (per-slot
+traced temperature/top-k/top-p vectors; greedy rows inside a mixed batch
+stay bit-exact).  The host picks per step; both stay cached, so the
+retrace-freedom invariant holds per variant.
+
+Request lifecycle: SUBMITTED (queued; admission backpressures on free
+slots AND free pages) -> PREFILL -> DECODE -> DONE, with per-request
+sampling params (greedy / temperature / top-k / top-p as traced per-slot
+vectors — one compiled step serves every mix), streaming ``on_token``
+callbacks, and per-step metrics (active slots, pool occupancy, queue
+depth, tokens/sec).
+
+See docs/serving.md for the architecture and slot/page lifecycle.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import ops
+from ..ops import dispatch
+from ..tensor import Tensor, to_tensor
+from .paged_cache import BlockAllocator
+from .scheduler import Scheduler
+
+__all__ = [
+    "RequestState", "SamplingParams", "Request", "RequestQueue",
+    "ServingEngine", "serve_trace_counts", "reset_serve_trace_counts",
+]
+
+_NEG = np.float32(-1e30)
+
+
+class RequestState:
+    SUBMITTED = "SUBMITTED"
+    PREFILL = "PREFILL"
+    DECODE = "DECODE"
+    DONE = "DONE"
+
+
+@dataclass
+class SamplingParams:
+    """Per-request sampling; every field rides as a traced per-slot vector
+    inside the ONE compiled decode step (no retrace across mixes).
+    Greedy (``do_sample=False``) ignores the rest."""
+
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_k: int = 0          # 0 = off
+    top_p: float = 1.0      # 1.0 = off
+
+    def __post_init__(self):
+        if self.do_sample and not self.temperature > 0.0:
+            raise ValueError("temperature must be > 0 when do_sample=True")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+
+
+class Request:
+    """One generation request moving through the engine."""
+
+    _ids = itertools.count()
+
+    def __init__(self, prompt: np.ndarray, max_new_tokens: int,
+                 sampling: Optional[SamplingParams] = None,
+                 eos_token_id: Optional[int] = None,
+                 on_token: Optional[Callable] = None):
+        self.id = next(Request._ids)
+        self.prompt = np.asarray(prompt, np.int64).reshape(-1)
+        self.max_new_tokens = int(max_new_tokens)
+        self.sampling = sampling or SamplingParams()
+        self.eos_token_id = eos_token_id
+        self.on_token = on_token
+        self.state = RequestState.SUBMITTED
+        self.tokens: List[int] = []      # generated ids, in order
+        self._done = threading.Event()
+
+    @property
+    def finished(self) -> bool:
+        return self.state == RequestState.DONE
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def output_ids(self) -> np.ndarray:
+        """prompt + generated ids (the ``generate()`` convention)."""
+        return np.concatenate([self.prompt,
+                               np.asarray(self.tokens, np.int64)])
+
+
+class RequestQueue:
+    """Thread-safe FIFO; ``submit`` may be called from any thread."""
+
+    def __init__(self):
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+
+    def submit(self, request: Request) -> Request:
+        with self._lock:
+            self._q.append(request)
+        return request
+
+    def pop(self) -> Optional[Request]:
+        with self._lock:
+            return self._q.popleft() if self._q else None
+
+    def push_front(self, request: Request):
+        with self._lock:
+            self._q.appendleft(request)
+
+    @property
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def __len__(self) -> int:
+        return self.depth
+
+
+# python-body execution counters (same invariant as models/generation):
+# the step bodies run ONLY while tracing — frozen counters across N steps
+# of request churn == the retrace-freedom proof.
+_SERVE_TRACE_COUNTS = {"prefill": 0, "decode": 0}
+
+
+def serve_trace_counts() -> dict:
+    return dict(_SERVE_TRACE_COUNTS)
+
+
+def reset_serve_trace_counts():
+    _SERVE_TRACE_COUNTS["prefill"] = 0
+    _SERVE_TRACE_COUNTS["decode"] = 0
+
+
+def _sample_per_slot(logits: Tensor, temperature: Tensor, top_p: Tensor,
+                     top_k: Tensor, do_sample: Tensor) -> Tensor:
+    """Next-token selection over [S, V] logits with PER-SLOT params (all
+    traced [S] vectors) -> int64 [S].  Greedy rows take the raw argmax
+    (bit-identical to ``generation.sample_tokens`` greedy); sampling rows
+    apply temperature, then top-k (k-th sorted value as threshold;
+    k <= 0 = off) and top-p (smallest probability-sorted prefix reaching
+    mass p; 1.0 = off), then draw via Gumbel-argmax with a key split from
+    the global generator (functionalizes under jit.to_static)."""
+    from ..ops.random import default_generator
+
+    key = default_generator.split()
+
+    def fn(raw, t, p, k, ds):
+        raw = raw.astype(jnp.float32)
+        greedy = jnp.argmax(raw, axis=-1).astype(jnp.int64)
+        v = raw.shape[-1]
+        scaled = raw / jnp.clip(t, 1e-6, None)[:, None]
+        srt = -jnp.sort(-scaled, axis=-1)                 # descending
+        kk = jnp.clip(jnp.where(k > 0, k, v), 1, v).astype(jnp.int32)
+        kth = jnp.take_along_axis(srt, (kk - 1)[:, None], axis=1)
+        probs = jax.nn.softmax(srt, axis=-1)
+        prev_mass = jnp.cumsum(probs, axis=-1) - probs
+        keep = prev_mass < p[:, None]
+        pth = jnp.min(jnp.where(keep, srt, jnp.float32(np.inf)),
+                      axis=-1, keepdims=True)
+        filt = jnp.where(scaled < jnp.maximum(kth, pth), _NEG, scaled)
+        g = jax.random.gumbel(key, filt.shape, jnp.float32)
+        sampled = jnp.argmax(filt + g, axis=-1).astype(jnp.int64)
+        return jnp.where(ds, sampled, greedy)
+
+    # fresh key closure every call: opt out of the eager op cache
+    return dispatch.apply_nondiff(fn, logits, temperature, top_p, top_k,
+                                  do_sample, _cacheable=False)
+
+
+def _take_position(logits: Tensor, idx: Tensor) -> Tensor:
+    """logits [1, C, V], traced scalar idx -> [1, V] (the last REAL prompt
+    position of a padded prefill chunk)."""
+    def fn(lg, i):
+        sl = jax.lax.dynamic_slice_in_dim(lg, i.astype(jnp.int32), 1, axis=1)
+        return sl[:, 0, :]
+
+    return dispatch.apply_nondiff(fn, logits, idx)
+
+
+class ServingEngine:
+    """Continuous-batching front end over a model exposing the paged-cache
+    contract (``new_paged_kv_cache`` + ``_paged_lm_logits`` — both GPT
+    flagship classes implement it).
+
+    ``num_pages`` defaults to full capacity (every slot can hold
+    ``max_context`` tokens, plus the null page); size it DOWN to
+    oversubscribe HBM — admission then backpressures on pool occupancy,
+    not just on free slots.
+    """
+
+    def __init__(self, model, *, num_slots: int = 4,
+                 page_size: int = 128, max_context: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 cache_dtype: str = "bfloat16",
+                 prefill_chunk: Optional[int] = None):
+        cfg = model.config
+        max_context = int(max_context or cfg.max_position_embeddings)
+        if max_context > cfg.max_position_embeddings:
+            raise ValueError(
+                f"max_context={max_context} exceeds max_position_embeddings="
+                f"{cfg.max_position_embeddings}")
+        if max_context % page_size:
+            raise ValueError(
+                f"max_context={max_context} must be a multiple of "
+                f"page_size={page_size}")
+        prefill_chunk = int(prefill_chunk or min(page_size, max_context))
+        if max_context % prefill_chunk:
+            # guarantees prefill padding never runs past a slot's table
+            # (see _raw_attend_paged's defensive clip)
+            raise ValueError(
+                f"max_context={max_context} must be a multiple of "
+                f"prefill_chunk={prefill_chunk}")
+        max_pages_per_slot = max_context // page_size
+        if num_pages is None:
+            num_pages = num_slots * max_pages_per_slot + 1  # + null page
+        self.model = model
+        self.num_slots = int(num_slots)
+        self.page_size = int(page_size)
+        self.max_context = max_context
+        self.prefill_chunk = prefill_chunk
+        self.cache_dtype = str(cache_dtype)
+        self.cache = model.new_paged_kv_cache(num_pages, page_size,
+                                              dtype=cache_dtype)
+        self.allocator = BlockAllocator(num_pages)
+        self.scheduler = Scheduler(num_slots, max_pages_per_slot, page_size,
+                                   self.allocator)
+        self.queue = RequestQueue()
+        self._lock = threading.RLock()
+        self._closed = False
+
+        # host mirrors shipped to the jitted step each call (fixed shapes)
+        self._tokens = np.zeros((num_slots,), np.int64)
+        self._temp = np.ones((num_slots,), np.float32)
+        self._top_p = np.ones((num_slots,), np.float32)
+        self._top_k = np.zeros((num_slots,), np.int32)
+        self._do_sample = np.zeros((num_slots,), bool)
+
+        self._totals = {"steps": 0, "tokens": 0, "admitted": 0,
+                        "completed": 0, "prefill_chunks": 0}
+        self._step_emitted = 0           # tokens emitted in the current step
+        self._last_metrics: dict = {}
+
+        cache = self.cache
+        from ..jit.api import to_static
+
+        # two compiled variants per phase, chosen host-side per step: the
+        # greedy one is a pure argmax (no full-vocab sort / softmax /
+        # gumbel, no RNG-state traffic) — all-greedy traffic, the common
+        # serving case, never pays the sampling machinery.  Mixed batches
+        # take the sampling variant, whose per-slot `do_sample` vector
+        # still reproduces greedy rows bit-exactly.
+        def _mk_prefill(with_sampling):
+            def prefill_step(ids, tables, positions, last_idx, temp, top_p,
+                             top_k, do_sample):
+                _SERVE_TRACE_COUNTS["prefill"] += 1
+                with dispatch.no_grad():
+                    logits = model._paged_lm_logits(ids, cache, tables,
+                                                    positions)
+                    last = _take_position(logits, last_idx).astype("float32")
+                    if with_sampling:
+                        tok = _sample_per_slot(last, temp, top_p, top_k,
+                                               do_sample)
+                    else:
+                        tok = ops.argmax(last, axis=-1)
+                return tok
+
+            return prefill_step
+
+        def _mk_decode(with_sampling):
+            def decode_step(tokens, tables, positions, temp, top_p, top_k,
+                            do_sample):
+                _SERVE_TRACE_COUNTS["decode"] += 1
+                with dispatch.no_grad():
+                    ids = ops.reshape(tokens, [-1, 1])
+                    logits = model._paged_lm_logits(ids, cache, tables,
+                                                    positions)
+                    last = logits[:, -1, :].astype("float32")
+                    if with_sampling:
+                        tok = _sample_per_slot(last, temp, top_p, top_k,
+                                               do_sample)
+                    else:
+                        tok = ops.argmax(last, axis=-1)
+                return tok
+
+            return decode_step
+
+        self._prefill_greedy = to_static(_mk_prefill(False))
+        self._prefill_sample = to_static(_mk_prefill(True))
+        self._decode_greedy = to_static(_mk_decode(False))
+        self._decode_sample = to_static(_mk_decode(True))
+
+    # -- submission --------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 32, *,
+               sampling: Optional[SamplingParams] = None,
+               eos_token_id: Optional[int] = None,
+               on_token: Optional[Callable] = None) -> Request:
+        """Queue a request; returns immediately.  Validation happens here
+        so the step loop can never hit an unseatable request."""
+        self._check_open()
+        prompt = np.asarray(prompt, np.int64).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = prompt.size + int(max_new_tokens)
+        if total > self.max_context:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds max_context {self.max_context}")
+        if self.scheduler.pages_needed(total) > self.allocator.capacity:
+            raise ValueError(
+                f"request needs {self.scheduler.pages_needed(total)} pages "
+                f"but the pool holds only {self.allocator.capacity}")
+        req = Request(prompt, max_new_tokens, sampling=sampling,
+                      eos_token_id=eos_token_id, on_token=on_token)
+        return self.queue.submit(req)
+
+    # -- the serving loop --------------------------------------------------
+    def step(self) -> dict:
+        """One scheduler tick: admit what fits, run ONE batched decode
+        step over every active slot, retire finished requests (their pages
+        free immediately).  Returns this step's metrics."""
+        with self._lock, self._eval_mode():
+            # under the lock: close() also serializes on it, so a racing
+            # close cannot delete the pool between this check and the
+            # decode dispatch
+            self._check_open()
+            t0 = time.perf_counter()
+            self._step_emitted = 0
+            self._admit()
+            sched = self.scheduler
+            if sched.active_slots:
+                decode = (self._decode_sample if self._do_sample.any()
+                          else self._decode_greedy)
+                toks = decode(
+                    to_tensor(self._tokens),
+                    to_tensor(np.ascontiguousarray(sched.tables)),
+                    to_tensor(np.ascontiguousarray(sched.positions)),
+                    to_tensor(self._temp), to_tensor(self._top_p),
+                    to_tensor(self._top_k), to_tensor(self._do_sample))
+                toks_np = np.asarray(toks.numpy())
+                for i in range(self.num_slots):
+                    slot = sched.slots[i]
+                    if slot is None:
+                        continue
+                    # the step wrote the fed token's K/V at slot.pos
+                    sched.advance(i)
+                    tok = int(toks_np[i])
+                    self._tokens[i] = tok
+                    self._emit(slot.request, tok)
+                    if self._is_finished(slot.request, tok):
+                        self._finish(i)
+            dt = time.perf_counter() - t0
+            emitted = self._step_emitted
+            self._totals["steps"] += 1
+            self._totals["tokens"] += emitted
+            self._last_metrics = {
+                "active_slots": sched.active_slots,
+                "queue_depth": self.queue.depth,
+                "pages_used": self.allocator.used_pages,
+                "pages_capacity": self.allocator.capacity,
+                "occupancy": sched.occupancy,
+                "tokens_this_step": emitted,
+                "tokens_per_sec": emitted / dt if dt > 0 else 0.0,
+                "step_seconds": dt,
+            }
+            return dict(self._last_metrics)
+
+    def run_until_idle(self, max_steps: Optional[int] = None) -> dict:
+        """Step until queue and slots drain; returns cumulative metrics."""
+        steps = 0
+        while self.queue.depth or self.scheduler.active_slots:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return self.metrics()
+
+    def generate_batch(self, prompts, max_new_tokens: int = 32,
+                       **kwargs) -> List[np.ndarray]:
+        """Convenience: submit every prompt, drain, return each request's
+        prompt+generated ids (in submission order)."""
+        reqs = [self.submit(p, max_new_tokens, **kwargs) for p in prompts]
+        self.run_until_idle()
+        return [r.output_ids() for r in reqs]
+
+    # -- internals ---------------------------------------------------------
+    @contextmanager
+    def _eval_mode(self):
+        was = getattr(self.model, "training", False)
+        if was:
+            self.model.eval()
+        try:
+            yield
+        finally:
+            if was:
+                self.model.train()
+
+    def _admit(self):
+        sched = self.scheduler
+        while sched.free_slot_indices():
+            req = self.queue.pop()
+            if req is None:
+                return
+            total = req.prompt.size + req.max_new_tokens
+            idx = sched.try_admit(req, total)
+            if idx is None:
+                # pool backpressure: requeue and stop admitting (FIFO —
+                # later smaller requests must not starve this one)
+                self.queue.push_front(req)
+                return
+            self._totals["admitted"] += 1
+            sp = req.sampling
+            self._temp[idx] = np.float32(sp.temperature)
+            self._top_p[idx] = np.float32(sp.top_p)
+            self._top_k[idx] = np.int32(sp.top_k)
+            self._do_sample[idx] = bool(sp.do_sample)
+            tok0 = self._run_prefill(idx, req)
+            sched.slots[idx].pos = req.prompt.size
+            sched.positions[idx] = req.prompt.size
+            self._tokens[idx] = tok0
+            req.state = RequestState.DECODE
+            self._emit(req, tok0)
+            if self._is_finished(req, tok0):
+                self._finish(idx)
+
+    def _run_prefill(self, idx: int, req: Request) -> int:
+        """Chunked prefill of one admitted request: every chunk is the
+        same [1, prefill_chunk] program (prompts pad the final chunk; pad
+        writes sink into reserved-but-unread positions or the null page).
+        Returns the first generated token, sampled from the last REAL
+        prompt position's logits."""
+        req.state = RequestState.PREFILL
+        c = self.prefill_chunk
+        s0 = req.prompt.size
+        n_chunks = -(-s0 // c)
+        padded = np.zeros((n_chunks * c,), np.int64)
+        padded[:s0] = req.prompt
+        row = np.ascontiguousarray(self.scheduler.tables[idx:idx + 1])
+        tok = 0
+        sl = slice(idx, idx + 1)
+        final_prefill = (self._prefill_sample if req.sampling.do_sample
+                         else self._prefill_greedy)
+        for ci in range(n_chunks):
+            ids = padded[ci * c:(ci + 1) * c][None, :]
+            pos = np.array([ci * c], np.int32)
+            last_idx = np.int32(np.clip(s0 - 1 - ci * c, 0, c - 1))
+            # only the FINAL chunk's token survives: earlier chunks run
+            # the greedy program (their argmax is discarded), so a
+            # sampling request pays the sampling machinery — and advances
+            # the global RNG — exactly once per admission, independent of
+            # prefill_chunk sizing
+            prefill = (final_prefill if ci == n_chunks - 1
+                       else self._prefill_greedy)
+            out = prefill(
+                to_tensor(ids), to_tensor(row), to_tensor(pos),
+                to_tensor(last_idx),
+                to_tensor(self._temp[sl]), to_tensor(self._top_p[sl]),
+                to_tensor(self._top_k[sl]), to_tensor(self._do_sample[sl]))
+            self._totals["prefill_chunks"] += 1
+            tok = int(np.asarray(out.numpy())[0])
+        return tok
+
+    def _emit(self, req: Request, tok: int):
+        req.tokens.append(tok)
+        self._step_emitted += 1
+        if req.on_token is not None:
+            try:
+                req.on_token(req, tok)
+            except Exception:  # noqa: BLE001 — a callback must not kill serving
+                pass
+
+    @staticmethod
+    def _is_finished(req: Request, tok: int) -> bool:
+        if len(req.tokens) >= req.max_new_tokens:
+            return True
+        return req.eos_token_id is not None and tok == req.eos_token_id
+
+    def _finish(self, idx: int):
+        req = self.scheduler.slots[idx].request
+        self.scheduler.retire(idx)         # pages free immediately
+        self._tokens[idx] = 0
+        self._temp[idx] = 1.0
+        self._top_p[idx] = 1.0
+        self._top_k[idx] = 0
+        self._do_sample[idx] = False
+        self._totals["completed"] += 1
+        req.state = RequestState.DONE
+        req._done.set()
+
+    def _check_open(self):
+        if self._closed:
+            raise RuntimeError("ServingEngine is closed (cache released)")
+
+    # -- observability -----------------------------------------------------
+    def metrics(self) -> dict:
+        """Cumulative totals + the last step's gauges."""
+        out = dict(self._totals)
+        out.update(self._last_metrics)
+        out["queue_depth"] = self.queue.depth
+        out["active_slots"] = self.scheduler.active_slots
+        out["pages_used"] = self.allocator.used_pages
+        out["pages_capacity"] = self.allocator.capacity
+        out["occupancy"] = self.scheduler.occupancy
+        out["cache_bytes"] = self.cache.nbytes if not self._closed else 0
+        return out
+
+    @property
+    def _static_fns(self):
+        return (self._prefill_greedy, self._prefill_sample,
+                self._decode_greedy, self._decode_sample)
+
+    @property
+    def compiled_programs(self) -> int:
+        return sum(len(f.code_cache) for f in self._static_fns)
+
+    def lint_reports(self):
+        """Graph-lint reports of the compiled prefill/decode programs
+        (populated when FLAGS_graph_lint / PADDLE_TPU_GRAPH_LINT=1 was on
+        at compile time; see docs/graph_lint.md)."""
+        return [r for f in self._static_fns for r in f.lint_reports()]
+
+    def close(self):
+        """Release the page pool's HBM eagerly.  Pending/active requests
+        are NOT drained — call ``run_until_idle`` first if they matter.
+        Serializes on the step lock, so an in-flight step() finishes
+        before the pool vanishes and later steps fail the open check
+        cleanly instead of consuming deleted arrays."""
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                self.cache.release()

@@ -1,0 +1,135 @@
+"""Paged KV cache: a global pool of fixed-size KV pages + free-list
+allocator.
+
+``models/generation.py``'s ``KVCache`` preallocates ``[B, H, max_seq, D]``
+per slot — HBM scales with ``batch * max_seq`` whether or not the tokens
+exist.  The paged cache replaces that with ONE pool of
+``[num_pages, H, page_size, D]`` pages shared by every decode slot; a
+slot's context is named by its *page table* (an int32 row of pool page
+ids), so memory scales with live tokens and short requests stop subsidizing
+long ones.
+
+Page 0 is the **null page**: never handed out by the allocator, it absorbs
+the writes of inactive slots and prefill padding (their page-table entries
+all point at it) so the compiled step needs no branching — garbage lands
+in a page no read ever resolves to validly.
+
+The pool tensors are plain framework Tensors so in-place updates are
+mutation-logged — ``jit.to_static`` donates them and the compiled serving
+step aliases each write into the same HBM (docs/decoding.md donation
+contract, unchanged).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..core.dtype import to_jax_dtype
+from ..models.generation import _KVBuffers
+from ..tensor import Tensor
+
+__all__ = ["NULL_PAGE", "PagedKVCache", "BlockAllocator"]
+
+# pool page 0: reserved sink for inactive-slot / padding writes
+NULL_PAGE = 0
+
+
+class PagedKVCache(_KVBuffers):
+    """Global KV page pool.
+
+    ``stacked=False``: per-layer Tensor pairs ``k[i]/v[i]`` of shape
+    ``[num_pages, H, page_size, D]`` (the layered ``GPTModel`` path).
+    ``stacked=True``: single Tensor pair ``[L, num_pages, H, page_size, D]``
+    scanned alongside the stacked decoder parameters.
+
+    ``paged`` is the duck-type marker ``models/gpt.py`` dispatches on (a
+    paged cache routes attention through the page-table write + paged
+    decode kernel instead of the contiguous ``dynamic_update_slice``
+    path).
+    """
+
+    paged = True
+
+    def __init__(self, num_layers: int, num_pages: int, num_heads: int,
+                 page_size: int, head_dim: int, dtype: str = "bfloat16",
+                 stacked: bool = False):
+        if num_pages < 2:
+            raise ValueError(
+                f"num_pages={num_pages}: the pool needs the null page plus "
+                "at least one allocatable page")
+        jd = to_jax_dtype(dtype)
+        self.num_layers = num_layers
+        self.num_pages = num_pages
+        self.num_heads = num_heads
+        self.page_size = page_size
+        self.head_dim = head_dim
+        self.dtype = str(dtype)
+        self.stacked = stacked
+        if stacked:
+            shape = (num_layers, num_pages, num_heads, page_size, head_dim)
+            self.k = Tensor(jnp.zeros(shape, jd))
+            self.v = Tensor(jnp.zeros(shape, jd))
+        else:
+            shape = (num_pages, num_heads, page_size, head_dim)
+            self.k = [Tensor(jnp.zeros(shape, jd)) for _ in range(num_layers)]
+            self.v = [Tensor(jnp.zeros(shape, jd)) for _ in range(num_layers)]
+
+    def layer(self, i: int):
+        """(k, v) pool Tensors for layer ``i`` (layered layout only)."""
+        if self.stacked:
+            raise ValueError("layer() is for the per-layer pool layout; "
+                             "the stacked pool is scanned whole")
+        return self.k[i], self.v[i]
+
+
+class BlockAllocator:
+    """Free-list allocator over pool pages ``1..num_pages-1`` (page 0 is
+    the null page and is never handed out).
+
+    ``alloc`` is all-or-nothing: a request that cannot be fully served
+    leaves the free list untouched and returns None — the caller
+    backpressures (keeps the request queued) instead of corrupting live
+    slots with partial reservations."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (null page + 1)")
+        self.num_pages = num_pages
+        self._free: deque = deque(range(1, num_pages))
+        self._allocated: set = set()
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (the null page is not counted)."""
+        return self.num_pages - 1
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return len(self._allocated)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n pages, or None (state unchanged) when fewer than n are free."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        pages = [self._free.popleft() for _ in range(n)]
+        self._allocated.update(pages)
+        return pages
+
+    def free(self, pages: List[int]):
+        """Return pages to the pool.  Double-free and foreign ids raise —
+        silent acceptance would eventually hand one page to two slots."""
+        for p in pages:
+            if p not in self._allocated:
+                raise ValueError(
+                    f"free({p}): page is not currently allocated "
+                    "(double free or foreign id)")
+            self._allocated.discard(p)
+            self._free.append(p)

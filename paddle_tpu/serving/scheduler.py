@@ -1,0 +1,118 @@
+"""Continuous-batching slot scheduler (host-side bookkeeping).
+
+A fixed number of decode *slots* share one compiled decode step; the
+scheduler owns which request occupies which slot, each slot's page-table
+row and position, and the block-pool accounting:
+
+- **admission** reserves every page a request can ever touch up front
+  (``ceil((prompt + max_new_tokens) / page_size)``).  All-or-nothing: a
+  request the pool cannot fully serve stays queued (backpressure) — a
+  mid-decode out-of-pages condition therefore cannot exist, so live slots
+  are never corrupted or preempted by page exhaustion.
+- **retirement** frees the slot's pages back to the allocator immediately
+  (they are reusable the same step) and zeroes its table row to the null
+  page.
+
+The numpy arrays (``tables`` [num_slots, max_pages] int32, ``positions``
+[num_slots] int32) are the exact host mirrors the engine ships to the
+jitted step each call — fixed shapes, so the step never retraces as the
+request mix churns.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .paged_cache import NULL_PAGE, BlockAllocator
+
+__all__ = ["Slot", "Scheduler"]
+
+
+class Slot:
+    """One decode slot: the request occupying it + its page reservation."""
+
+    __slots__ = ("request", "pages", "pos")
+
+    def __init__(self, request, pages: List[int], pos: int = 0):
+        self.request = request
+        self.pages = pages
+        self.pos = pos       # tokens written into the slot's pages so far
+
+
+class Scheduler:
+    def __init__(self, num_slots: int, max_pages_per_slot: int,
+                 page_size: int, allocator: BlockAllocator):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        self.num_slots = num_slots
+        self.max_pages_per_slot = max_pages_per_slot
+        self.page_size = page_size
+        self.allocator = allocator
+        self.slots: List[Optional[Slot]] = [None] * num_slots
+        self.tables = np.full((num_slots, max_pages_per_slot), NULL_PAGE,
+                              np.int32)
+        self.positions = np.zeros((num_slots,), np.int32)
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for s in self.slots if s is not None)
+
+    def free_slot_indices(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots) if s is None]
+
+    def active_mask(self) -> np.ndarray:
+        return np.array([s is not None for s in self.slots], bool)
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of the allocatable pool currently reserved."""
+        cap = self.allocator.capacity
+        return self.allocator.used_pages / cap if cap else 0.0
+
+    def pages_needed(self, total_tokens: int) -> int:
+        return -(-int(total_tokens) // self.page_size)
+
+    # -- admission / retirement --------------------------------------------
+    def try_admit(self, request, total_tokens: int) -> Optional[int]:
+        """Seat ``request`` in a free slot with pages reserved for
+        ``total_tokens``; None (nothing changed) when no slot is free, the
+        request cannot fit a slot's table, or the pool lacks pages."""
+        free = self.free_slot_indices()
+        if not free:
+            return None
+        n = self.pages_needed(total_tokens)
+        if n > self.max_pages_per_slot:
+            raise ValueError(
+                f"request needs {n} pages but a slot holds at most "
+                f"{self.max_pages_per_slot} (max_context "
+                f"{self.max_pages_per_slot * self.page_size})")
+        pages = self.allocator.alloc(n)
+        if pages is None:
+            return None          # pool backpressure: stays queued
+        idx = free[0]
+        self.slots[idx] = Slot(request, pages)
+        row = np.full((self.max_pages_per_slot,), NULL_PAGE, np.int32)
+        row[:n] = pages
+        self.tables[idx] = row
+        self.positions[idx] = 0
+        return idx
+
+    def retire(self, idx: int):
+        """Release slot ``idx``: pages back to the pool NOW, table row to
+        the null page, position to 0 (the inactive-slot encoding)."""
+        slot = self.slots[idx]
+        if slot is None:
+            raise ValueError(f"retire({idx}): slot is already free")
+        self.allocator.free(slot.pages)
+        self.slots[idx] = None
+        self.tables[idx] = NULL_PAGE
+        self.positions[idx] = 0
+
+    def advance(self, idx: int, n: int = 1):
+        """Record ``n`` more tokens written into slot ``idx``."""
+        slot = self.slots[idx]
+        assert slot is not None
+        slot.pos += n
+        self.positions[idx] = slot.pos

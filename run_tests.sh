@@ -11,6 +11,11 @@
 # A checkpoint crash-injection gate runs next (tools/crash_gate.py —
 # a writer killed at any pipeline stage must never corrupt latest(); see
 # docs/checkpointing.md).  PADDLE_TPU_SKIP_CRASH_GATE=1 skips it.
+#
+# A serving gate runs third (tools/serving_bench.py --gate — continuous
+# batching must stay retrace-free, match single-shot generate(), and keep
+# block accounting sound under pool backpressure; see docs/serving.md).
+# PADDLE_TPU_SKIP_SERVING_GATE=1 skips it.
 export JAX_PLATFORMS=cpu
 export PYTHONPATH=$(python - << 'PY'
 import os
@@ -34,6 +39,15 @@ if [ -z "$PADDLE_TPU_SKIP_CRASH_GATE" ]; then
     python "$(dirname "$0")/tools/crash_gate.py" || {
         rc=$?
         echo "run_tests: crash-injection gate FAILED (rc=$rc)"
+        exit $rc
+    }
+fi
+
+if [ -z "$PADDLE_TPU_SKIP_SERVING_GATE" ]; then
+    echo "run_tests: serving gate (tools/serving_bench.py --gate)"
+    python "$(dirname "$0")/tools/serving_bench.py" --gate || {
+        rc=$?
+        echo "run_tests: serving gate FAILED (rc=$rc)"
         exit $rc
     }
 fi

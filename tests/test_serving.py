@@ -1,0 +1,709 @@
+"""Continuous-batching serving engine: paged KV cache + Pallas paged
+decode-attention + scheduler/engine (docs/serving.md).
+
+Covers the PR's acceptance criteria:
+- retrace-freedom under churn (>= 20 varying-length requests through a
+  4-slot engine, decode compiles <= 2, outputs token-for-token equal to
+  single-shot greedy generate());
+- paged-kernel parity vs the XLA gather reference (interpret= on CPU),
+  incl. length-0 slots and boundary pages, and vs decode_attention on
+  single-page layouts;
+- block accounting soundness (reuse after free, occupancy never exceeds
+  capacity, out-of-pages admission backpressures);
+plus the satellites: chunked prefill into non-contiguous pages (fp32/bf16,
+layered/stacked), LRU eviction releasing KV-cache buffers, PredictorPool
+concurrency, and the GL001/GL004-clean serving step."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import inference, serving
+from paddle_tpu.models import (
+    GPTForPretraining,
+    GPTStackedForPretraining,
+    generation,
+    gpt_tiny,
+)
+from paddle_tpu.serving import (
+    BlockAllocator,
+    SamplingParams,
+    ServingEngine,
+)
+
+
+def _tiny_cfg():
+    return gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+
+
+def _prompt(cfg, b=1, s=6, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, cfg.vocab_size, (b, s)).astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# paged kernel parity (interpreter on CPU; the real kernel on TPU)
+# ---------------------------------------------------------------------------
+
+def test_paged_attention_kernel_parity_interpret():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas_kernels import paged_attention as pa
+
+    rng = np.random.RandomState(0)
+    P, H, PS, D = 9, 2, 128, 64
+    S, MP = 3, 4
+    assert pa.paged_shape_supported(PS, D)
+    pt_tbl = jnp.array([[1, 2, 3, 4], [5, 6, 0, 0], [7, 8, 0, 0]], jnp.int32)
+    for dt in (jnp.float32, jnp.bfloat16):
+        q = jnp.array(rng.randn(S, H, D), dt)
+        kp = jnp.array(rng.randn(P, H, PS, D), dt)
+        vp = jnp.array(rng.randn(P, H, PS, D), dt)
+        # boundary lengths: inactive slot, single token, inside a page,
+        # page edge, mid-table, full table
+        for lens in ([0, 1, 127], [128, 200, 512], [256, 0, 129]):
+            ln = jnp.array(lens, jnp.int32)
+            ref = np.asarray(pa._xla_paged_reference(
+                q, kp, vp, pt_tbl, ln, 0.125), np.float32)
+            q8 = jnp.broadcast_to(q.reshape(S * H, 1, D), (S * H, 8, D))
+            out = pa._paged_pallas(q8, kp, vp, pt_tbl, ln, 0.125,
+                                   interpret=True)
+            got = np.asarray(out[:, 0, :].reshape(S, H, D), np.float32)
+            tol = 5e-6 if dt == jnp.float32 else 1e-2
+            np.testing.assert_allclose(got, ref, rtol=tol, atol=tol)
+            for i, l in enumerate(lens):
+                if l == 0:
+                    assert not got[i].any(), "length-0 slot must emit zeros"
+
+
+def test_paged_reference_matches_contiguous_single_page():
+    """A one-page-per-slot table is a contiguous cache: the paged gather
+    reference must agree with decode_attention's reference bitwise."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas_kernels import decode_attention as da
+    from paddle_tpu.ops.pallas_kernels import paged_attention as pa
+
+    rng = np.random.RandomState(1)
+    P, H, PS, D = 5, 2, 128, 64
+    S = 4
+    kp = jnp.array(rng.randn(P, H, PS, D), jnp.float32)
+    vp = jnp.array(rng.randn(P, H, PS, D), jnp.float32)
+    q = jnp.array(rng.randn(S, H, D), jnp.float32)
+    tbl = jnp.array([[1], [2], [3], [4]], jnp.int32)
+    for length in (1, 64, 127, 128):
+        got = pa._xla_paged_reference(
+            q, kp, vp, tbl, jnp.full((S,), length, jnp.int32), 0.125)
+        ref = da._xla_decode_reference(
+            q, kp[tbl[:, 0]], vp[tbl[:, 0]], jnp.int32(length), 0.125)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_paged_shape_eligibility_gate():
+    from paddle_tpu.ops.pallas_kernels.paged_attention import (
+        paged_shape_supported,
+        paged_shape_unsupported_reason,
+    )
+
+    assert paged_shape_supported(128, 64)
+    assert paged_shape_supported(256, 128)
+    assert not paged_shape_supported(64, 64)     # page under one KV block
+    assert not paged_shape_supported(200, 64)    # not a 128 multiple
+    assert not paged_shape_supported(128, 80)    # head dim not 64-multiple
+    r = paged_shape_unsupported_reason(16, 48)
+    assert r is not None and r.code == "GL002"
+    assert "paged_attention" in str(r)
+    assert paged_shape_unsupported_reason(128, 64) is None
+
+
+@pytest.mark.skipif(
+    __import__("jax").devices()[0].platform != "tpu",
+    reason="real-kernel parity needs a TPU backend (tools/tpu_smoke.py)")
+def test_paged_attention_kernel_parity_tpu():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas_kernels import paged_attention as pa
+
+    rng = np.random.RandomState(0)
+    P, H, PS, D = 17, 4, 128, 64
+    S, MP = 4, 4
+    kp = jnp.array(rng.randn(P, H, PS, D), jnp.bfloat16)
+    vp = jnp.array(rng.randn(P, H, PS, D), jnp.bfloat16)
+    q = jnp.array(rng.randn(S, H, D), jnp.bfloat16)
+    tbl = jnp.array(rng.permutation(P - 1)[:S * MP].reshape(S, MP) + 1,
+                    jnp.int32)
+    lens = jnp.array([0, 1, 200, 512], jnp.int32)
+    got = np.asarray(pa.paged_attention(q, kp, vp, tbl, lens), np.float32)
+    ref = np.asarray(pa._xla_paged_reference(q, kp, vp, tbl, lens, 0.125),
+                     np.float32)
+    np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
+
+
+# ---------------------------------------------------------------------------
+# block-pool accounting (property-style)
+# ---------------------------------------------------------------------------
+
+def test_block_allocator_invariants():
+    a = BlockAllocator(9)           # null page + 8 allocatable
+    assert a.capacity == 8 and a.free_pages == 8 and a.used_pages == 0
+    p1 = a.alloc(3)
+    p2 = a.alloc(5)
+    assert a.free_pages == 0
+    assert 0 not in p1 + p2          # null page never handed out
+    assert a.alloc(1) is None        # exhausted: None, state unchanged
+    assert a.used_pages == 8
+    a.free(p1)
+    assert a.free_pages == 3
+    with pytest.raises(ValueError, match="not currently allocated"):
+        a.free(p1[:1])               # double free must raise
+    with pytest.raises(ValueError):
+        a.free([0])                  # the null page was never allocated
+    p3 = a.alloc(3)
+    assert sorted(p3) == sorted(p1)  # freed pages are reused
+
+
+def test_block_accounting_random_churn():
+    """Random alloc/free churn: occupancy never exceeds capacity, a
+    too-big request leaves state untouched, every page freed comes back."""
+    rng = np.random.RandomState(7)
+    a = BlockAllocator(17)
+    live = []
+    for _ in range(300):
+        if live and rng.rand() < 0.45:
+            a.free(live.pop(rng.randint(len(live))))
+        else:
+            n = int(rng.randint(1, 5))
+            before = (a.free_pages, a.used_pages)
+            got = a.alloc(n)
+            if got is None:
+                assert (a.free_pages, a.used_pages) == before
+            else:
+                live.append(got)
+        assert a.used_pages + a.free_pages == a.capacity
+        assert a.used_pages <= a.capacity
+    for pages in live:
+        a.free(pages)
+    assert a.free_pages == a.capacity
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill into non-contiguous pages (satellite): parity vs the
+# contiguous-cache path and vs the full forward, fp32+bf16, both layouts
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("model_cls", [GPTForPretraining,
+                                       GPTStackedForPretraining])
+@pytest.mark.parametrize("cache_dtype,atol", [("float32", 5e-5),
+                                              ("bfloat16", 0.08)])
+def test_chunked_prefill_into_pages_matches_contiguous(model_cls,
+                                                       cache_dtype, atol):
+    pt.seed(13)
+    cfg = _tiny_cfg()
+    m = model_cls(cfg)
+    m.eval()
+    ids_np = _prompt(cfg, s=12, seed=3)
+    ids = pt.to_tensor(ids_np, dtype="int64")
+    full = m(ids).numpy()
+
+    # contiguous-cache chunked prefill (the PR-2 path)
+    ckv = m.new_kv_cache(1, 64, dtype=cache_dtype)
+    c_pre = m(ids[:, :4], kv_cache=ckv, cache_index=0).numpy()
+    c_mid = m(ids[:, 4:9], kv_cache=ckv, cache_index=4).numpy()
+    c_tail = m(ids[:, 9:12], kv_cache=ckv, cache_index=9).numpy()
+
+    # paged: deliberately OUT-OF-ORDER page ids (non-contiguous pool walk)
+    pcache = m.new_paged_kv_cache(10, 16, dtype=cache_dtype)
+    tbl = pt.to_tensor(np.array([[7, 2, 9, 4]], np.int32))
+
+    def step(lo, hi):
+        pos = pt.to_tensor(np.array([lo], np.int32))
+        return m._paged_lm_logits(ids[:, lo:hi], pcache, tbl, pos).numpy()
+
+    p_pre, p_mid, p_tail = step(0, 4), step(4, 9), step(9, 12)
+    # paged vs contiguous agree far tighter than either is to the full
+    # forward — except the FIRST chunk under bf16, where the contiguous
+    # pos==0 fast path attends the fresh (unrounded) K/V while the paged
+    # path reads the bf16-rounded pool: one bf16 rounding apart
+    ctg_atol = 5e-5 if cache_dtype == "float32" else 5e-3
+    for got, ctg, lo, hi in ((p_pre, c_pre, 0, 4), (p_mid, c_mid, 4, 9),
+                             (p_tail, c_tail, 9, 12)):
+        np.testing.assert_allclose(got, full[:, lo:hi], rtol=1e-2, atol=atol)
+        np.testing.assert_allclose(got, ctg, rtol=1e-3, atol=ctg_atol)
+
+    # and single-token decode over the paged chunks stays consistent
+    dec = m._paged_lm_logits(
+        pt.to_tensor(ids_np[:, :1], dtype="int64"), pcache, tbl,
+        pt.to_tensor(np.array([12], np.int32))).numpy()
+    assert np.isfinite(dec).all()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance churn test: retrace-free continuous batching, outputs
+# token-for-token equal to single-shot greedy generate()
+# ---------------------------------------------------------------------------
+
+def test_continuous_batching_churn_matches_generate():
+    pt.seed(0)
+    cfg = _tiny_cfg()
+    m = GPTForPretraining(cfg)
+    m.eval()
+    rng = np.random.RandomState(1)
+    lengths = [3, 17, 5, 9, 14, 4, 19, 7, 11, 6] * 2   # 20 varying lengths
+    prompts = [rng.randint(0, cfg.vocab_size, (s,)) for s in lengths]
+    new_toks = [int(rng.randint(2, 9)) for _ in prompts]
+
+    refs = []
+    for p, n in zip(prompts, new_toks):
+        out = m.generate(pt.to_tensor(p[None, :], dtype="int64"),
+                         max_new_tokens=n, max_seq_len=64,
+                         cache_dtype="float32")
+        refs.append(np.asarray(out.numpy())[0])
+
+    serving.reset_serve_trace_counts()
+    eng = ServingEngine(m, num_slots=4, page_size=16, max_context=64,
+                        cache_dtype="float32", prefill_chunk=8)
+    reqs, it, submitted = [], iter(zip(prompts, new_toks)), 0
+    while submitted < len(prompts) or eng.queue.depth \
+            or eng.scheduler.active_slots:
+        # arrivals interleave with completions: 2 new requests per step
+        for _ in range(2):
+            try:
+                p, n = next(it)
+            except StopIteration:
+                break
+            reqs.append(eng.submit(p, n))
+            submitted += 1
+        eng.step()
+
+    tc = serving.serve_trace_counts()
+    # step bodies run ONLY while tracing (scout + jit trace = 2 per
+    # compiled program): <= 2 means the decode step compiled at most once
+    assert tc["decode"] <= 2, tc
+    assert tc["prefill"] <= 2, tc
+    assert eng.compiled_programs == 2
+
+    for r, ref in zip(reqs, refs):
+        assert r.finished
+        got = r.output_ids()
+        assert np.array_equal(got, ref), (
+            f"request {r.id}: {got[len(r.prompt):]} vs "
+            f"{ref[len(r.prompt):]}")
+    # everything retired: every page back in the pool
+    assert eng.allocator.used_pages == 0
+    assert eng.scheduler.active_slots == 0
+    mets = eng.metrics()
+    assert mets["completed"] == len(prompts)
+    assert mets["tokens"] == sum(new_toks)
+
+
+def test_continuous_batching_stacked_decoder():
+    """The stacked [L, P, H, ps, D] pool path: same greedy parity."""
+    pt.seed(3)
+    cfg = _tiny_cfg()
+    m = GPTStackedForPretraining(cfg)
+    m.eval()
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, cfg.vocab_size, (s,)) for s in (4, 11, 7, 16)]
+    refs = [np.asarray(m.generate(pt.to_tensor(p[None, :], dtype="int64"),
+                                  max_new_tokens=4, max_seq_len=64,
+                                  cache_dtype="float32").numpy())[0]
+            for p in prompts]
+    eng = ServingEngine(m, num_slots=2, page_size=16, max_context=64,
+                        cache_dtype="float32")
+    outs = eng.generate_batch(prompts, max_new_tokens=4)
+    for got, ref in zip(outs, refs):
+        assert np.array_equal(got, ref)
+
+
+def test_out_of_pages_admission_backpressures():
+    """A pool too small for every request at once must queue the overflow
+    (never corrupt live slots) and still finish everything as pages free."""
+    pt.seed(5)
+    cfg = _tiny_cfg()
+    m = GPTForPretraining(cfg)
+    m.eval()
+    rng = np.random.RandomState(3)
+    # 4 slots, but only 6 allocatable pages and every request reserves 2
+    # (20 prompt + 3 new = 23 tokens, 16/page): at most 3 seated at once —
+    # the pool, not the slot count, is the binding constraint
+    eng = ServingEngine(m, num_slots=4, page_size=16, max_context=64,
+                        num_pages=7, cache_dtype="float32")
+    prompts = [rng.randint(0, cfg.vocab_size, (20,)) for _ in range(6)]
+    refs = [np.asarray(m.generate(pt.to_tensor(p[None, :], dtype="int64"),
+                                  max_new_tokens=3, max_seq_len=64,
+                                  cache_dtype="float32").numpy())[0]
+            for p in prompts]
+    reqs = [eng.submit(p, 3) for p in prompts]
+    saw_backpressure = False
+    peak_used = 0
+    steps = 0
+    while eng.queue.depth or eng.scheduler.active_slots:
+        met = eng.step()
+        steps += 1
+        peak_used = max(peak_used, met["pages_used"])
+        assert met["pages_used"] <= eng.allocator.capacity
+        if met["queue_depth"] > 0 and met["active_slots"] > 0:
+            saw_backpressure = True
+        assert steps < 200, "engine made no progress"
+    assert saw_backpressure, "pool never backpressured despite 6x2 > 6 pages"
+    assert peak_used == 6                     # the pool really saturated
+    for r, ref in zip(reqs, refs):
+        assert np.array_equal(r.output_ids(), ref)
+    assert eng.allocator.used_pages == 0      # blocks freed on completion
+    # freed pages get REUSED: total admitted pages > capacity
+    assert eng.metrics()["completed"] == 6
+
+
+def test_boundary_length_requests():
+    """prompt + max_new == max_context (prefill padding reaches the table
+    edge) and a prefill-only request (max_new=1, never decodes) both match
+    generate()."""
+    pt.seed(0)
+    cfg = _tiny_cfg()
+    m = GPTForPretraining(cfg)
+    m.eval()
+    rng = np.random.RandomState(0)
+    eng = ServingEngine(m, num_slots=2, page_size=16, max_context=64,
+                        cache_dtype="float32")
+    for s0, n in ((62, 2), (1, 1), (16, 4)):   # incl. exact-page prompt
+        p = rng.randint(0, cfg.vocab_size, (s0,))
+        ref = np.asarray(m.generate(pt.to_tensor(p[None, :], dtype="int64"),
+                                    max_new_tokens=n, max_seq_len=64,
+                                    cache_dtype="float32").numpy())[0]
+        r = eng.submit(p, n)
+        eng.run_until_idle()
+        assert np.array_equal(r.output_ids(), ref), (s0, n)
+    assert eng.allocator.used_pages == 0
+
+
+def test_requests_too_big_rejected_at_submit():
+    cfg = _tiny_cfg()
+    m = GPTForPretraining(cfg)
+    m.eval()
+    eng = ServingEngine(m, num_slots=2, page_size=16, max_context=64,
+                        num_pages=4, cache_dtype="float32")
+    with pytest.raises(ValueError, match="exceeds max_context"):
+        eng.submit(np.zeros(60, np.int64), 10)
+    with pytest.raises(ValueError, match="pool holds only"):
+        eng.submit(np.zeros(50, np.int64), 14)    # 4 pages > capacity 3
+    with pytest.raises(ValueError, match="at least one token"):
+        eng.submit(np.zeros(0, np.int64), 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(np.zeros(4, np.int64), 0)
+
+
+def test_eos_retires_slot_and_frees_pages():
+    pt.seed(9)
+    cfg = _tiny_cfg()
+    m = GPTForPretraining(cfg)
+    m.eval()
+    p = _prompt(cfg, s=6, seed=4)[0]
+    base = np.asarray(m.generate(pt.to_tensor(p[None, :], dtype="int64"),
+                                 max_new_tokens=6, max_seq_len=64,
+                                 cache_dtype="float32").numpy())[0]
+    eos = int(base[6 + 2])                    # greedy token at step 2
+    eng = ServingEngine(m, num_slots=2, page_size=16, max_context=64,
+                        cache_dtype="float32")
+    req = eng.submit(p, 6, eos_token_id=eos)
+    eng.run_until_idle()
+    assert req.finished
+    assert req.tokens[-1] == eos
+    assert len(req.tokens) <= 6
+    assert eng.allocator.used_pages == 0
+
+
+def test_streaming_token_callbacks_in_order():
+    pt.seed(11)
+    cfg = _tiny_cfg()
+    m = GPTForPretraining(cfg)
+    m.eval()
+    seen = []
+    eng = ServingEngine(m, num_slots=2, page_size=16, max_context=64,
+                        cache_dtype="float32")
+    req = eng.submit(_prompt(cfg, s=5, seed=6)[0], 5,
+                     on_token=lambda r, t: seen.append((r.id, t)))
+    eng.run_until_idle()
+    assert [t for _, t in seen] == req.tokens
+    assert all(rid == req.id for rid, _ in seen)
+    assert req.state == serving.RequestState.DONE
+
+
+def test_per_request_sampling_mix_and_reproducibility():
+    """Greedy and sampling requests share ONE compiled step; greedy rows
+    still match single-shot generate(); sampling is in-vocab and
+    reproducible under the same global seed."""
+    cfg = _tiny_cfg()
+    pt.seed(0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    pg = _prompt(cfg, s=7, seed=8)[0]
+    ps = _prompt(cfg, s=5, seed=9)[0]
+    ref = np.asarray(m.generate(pt.to_tensor(pg[None, :], dtype="int64"),
+                                max_new_tokens=5, max_seq_len=64,
+                                cache_dtype="float32").numpy())[0]
+
+    def run():
+        pt.seed(1234)
+        eng = ServingEngine(m, num_slots=2, page_size=16, max_context=64,
+                            cache_dtype="float32")
+        rg = eng.submit(pg, 5)                    # greedy
+        rs = eng.submit(ps, 6, sampling=SamplingParams(
+            do_sample=True, temperature=0.8, top_k=50, top_p=0.9))
+        eng.run_until_idle()
+        return rg.output_ids(), rs.output_ids()
+
+    g1, s1 = run()
+    g2, s2 = run()
+    assert np.array_equal(g1, ref)                # greedy unaffected by mix
+    assert np.array_equal(g1, g2)
+    assert np.array_equal(s1, s2), "sampling must be seed-reproducible"
+    assert (s1 >= 0).all() and (s1 < cfg.vocab_size).all()
+
+
+def test_engine_close_releases_pool_and_rejects_use():
+    cfg = _tiny_cfg()
+    m = GPTForPretraining(cfg)
+    m.eval()
+    eng = ServingEngine(m, num_slots=2, page_size=16, max_context=32,
+                        cache_dtype="float32")
+    ks = eng.cache.k if isinstance(eng.cache.k, list) else [eng.cache.k]
+    eng.close()
+    for t in ks:
+        assert t._value.is_deleted()
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.submit(np.zeros(4, np.int64), 2)
+    with pytest.raises(RuntimeError, match="closed"):
+        eng.step()
+
+
+# ---------------------------------------------------------------------------
+# graph-lint regression: the paged decode step stays GL001/GL004-clean
+# ---------------------------------------------------------------------------
+
+def test_serving_step_bf16_stays_gl001_clean():
+    """A pure-bf16 model's paged decode step must not silently promote
+    its projections to fp32 (same regression class PR 3 fixed for the
+    contiguous decode path)."""
+    from paddle_tpu import analysis
+
+    analysis.clear_reports()
+    pt.set_flags({"FLAGS_graph_lint": True})
+    try:
+        pt.seed(0)
+        cfg = _tiny_cfg()
+        m = GPTStackedForPretraining(cfg)
+        pt.amp.decorate(m, level="O2", dtype="bfloat16")
+        m.eval()
+        eng = ServingEngine(m, num_slots=2, page_size=16, max_context=32,
+                            cache_dtype="bfloat16")
+        eng.submit(_prompt(cfg, s=5, seed=1)[0], 3)
+        eng.run_until_idle()
+        reps = eng.lint_reports()
+        assert reps, "FLAGS_graph_lint on but no serving lint reports"
+        bad = [f for r in reps for f in r.findings if f.code == "GL001"]
+        assert bad == [], "\n".join(f.render() for f in bad)
+    finally:
+        pt.set_flags({"FLAGS_graph_lint": False})
+        analysis.clear_reports()
+
+
+def test_serving_step_donates_pool_gl004_clean():
+    """The page pool is mutated captured state: jit.to_static must donate
+    it (no GL004 double-buffer finding on pool-sized inputs)."""
+    from paddle_tpu import analysis
+
+    analysis.clear_reports()
+    pt.set_flags({"FLAGS_graph_lint": True})
+    try:
+        pt.seed(0)
+        cfg = _tiny_cfg()
+        m = GPTForPretraining(cfg)
+        m.eval()
+        # 300 pages x 4 heads x 16 x 16 fp32 = ~1.2 MiB per pool tensor:
+        # big enough for the linter's donation_min_bytes candidate floor
+        eng = ServingEngine(m, num_slots=2, page_size=16, max_context=32,
+                            num_pages=300, cache_dtype="float32")
+        eng.submit(_prompt(cfg, s=5, seed=1)[0], 3)
+        eng.run_until_idle()
+        reps = eng.lint_reports()
+        assert reps
+        bad = [f for r in reps for f in r.findings if f.code == "GL004"]
+        assert bad == [], "\n".join(f.render() for f in bad)
+    finally:
+        pt.set_flags({"FLAGS_graph_lint": False})
+        analysis.clear_reports()
+
+
+# ---------------------------------------------------------------------------
+# satellite: LRU eviction / clear_decode_cache release KV-cache HBM
+# ---------------------------------------------------------------------------
+
+def test_lru_eviction_releases_cache_buffers():
+    pt.seed(14)
+    cfg = _tiny_cfg()
+    m = GPTForPretraining(cfg)
+    m.eval()
+    ids = pt.to_tensor(_prompt(cfg, b=2, s=6), dtype="int64")
+    m.generate(ids, max_new_tokens=2, max_seq_len=32, cache_dtype="float32")
+    first = m.__dict__["_decode_engines"][(2, 32, "float32", False, 0,
+                                           False)]
+    held = first.cache.k[0]._value    # buffer to be evicted, ref held here
+    for b in (48, 64, 80, 96):        # four more shapes: evicts the first
+        m.generate(ids, max_new_tokens=2, max_seq_len=b,
+                   cache_dtype="float32")
+    engines = m.__dict__["_decode_engines"]
+    assert len(engines) == generation._MAX_ENGINES
+    assert (2, 32, "float32", False, 0, False) not in engines
+    assert held.is_deleted(), \
+        "evicted engine's KV buffers must be deleted eagerly, not GC'd"
+    # clear_decode_cache releases every remaining engine's buffers
+    remaining = [e.cache.k[0]._value for e in engines.values()]
+    m.clear_decode_cache()
+    assert "_decode_engines" not in m.__dict__
+    assert all(v.is_deleted() for v in remaining)
+
+
+def test_generate_retries_on_engine_released_race():
+    """A caller that looked an engine up just before eviction deleted its
+    buffers must fetch a fresh engine (the `released` flag under the
+    engine lock), not dispatch into deleted arrays."""
+    pt.seed(7)
+    cfg = _tiny_cfg()
+    m = GPTForPretraining(cfg)
+    m.eval()
+    ids = pt.to_tensor(_prompt(cfg, b=2, s=6), dtype="int64")
+    ref = m.generate(ids, max_new_tokens=3, max_seq_len=32,
+                     cache_dtype="float32").numpy()
+    # simulate the evictor winning the race: release the cached engine
+    # (buffers deleted, flag set) while it is still in the registry
+    eng = m.__dict__["_decode_engines"][(2, 32, "float32", False, 0, False)]
+    eng.release()
+    assert eng.released and eng.cache.k[0]._value.is_deleted()
+    out = m.generate(ids, max_new_tokens=3, max_seq_len=32,
+                     cache_dtype="float32").numpy()
+    assert np.array_equal(out, ref)
+
+
+def test_kv_cache_release_idempotent():
+    cfg = _tiny_cfg()
+    m = GPTForPretraining(cfg)
+    cache = m.new_kv_cache(1, 32, dtype="float32")
+    cache.release()
+    cache.release()                   # second release must not raise
+    assert cache.k[0]._value.is_deleted()
+
+
+# ---------------------------------------------------------------------------
+# satellite: PredictorPool concurrency
+# ---------------------------------------------------------------------------
+
+def _decode_pool(m, size):
+    config = inference.Config().set_causal_lm_model(m)
+    config.enable_causal_lm_decode(max_new_tokens=4, max_seq_len=64,
+                                   cache_dtype="float32")
+    return inference.PredictorPool(config, size)
+
+
+def test_predictor_pool_concurrent_acquire_run_release():
+    """Concurrent acquire/run/release through the pool: every thread gets
+    an exclusive predictor, decode outputs stay correct (the shared decode
+    engine serializes on its cache lock), nothing deadlocks."""
+    pt.seed(2)
+    cfg = _tiny_cfg()
+    m = GPTForPretraining(cfg)
+    m.eval()
+    ids = _prompt(cfg, b=2, s=6)
+    ref = m.generate(pt.to_tensor(ids, dtype="int64"), max_new_tokens=4,
+                     max_seq_len=64, cache_dtype="float32").numpy()
+    pool = _decode_pool(m, 3)
+    in_flight, in_flight_lock, errors, results = set(), threading.Lock(), [], []
+
+    def work():
+        try:
+            for _ in range(3):
+                p = pool.acquire(timeout=30)
+                with in_flight_lock:
+                    assert id(p) not in in_flight, "predictor handed twice"
+                    in_flight.add(id(p))
+                try:
+                    out = p.run([pt.to_tensor(ids, dtype="int64")])
+                    results.append(np.asarray(out[0].numpy()))
+                finally:
+                    with in_flight_lock:
+                        in_flight.discard(id(p))
+                    pool.release(p)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=work) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    assert len(results) == 18
+    for out in results:
+        assert np.array_equal(out, ref)
+
+
+def test_predictor_pool_release_guards():
+    pt.seed(2)
+    m = GPTForPretraining(_tiny_cfg())
+    m.eval()
+    pool = _decode_pool(m, 2)
+    p = pool.acquire()
+    pool.release(p)
+    with pytest.raises(ValueError, match="not checked out"):
+        pool.release(p)               # double release
+    with pytest.raises(TimeoutError):
+        a = pool.acquire()
+        b = pool.acquire()
+        try:
+            pool.acquire(timeout=0.05)
+        finally:
+            pool.release(a)
+            pool.release(b)
+    with pool.predictor() as q:       # context manager round-trip
+        assert q is not None
+
+
+# ---------------------------------------------------------------------------
+# inference.Config serving mode
+# ---------------------------------------------------------------------------
+
+def test_predictor_serving_mode_matches_generate():
+    pt.seed(2)
+    cfg = _tiny_cfg()
+    m = GPTForPretraining(cfg)
+    m.eval()
+    ids = _prompt(cfg, b=3, s=6)
+    ref = m.generate(pt.to_tensor(ids, dtype="int64"), max_new_tokens=5,
+                     max_seq_len=64, cache_dtype="float32").numpy()
+    config = inference.Config().set_causal_lm_model(m)
+    config.enable_serving_mode(max_new_tokens=5, num_slots=4, page_size=16,
+                               max_context=64, cache_dtype="float32")
+    assert "serving_mode" in config.summary()
+    predictor = inference.create_predictor(config)
+    h = predictor.get_input_handle("x0")
+    h.copy_from_cpu(ids)
+    predictor.run()
+    out = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+    assert np.array_equal(out, ref)
+
+
+def test_serving_mode_validation():
+    m = GPTForPretraining(_tiny_cfg())
+    config = inference.Config(str("/nonexistent"))
+    config.enable_serving_mode(max_new_tokens=2)
+    with pytest.raises(RuntimeError, match="live model"):
+        inference.create_predictor(config)
+    config2 = inference.Config().set_causal_lm_model(m)
+    config2.enable_serving_mode(max_new_tokens=2)
+    with pytest.raises(RuntimeError, match="mutually exclusive"):
+        config2.enable_causal_lm_decode(max_new_tokens=2)
+    config3 = inference.Config().set_causal_lm_model(m)
+    config3.enable_causal_lm_decode(max_new_tokens=2)
+    with pytest.raises(RuntimeError, match="mutually exclusive"):
+        config3.enable_serving_mode(max_new_tokens=2)

@@ -1,0 +1,208 @@
+#!/usr/bin/env python
+"""Serving-engine bench + CI gate: continuous batching under offered load.
+
+Sweep mode (default): drives the ServingEngine at increasing offered load
+(requests injected per scheduler step) and prints ONE JSON line per level:
+
+  {"metric": "serving_sweep", "offered_load": ..., "tokens_per_sec": ...,
+   "mean_occupancy": ..., "mean_queue_depth": ..., "completed": ...,
+   "steps": ...}
+
+tokens/sec should rise with load until the slots saturate, then flatten
+while queue depth grows — the continuous-batching signature.  Runs on the
+TPU ladder model when a TPU is present, and on a CPU-sized gpt_tiny
+otherwise (the numbers are then about the SCHEDULER, not the chip).
+
+Gate mode (--gate, wired into run_tests.sh; PADDLE_TPU_SKIP_SERVING_GATE=1
+skips): a fast correctness gate in the crash/lint-gate mold —
+
+  - >= 12 varying-length greedy requests through a 3-slot engine with an
+    undersized page pool must match single-shot generate() token-for-token;
+  - the decode step must compile at most once (trace counters <= 2);
+  - block accounting must close: peak pages <= capacity, 0 in use at the
+    end, backpressure observed (the pool is sized to force it).
+
+Exit codes: 0 ok, 1 gate/bench failure.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import numpy as np  # noqa: E402
+
+
+def _build(on_tpu: bool):
+    import paddle_tpu as pt
+    from paddle_tpu.models import (
+        GPTStackedForPretraining, gpt_small, gpt_tiny,
+    )
+
+    pt.seed(0)
+    if on_tpu:
+        cfg = gpt_small(hidden_dropout=0.0, attention_dropout=0.0,
+                        use_flash_attention=True)
+        model = GPTStackedForPretraining(cfg)
+        pt.amp.decorate(model, level="O2", dtype="bfloat16")
+        serving_kw = dict(num_slots=8, page_size=128, max_context=512,
+                          cache_dtype="bfloat16")
+        prompt_lens, max_new = (64, 200, 120, 380), 32
+    else:
+        cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+        model = GPTStackedForPretraining(cfg)
+        serving_kw = dict(num_slots=4, page_size=16, max_context=64,
+                          cache_dtype="float32")
+        prompt_lens, max_new = (6, 14, 9, 20), 6
+    model.eval()
+    return model, cfg, serving_kw, prompt_lens, max_new
+
+
+def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24) -> int:
+    import jax
+
+    from paddle_tpu.serving import ServingEngine
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    model, cfg, kw, prompt_lens, max_new = _build(on_tpu)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           (prompt_lens[i % len(prompt_lens)],))
+               for i in range(n_requests)]
+    for load in loads:
+        eng = ServingEngine(model, **kw)
+        # warmup: compile prefill + decode outside the timed region
+        eng.submit(prompts[0], 2)
+        eng.run_until_idle()
+        occ, qd, steps, injected = [], [], 0, 0.0
+        t0 = time.perf_counter()
+        reqs = []
+        while True:
+            # inject `load` requests per step (fractional loads carry over)
+            injected += load
+            while len(reqs) < min(int(injected), n_requests):
+                reqs.append(eng.submit(prompts[len(reqs)], max_new))
+            met = eng.step()
+            steps += 1
+            occ.append(met["occupancy"])
+            qd.append(met["queue_depth"])
+            drained = (len(reqs) >= n_requests and not eng.queue.depth
+                       and not eng.scheduler.active_slots)
+            if drained or steps > 100000:
+                break
+        dt = time.perf_counter() - t0
+        done_tokens = sum(len(r.tokens) for r in reqs)
+        print(json.dumps({
+            "metric": "serving_sweep",
+            "offered_load": load,
+            "tokens_per_sec": round(done_tokens / dt, 1),
+            "mean_occupancy": round(float(np.mean(occ)), 4),
+            "mean_queue_depth": round(float(np.mean(qd)), 2),
+            "completed": sum(r.finished for r in reqs),
+            "steps": steps,
+            "platform": "tpu" if on_tpu else "cpu",
+        }))
+        sys.stdout.flush()
+        eng.close()
+    return 0
+
+
+def gate() -> int:
+    import paddle_tpu as pt
+    from paddle_tpu import serving
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+    from paddle_tpu.serving import ServingEngine
+
+    pt.seed(0)
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    rng = np.random.RandomState(1)
+    lengths = [5, 18, 9, 26, 13, 7, 21, 11, 16, 6, 24, 8]
+    prompts = [rng.randint(0, cfg.vocab_size, (s,)) for s in lengths]
+    new_toks = [int(rng.randint(2, 7)) for _ in prompts]
+
+    refs = []
+    for p, n in zip(prompts, new_toks):
+        out = m.generate(pt.to_tensor(p[None, :], dtype="int64"),
+                         max_new_tokens=n, max_seq_len=64,
+                         cache_dtype="float32")
+        refs.append(np.asarray(out.numpy())[0])
+
+    serving.reset_serve_trace_counts()
+    # 3 slots but only 5 allocatable pages (2 pages per long request):
+    # the gate exercises pool backpressure, not just slot contention
+    eng = ServingEngine(m, num_slots=3, page_size=16, max_context=64,
+                        num_pages=6, cache_dtype="float32")
+    reqs, it, submitted = [], iter(zip(prompts, new_toks)), 0
+    peak = 0
+    saw_backpressure = False
+    steps = 0
+    while submitted < len(prompts) or eng.queue.depth \
+            or eng.scheduler.active_slots:
+        for _ in range(2):
+            try:
+                p, n = next(it)
+            except StopIteration:
+                break
+            reqs.append(eng.submit(p, n))
+            submitted += 1
+        met = eng.step()
+        steps += 1
+        peak = max(peak, met["pages_used"])
+        if met["pages_used"] > eng.allocator.capacity:
+            print(f"serving_gate: FAIL pool over capacity "
+                  f"({met['pages_used']} > {eng.allocator.capacity})")
+            return 1
+        if met["queue_depth"] > 0 and met["active_slots"] > 0:
+            saw_backpressure = True
+        if steps > 500:
+            print("serving_gate: FAIL engine made no progress")
+            return 1
+
+    tc = serving.serve_trace_counts()
+    if tc["decode"] > 2 or tc["prefill"] > 2:
+        print(f"serving_gate: FAIL retraced under churn: {tc}")
+        return 1
+    bad = 0
+    for r, ref in zip(reqs, refs):
+        if not (r.finished and np.array_equal(r.output_ids(), ref)):
+            bad += 1
+    if bad:
+        print(f"serving_gate: FAIL {bad}/{len(reqs)} requests diverged "
+              "from single-shot generate()")
+        return 1
+    if eng.allocator.used_pages != 0:
+        print(f"serving_gate: FAIL {eng.allocator.used_pages} pages leaked")
+        return 1
+    if not saw_backpressure:
+        print("serving_gate: FAIL pool never backpressured (gate sizing "
+              "is supposed to force it)")
+        return 1
+    print(f"serving_gate: OK ({len(reqs)} requests, {steps} steps, "
+          f"traces={tc}, peak_pages={peak}/{eng.allocator.capacity})")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--gate", action="store_true",
+                    help="fast CI correctness gate (run_tests.sh)")
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--loads", type=str, default="0.5,1,2,4",
+                    help="comma-separated offered loads (requests/step)")
+    args = ap.parse_args()
+    if args.gate:
+        return gate()
+    return sweep(tuple(float(x) for x in args.loads.split(",")),
+                 args.requests)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,6 +82,36 @@ def main() -> int:
             err = float(jnp.abs(got - want).max())
             assert err < 0.05, f"len={length} err={err}"
 
+    # -- paged decode attention (continuous-batching serving) vs gather
+    # reference: shuffled page tables, boundary lengths incl. the
+    # length-0 inactive-slot case ---------------------------------------
+    def paged_attention():
+        from paddle_tpu.ops.pallas_kernels import paged_attention as pa
+        P, H, PS, D = 17, 4, 128, 64
+        S, MP = 4, 4
+        kp = jnp.array(rng.randn(P, H, PS, D), jnp.bfloat16)
+        vp = jnp.array(rng.randn(P, H, PS, D), jnp.bfloat16)
+        q = jnp.array(rng.randn(S, H, D), jnp.bfloat16)
+        # page-table edge cases: out-of-order pool pages, trailing null
+        # entries past each slot's length
+        tbl = jnp.array(rng.permutation(P - 1)[:S * MP].reshape(S, MP) + 1,
+                        jnp.int32)
+        assert pa.paged_shape_supported(PS, D)
+        for lens in ((0, 1, 127, 512), (128, 200, 256, 384)):
+            ln = jnp.array(lens, jnp.int32)
+            got = pa.paged_attention(q, kp, vp, tbl, ln).astype(jnp.float32)
+            want = pa._xla_paged_reference(
+                q, kp, vp, tbl, ln, 0.125).astype(jnp.float32)
+            err = float(jnp.abs(got - want).max())
+            assert err < 0.05, f"lens={lens} err={err}"
+            for i, l in enumerate(lens):
+                if l == 0:
+                    assert float(jnp.abs(got[i]).max()) == 0.0, \
+                        "length-0 slot must emit zeros"
+        # the eligibility gate reports GL002-coded reasons on this host
+        r = pa.paged_shape_unsupported_reason(100, 48)
+        assert r is not None and r.code == "GL002"
+
     # -- fused AdamW slab kernel vs composed update ----------------------
     def fused_adamw():
         from paddle_tpu.ops.pallas_kernels.fused_adamw import fused_adamw_update
@@ -198,6 +228,7 @@ def main() -> int:
 
     check("flash_attention", flash)
     check("decode_attention", decode_attention)
+    check("paged_attention", paged_attention)
     check("fused_adamw", fused_adamw)
     check("rms_norm", rms_norm)
     check("graph_lint", graph_lint)
