@@ -184,6 +184,79 @@ def _probe_backend(timeout=240.0):
     return None
 
 
+# -- probe verdict cache (round-4/5 postmortem: r04/r05 burned 3x480s of
+# probe timeouts per invocation, then SILENTLY fell back to CPU — the
+# trajectory was blind for two rounds).  A definitive verdict is cached on
+# disk for PADDLE_TPU_PROBE_CACHE_TTL seconds (default 30 min), so every
+# bench/tool invocation in the same round pays the probe at most once. ----
+
+def _probe_cache_path() -> str:
+    return os.environ.get("PADDLE_TPU_PROBE_CACHE",
+                          "/tmp/paddle_tpu_probe_verdict.json")
+
+
+def _probe_cache_ttl() -> float:
+    return float(os.environ.get("PADDLE_TPU_PROBE_CACHE_TTL", "1800"))
+
+
+def _read_probe_cache():
+    """Cached (platform, age_s) when fresh, else None."""
+    try:
+        with open(_probe_cache_path()) as f:
+            d = json.load(f)
+        age = time.time() - float(d["time"])
+        if 0 <= age <= _probe_cache_ttl():
+            return str(d["platform"]), age
+    except Exception:  # noqa: BLE001 — a bad cache is just a cache miss
+        pass
+    return None
+
+
+def _write_probe_cache(platform: str):
+    try:
+        path = _probe_cache_path()
+        with open(path + ".tmp", "w") as f:
+            json.dump({"platform": platform, "time": time.time()}, f)
+        os.replace(path + ".tmp", path)
+    except Exception:  # noqa: BLE001 — best-effort
+        pass
+
+
+def _probe_backend_adaptive():
+    """Probe with ADAPTIVE timeout + short backoff instead of the old
+    3 x 480s ladder: attempts start at PADDLE_TPU_BENCH_PROBE_TIMEOUT (or
+    BENCH_PROBE_TIMEOUT, default 120s) and double per retry up to 480s,
+    with 15s pauses — worst case ~14.5 min instead of ~25, and the common
+    flaky-init case resolves in the first short attempt.  A definitive
+    verdict (any platform string) is cached for the round.
+
+    Returns (platform_or_None, source) where source is 'cache' or
+    'probe#N'."""
+    cached = _read_probe_cache()
+    if cached is not None:
+        platform, age = cached
+        sys.stderr.write(f"bench: probe verdict '{platform}' from cache "
+                         f"(age {age:.0f}s)\n")
+        return platform, "cache"
+    base = float(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT")
+                 or os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+    retries = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
+    backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", "15"))
+    timeout = base
+    for attempt in range(1 + retries):
+        platform = _probe_backend(timeout=timeout)
+        if platform is not None:
+            _write_probe_cache(platform)
+            return platform, f"probe#{attempt + 1}"
+        if attempt < retries:
+            sys.stderr.write(
+                f"bench: probe attempt {attempt + 1} failed; retrying in "
+                f"{backoff:.0f}s with timeout {min(timeout * 2, 480):.0f}s\n")
+            time.sleep(backoff)
+            timeout = min(timeout * 2, 480.0)
+    return None, f"probe#{1 + retries}"
+
+
 def _run_child(env, timeout):
     """Run the measured workload in a watchdog-timed child; return its
     JSON metric lines (train + decode) or None.  A backend that
@@ -214,30 +287,17 @@ def parent():
     tpu_timeout = float(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
     cpu_timeout = float(os.environ.get("BENCH_CPU_TIMEOUT", "900"))
     # the axon terminal can be transiently unavailable for many minutes
-    # (session-claim recovery); retry the cheap probe before abandoning
-    # the on-TPU measurement for the CPU cliff.  PADDLE_TPU_BENCH_PROBE_TIMEOUT
-    # overrides for CI hosts that want a fast verdict.
-    probe_timeout = float(
-        os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT")
-        or os.environ.get("BENCH_PROBE_TIMEOUT", "480"))
-    probe_retries = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
-    probed = False
-    for attempt in range(1 + probe_retries):
-        platform = _probe_backend(timeout=probe_timeout)
-        if platform == "cpu":
-            # definitive: no TPU plugin on this host — retrying cannot
-            # change the answer, so skip straight to the CPU child
-            sys.stderr.write("bench: probe reports CPU-only host; skipping "
-                             "TPU ladder and probe retries\n")
-            break
-        if platform is not None:
-            probed = True
-            break
-        if attempt < probe_retries:
-            sys.stderr.write(f"bench: probe attempt {attempt + 1} failed; "
-                             "retrying in 60s\n")
-            time.sleep(60)
+    # (session-claim recovery); the ADAPTIVE probe retries with doubling
+    # timeouts + short backoff, and a definitive verdict is cached for the
+    # round (see _probe_backend_adaptive)
+    platform, probe_source = _probe_backend_adaptive()
+    probed = platform is not None and platform != "cpu"
+    if platform == "cpu":
+        # definitive: no TPU plugin on this host — skip the TPU ladder
+        sys.stderr.write("bench: probe reports CPU-only host; skipping "
+                         "TPU ladder\n")
     lines = None
+    failed_rungs = 0
     if probed:
         hbm = _probe_hbm()
         sys.stderr.write(f"bench: HBM capacity probe: "
@@ -250,11 +310,26 @@ def parent():
             lines = _run_child(env, tpu_timeout)
             if lines is not None:
                 break
+            failed_rungs += 1
             sys.stderr.write(f"bench: rung {rung} {_RUNGS[rung]} failed; "
                              "backing off\n")
+    on_tpu_lines = lines is not None
     if lines is None:
         sys.stderr.write("bench: falling back to clean-env CPU child\n")
         lines = _run_child(_cpu_env(), cpu_timeout)
+    # EXPLICIT backend line (ROADMAP item 1: a CPU fallback must be
+    # visible in the BENCH_*.json trajectory, never silent): value 1.0 =
+    # metrics below ran on TPU, 0.0 = the TPU rung was LOST this round —
+    # the unit says why (probe timeout, CPU-only host, or rung failures)
+    reason = ("ok" if on_tpu_lines
+              else "cpu_only_host" if platform == "cpu"
+              else "probe_failed" if platform is None
+              else f"all_{failed_rungs}_tpu_rungs_failed")
+    _emit("bench_backend", 1.0 if on_tpu_lines else 0.0,
+          f"tpu_lost={0 if on_tpu_lines else 1} backend="
+          f"{'tpu' if on_tpu_lines else 'cpu'} probe={platform or 'none'} "
+          f"via={probe_source} reason={reason}",
+          0.0)
     if lines is None:
         _emit("gpt_small_train_tokens_per_sec_per_chip", 0.0,
               "tokens/s (bench failed on both tpu and cpu paths)", 0.0)

@@ -120,13 +120,25 @@ class Config:
                             do_sample: bool = False,
                             temperature: float = 1.0, top_k: int = 0,
                             top_p: float = 1.0,
-                            eos_token_id: Optional[int] = None):
+                            eos_token_id: Optional[int] = None,
+                            deadline_s: Optional[float] = None,
+                            max_queue_depth: Optional[int] = None,
+                            max_queue_wait_s: Optional[float] = None,
+                            stall_budget_s: Optional[float] = None):
         """Switch ``Predictor.run`` to the continuous-batching serving
         engine (paged KV cache; docs/serving.md): each prompt row becomes
         a request through the SHARED engine, so concurrent predictors
         batch against each other instead of serializing whole generate()
         calls.  Mutually exclusive with ``enable_causal_lm_decode`` (the
-        single-shot contiguous-cache path)."""
+        single-shot contiguous-cache path).
+
+        Fault-containment knobs pass straight through to the engine:
+        ``deadline_s`` bounds each request's lifetime, ``max_queue_depth``
+        / ``max_queue_wait_s`` shed load with the typed
+        ``serving.Overloaded`` error, ``stall_budget_s`` arms the step
+        watchdog.  A request that ends CANCELLED / TIMED_OUT / FAILED
+        surfaces from ``Predictor.run`` as the typed serving error
+        attached to it (docs/serving.md "Failure model & SLOs")."""
         if self._decode_opts is not None:
             raise RuntimeError(
                 "enable_serving_mode and enable_causal_lm_decode are "
@@ -138,7 +150,10 @@ class Config:
             num_pages=num_pages, cache_dtype=str(cache_dtype),
             prefill_chunk=prefill_chunk, do_sample=bool(do_sample),
             temperature=float(temperature), top_k=int(top_k),
-            top_p=float(top_p), eos_token_id=eos_token_id)
+            top_p=float(top_p), eos_token_id=eos_token_id,
+            deadline_s=deadline_s, max_queue_depth=max_queue_depth,
+            max_queue_wait_s=max_queue_wait_s,
+            stall_budget_s=stall_budget_s)
         return self
 
     def serving_mode_enabled(self) -> bool:
@@ -155,7 +170,10 @@ class Config:
                     self._causal_lm_model, num_slots=o["num_slots"],
                     page_size=o["page_size"], max_context=o["max_context"],
                     num_pages=o["num_pages"], cache_dtype=o["cache_dtype"],
-                    prefill_chunk=o["prefill_chunk"])
+                    prefill_chunk=o["prefill_chunk"],
+                    max_queue_depth=o.get("max_queue_depth"),
+                    max_queue_wait_s=o.get("max_queue_wait_s"),
+                    stall_budget_s=o.get("stall_budget_s"))
             return self._serving_engine
 
     def model_dir(self):
@@ -339,12 +357,16 @@ class Predictor:
     def _run_serving(self, ids):
         """Serving mode: each prompt row becomes a request through the
         Config-shared continuous-batching engine; this thread steps the
-        engine until ITS requests finish (other predictors' requests ride
-        in the same batched step).  Rows that stop early on eos are padded
-        with the eos id — the generate() output convention."""
+        engine until ITS requests reach a TERMINAL state (other
+        predictors' requests ride in the same batched step).  Rows that
+        stop early on eos are padded with the eos id — the generate()
+        output convention.  A row that ends CANCELLED / TIMED_OUT /
+        FAILED re-raises its typed serving error here; an over-full
+        bounded queue raises ``serving.Overloaded`` straight from
+        submit (load shed — the client backs off)."""
         o = self._config._serving_opts
         eng = self._config._get_serving_engine()
-        from ..serving import SamplingParams
+        from ..serving import RequestState, SamplingParams, ServingError
 
         sp = SamplingParams(do_sample=o["do_sample"],
                             temperature=o["temperature"],
@@ -354,11 +376,36 @@ class Predictor:
             np.int64)
         if prompts.ndim == 1:
             prompts = prompts[None, :]
-        reqs = [eng.submit(row, o["max_new_tokens"], sampling=sp,
-                           eos_token_id=o["eos_token_id"])
-                for row in prompts]
-        while not all(r.finished for r in reqs):
+        reqs = []
+        try:
+            for row in prompts:
+                reqs.append(eng.submit(row, o["max_new_tokens"], sampling=sp,
+                                       eos_token_id=o["eos_token_id"],
+                                       deadline_s=o.get("deadline_s")))
+        except Exception:
+            # a mid-batch shed (Overloaded) must not strand the rows
+            # already queued in the SHARED engine: cancel them and step
+            # once so the reap retires them before re-raising
+            for r in reqs:
+                r.cancel()
+            if reqs:
+                eng.step()
+            raise
+        while not all(r.terminal for r in reqs):
             eng.step()
+        bad = [r for r in reqs if r.state != RequestState.DONE]
+        if bad:
+            detail = "; ".join(
+                f"row {i}: {r.state}"
+                f" ({type(r.error).__name__}: {r.error})" if r.error
+                else f"row {i}: {r.state}"
+                for i, r in enumerate(reqs) if r.state != RequestState.DONE)
+            first = bad[0]
+            if len(bad) == 1 and isinstance(first.error, ServingError):
+                raise first.error      # the typed terminal cause, verbatim
+            raise ServingError(
+                f"{len(bad)}/{len(reqs)} serving request(s) did not "
+                f"complete: {detail}") from first.error
         n = o["max_new_tokens"]
         out = np.empty((len(reqs), prompts.shape[1] + n), np.int64)
         for i, r in enumerate(reqs):

@@ -10,26 +10,42 @@ request mix (PAPERS.md: "Ragged Paged Attention", arxiv 2604.15464).
   reservation (out-of-pages admission backpressures into the queue),
   immediate page free on retirement;
 - :mod:`engine` — ``ServingEngine`` / ``RequestQueue``: request lifecycle
-  (SUBMITTED -> PREFILL -> DECODE -> DONE), chunked prefill into pages,
-  ONE donated retrace-free jitted decode step over all slots, per-request
-  sampling, streaming token callbacks, per-step metrics.
+  (SUBMITTED -> PREFILL -> DECODE -> DONE | CANCELLED | TIMED_OUT |
+  FAILED), chunked prefill into pages, ONE donated retrace-free jitted
+  decode step over all slots, per-request sampling + deadlines +
+  cancellation, watchdog-supervised steps with auto-recovery, bounded
+  queues with typed ``Overloaded`` shedding, NaN-slot quarantine,
+  streaming token callbacks, per-step metrics;
+- :mod:`faults` — deterministic fault-injection harness (step crashes,
+  stalls, NaN logits, pool exhaustion, callback errors) driving
+  tests/test_serving_faults.py and tools/serving_fault_gate.py.
 
-See docs/serving.md.
+See docs/serving.md (incl. the "Failure model & SLOs" section).
 """
 from .engine import (  # noqa: F401
+    DeadlineExceeded,
+    NaNLogitsError,
+    Overloaded,
     Request,
+    RequestCancelled,
     RequestQueue,
     RequestState,
     SamplingParams,
     ServingEngine,
+    ServingError,
+    StepStalledError,
     serve_trace_counts,
     reset_serve_trace_counts,
 )
+from .faults import FaultInjector, FaultPlan, InjectedFault, random_schedule  # noqa: F401,E501
 from .paged_cache import NULL_PAGE, BlockAllocator, PagedKVCache  # noqa: F401
 from .scheduler import Scheduler, Slot  # noqa: F401
 
 __all__ = [
     "Request", "RequestQueue", "RequestState", "SamplingParams",
     "ServingEngine", "serve_trace_counts", "reset_serve_trace_counts",
+    "ServingError", "Overloaded", "DeadlineExceeded", "RequestCancelled",
+    "StepStalledError", "NaNLogitsError",
+    "FaultInjector", "FaultPlan", "InjectedFault", "random_schedule",
     "NULL_PAGE", "BlockAllocator", "PagedKVCache", "Scheduler", "Slot",
 ]

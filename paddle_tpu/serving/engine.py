@@ -22,23 +22,55 @@ stay bit-exact).  The host picks per step; both stay cached, so the
 retrace-freedom invariant holds per variant.
 
 Request lifecycle: SUBMITTED (queued; admission backpressures on free
-slots AND free pages) -> PREFILL -> DECODE -> DONE, with per-request
-sampling params (greedy / temperature / top-k / top-p as traced per-slot
-vectors — one compiled step serves every mix), streaming ``on_token``
-callbacks, and per-step metrics (active slots, pool occupancy, queue
-depth, tokens/sec).
+slots AND free pages) -> PREFILL -> DECODE -> one of the four terminal
+states:
+
+- ``DONE`` — hit max_new_tokens or eos;
+- ``CANCELLED`` — ``Request.cancel()`` honored at the next step boundary;
+- ``TIMED_OUT`` — the per-request ``deadline_s`` passed, or the request
+  overstayed the queue's ``max_queue_wait_s`` (load shedding);
+- ``FAILED`` — the request was implicated in a crashed/stalled/NaN step;
+  the error is attached as ``Request.error``.
+
+Fault containment (docs/serving.md "Failure model & SLOs"): one bad
+request, one wedged step, or one transient device error never kills the
+engine or strands other requests.
+
+- **watchdog** — with ``stall_budget_s`` set, step dispatch runs on a
+  supervised worker thread; a step that exceeds the budget is abandoned
+  (the zombie's eventual write-backs land in orphaned buffers, see
+  ``_rebuild``), the seated requests are FAILED, and the engine rebuilds
+  its device state from the scheduler's host mirrors and keeps serving.
+- **retry + backoff** — a step exception is retried once (transient
+  device errors); a second failure triggers recovery, and re-admission
+  backs off exponentially so a persistently sick device is not hammered.
+- **finiteness sentry** — every step also returns a fused per-slot
+  finiteness flag over the logits (the PR-4 fused all-finite reduction of
+  ``checkpoint/sentry.py`` widened from one scalar to one flag per slot,
+  riding in the SAME compiled program: zero extra host syncs); a
+  NaN-poisoned slot is quarantined (FAILED) instead of streaming garbage.
+- **load shedding** — the queue is bounded (``max_queue_depth`` →typed
+  ``Overloaded`` raised at submit) and queue-wait bounded
+  (``max_queue_wait_s`` → TIMED_OUT at the step boundary); shed/timeout/
+  failure counters ride in the per-step metrics.
+
+The invariant proven by tests/test_serving_faults.py and
+tools/serving_fault_gate.py: **page accounting stays exact through every
+failure path** — cancel, timeout, crash, stall, quarantine, recovery —
+no leaked or double-freed pages.
 
 See docs/serving.md for the architecture and slot/page lifecycle.
 """
 from __future__ import annotations
 
 import itertools
+import queue as _queue
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass
-from typing import Callable, List, Optional
+from typing import Callable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -53,9 +85,41 @@ from .scheduler import Scheduler
 __all__ = [
     "RequestState", "SamplingParams", "Request", "RequestQueue",
     "ServingEngine", "serve_trace_counts", "reset_serve_trace_counts",
+    "ServingError", "Overloaded", "DeadlineExceeded", "RequestCancelled",
+    "StepStalledError", "NaNLogitsError",
 ]
 
 _NEG = np.float32(-1e30)
+
+
+# ---------------------------------------------------------------------------
+# typed serving errors (docs/serving.md "Failure model & SLOs")
+# ---------------------------------------------------------------------------
+
+class ServingError(RuntimeError):
+    """Base of every typed serving fault."""
+
+
+class Overloaded(ServingError):
+    """Load shed: the bounded queue is full (raised at ``submit``) or the
+    request overstayed ``max_queue_wait_s`` (attached to a TIMED_OUT
+    request).  Clients should back off and retry."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's ``deadline_s`` passed before it completed."""
+
+
+class RequestCancelled(ServingError):
+    """The request was cancelled via ``Request.cancel()``."""
+
+
+class StepStalledError(ServingError):
+    """A supervised step exceeded the watchdog's stall budget."""
+
+
+class NaNLogitsError(ServingError):
+    """The finiteness sentry caught non-finite logits for this slot."""
 
 
 class RequestState:
@@ -63,6 +127,11 @@ class RequestState:
     PREFILL = "PREFILL"
     DECODE = "DECODE"
     DONE = "DONE"
+    CANCELLED = "CANCELLED"
+    TIMED_OUT = "TIMED_OUT"
+    FAILED = "FAILED"
+
+    TERMINAL = frozenset({DONE, CANCELLED, TIMED_OUT, FAILED})
 
 
 @dataclass
@@ -93,7 +162,8 @@ class Request:
     def __init__(self, prompt: np.ndarray, max_new_tokens: int,
                  sampling: Optional[SamplingParams] = None,
                  eos_token_id: Optional[int] = None,
-                 on_token: Optional[Callable] = None):
+                 on_token: Optional[Callable] = None,
+                 deadline_s: Optional[float] = None):
         self.id = next(Request._ids)
         self.prompt = np.asarray(prompt, np.int64).reshape(-1)
         self.max_new_tokens = int(max_new_tokens)
@@ -102,14 +172,52 @@ class Request:
         self.on_token = on_token
         self.state = RequestState.SUBMITTED
         self.tokens: List[int] = []      # generated ids, in order
+        # fault-containment bookkeeping
+        self.deadline_s = None if deadline_s is None else float(deadline_s)
+        self.deadline: Optional[float] = None   # absolute monotonic; at submit
+        self.submit_t: Optional[float] = None   # monotonic queue-entry time
+        self.error: Optional[BaseException] = None
+        self.callback_error: Optional[BaseException] = None
+        self._cancelled = False
+        self._cb_warned = False
         self._done = threading.Event()
 
     @property
     def finished(self) -> bool:
         return self.state == RequestState.DONE
 
-    def wait(self, timeout: Optional[float] = None) -> bool:
-        return self._done.wait(timeout)
+    @property
+    def terminal(self) -> bool:
+        return self.state in RequestState.TERMINAL
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancelled
+
+    def cancel(self) -> bool:
+        """Request cancellation.  Honored at the engine's next step
+        boundary (the slot is retired and its pages returned); safe from
+        any thread.  Returns False when the request is already terminal
+        (nothing to cancel)."""
+        if self.terminal:
+            return False
+        self._cancelled = True
+        return True
+
+    def wait(self, timeout: Optional[float] = None,
+             raise_on_failure: bool = False) -> bool:
+        """Block until the request reaches a TERMINAL state (not just
+        DONE).  Returns True when terminal, False when the WAIT timed out
+        — distinguishable from a failed request, whose wait returns True
+        with ``state`` telling which terminal it hit and ``error``
+        carrying the typed cause.  With ``raise_on_failure`` a non-DONE
+        terminal re-raises that error here."""
+        reached = self._done.wait(timeout)
+        if raise_on_failure and reached and self.state != RequestState.DONE:
+            err = self.error or ServingError(
+                f"request {self.id} ended {self.state}")
+            raise err
+        return reached
 
     def output_ids(self) -> np.ndarray:
         """prompt + generated ids (the ``generate()`` convention)."""
@@ -118,14 +226,23 @@ class Request:
 
 
 class RequestQueue:
-    """Thread-safe FIFO; ``submit`` may be called from any thread."""
+    """Thread-safe FIFO; ``submit`` may be called from any thread.
 
-    def __init__(self):
+    ``max_depth`` bounds the queue: an over-limit ``submit`` raises the
+    typed ``Overloaded`` error immediately (fail fast — the client backs
+    off) instead of queueing unboundedly."""
+
+    def __init__(self, max_depth: Optional[int] = None):
         self._q: deque = deque()
         self._lock = threading.Lock()
+        self.max_depth = None if max_depth is None else int(max_depth)
 
     def submit(self, request: Request) -> Request:
         with self._lock:
+            if self.max_depth is not None and len(self._q) >= self.max_depth:
+                raise Overloaded(
+                    f"queue full ({len(self._q)}/{self.max_depth}): "
+                    "request shed — back off and retry")
             self._q.append(request)
         return request
 
@@ -136,6 +253,20 @@ class RequestQueue:
     def push_front(self, request: Request):
         with self._lock:
             self._q.appendleft(request)
+
+    def remove_where(self, pred: Callable[[Request], bool]) -> List[Request]:
+        """Remove and return every queued request matching ``pred``
+        (queue sweep for cancelled/expired requests; preserves FIFO order
+        of the survivors)."""
+        with self._lock:
+            kept, dropped = deque(), []
+            for r in self._q:
+                if pred(r):
+                    dropped.append(r)
+                else:
+                    kept.append(r)
+            self._q = kept
+            return dropped
 
     @property
     def depth(self) -> int:
@@ -207,6 +338,93 @@ def _take_position(logits: Tensor, idx: Tensor) -> Tensor:
     return dispatch.apply_nondiff(fn, logits, idx)
 
 
+def _slotwise_finite(logits: Tensor) -> Tensor:
+    """Per-slot finiteness of [S, V] logits -> bool [S]: the PR-4 fused
+    all-finite reduction (``checkpoint/sentry.tree_all_finite``) widened
+    from one scalar to one flag per slot and fused INTO the compiled
+    serving step — the sentry costs zero extra host syncs (the flags ride
+    the same device->host transfer as the sampled tokens)."""
+    def fn(lg):
+        return jnp.isfinite(lg).all(axis=-1)
+
+    return dispatch.apply_nondiff(fn, logits)
+
+
+class _StepBox:
+    """One supervised unit of work (see ``_StepWorker``)."""
+
+    __slots__ = ("fn", "result", "error", "done", "abandoned", "cleanup",
+                 "lock")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.done = threading.Event()
+        self.abandoned = False
+        self.cleanup: Optional[Callable[[], None]] = None
+        self.lock = threading.Lock()
+
+
+class _StepWorker:
+    """Watchdog executor: runs step thunks on one daemon thread so the
+    caller can bound how long it waits.  A thunk that overruns the stall
+    budget is ABANDONED — a wedged XLA dispatch cannot be cancelled, so
+    the thread is left to finish (or never finish) on its own, the worker
+    is marked dead (the engine spawns a fresh one), and the abandoned
+    box's ``cleanup`` releases the orphaned device state once the zombie
+    does return.  Thunks receive a ``cancelled()`` callable and must skip
+    device dispatch once it reports True (fault-injected stalls exercise
+    exactly this path)."""
+
+    def __init__(self, name: str):
+        self._q: _queue.Queue = _queue.Queue()
+        self.dead = False
+        self._t = threading.Thread(target=self._loop, daemon=True, name=name)
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            box = self._q.get()
+            if box is None:
+                return
+            try:
+                box.result = box.fn(lambda: box.abandoned)
+            except BaseException as e:  # noqa: BLE001 — surfaced to caller
+                box.error = e
+            with box.lock:
+                box.done.set()
+            if box.abandoned and box.cleanup is not None:
+                try:
+                    box.cleanup()
+                except Exception:  # noqa: BLE001 — zombie cleanup best-effort
+                    pass
+
+    def shutdown(self):
+        self._q.put(None)
+
+    def run(self, fn, timeout: float,
+            cleanup: Optional[Callable[[], None]] = None):
+        box = _StepBox(fn)
+        self._q.put(box)
+        if not box.done.wait(timeout):
+            with box.lock:
+                if not box.done.is_set():
+                    # genuine overrun: abandon the thunk.  The lock makes
+                    # abandon-vs-finish atomic: either the worker published
+                    # its result first (we harvest it below) or it will see
+                    # abandoned=True and run the cleanup when it returns.
+                    box.abandoned = True
+                    box.cleanup = cleanup
+                    self.dead = True
+                    raise StepStalledError(
+                        f"supervised step exceeded the stall budget "
+                        f"({timeout:.3f}s); worker abandoned")
+        if box.error is not None:
+            raise box.error
+        return box.result
+
+
 class ServingEngine:
     """Continuous-batching front end over a model exposing the paged-cache
     contract (``new_paged_kv_cache`` + ``_paged_lm_logits`` — both GPT
@@ -216,13 +434,29 @@ class ServingEngine:
     ``max_context`` tokens, plus the null page); size it DOWN to
     oversubscribe HBM — admission then backpressures on pool occupancy,
     not just on free slots.
+
+    Fault-containment knobs (all optional; docs/serving.md):
+
+    - ``stall_budget_s`` — supervise step dispatch with a watchdog; a
+      stalled step fails only the seated requests and the engine rebuilds
+      and keeps serving.  None (default) dispatches inline.
+    - ``max_queue_depth`` / ``max_queue_wait_s`` — bounded queue + queue
+      -wait shedding (typed ``Overloaded``).
+    - ``readmission_backoff_s`` / ``backoff_max_s`` — exponential
+      re-admission backoff after a recovery (reset by a clean step).
     """
 
     def __init__(self, model, *, num_slots: int = 4,
                  page_size: int = 128, max_context: Optional[int] = None,
                  num_pages: Optional[int] = None,
                  cache_dtype: str = "bfloat16",
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 stall_budget_s: Optional[float] = None,
+                 compile_budget_s: float = 300.0,
+                 max_queue_depth: Optional[int] = None,
+                 max_queue_wait_s: Optional[float] = None,
+                 readmission_backoff_s: float = 0.05,
+                 backoff_max_s: float = 5.0):
         cfg = model.config
         max_context = int(max_context or cfg.max_position_embeddings)
         if max_context > cfg.max_position_embeddings:
@@ -249,14 +483,34 @@ class ServingEngine:
         self.max_context = max_context
         self.prefill_chunk = prefill_chunk
         self.cache_dtype = str(cache_dtype)
+        self.num_pages = int(num_pages)
         self.cache = model.new_paged_kv_cache(num_pages, page_size,
                                               dtype=cache_dtype)
         self.allocator = BlockAllocator(num_pages)
         self.scheduler = Scheduler(num_slots, max_pages_per_slot, page_size,
                                    self.allocator)
-        self.queue = RequestQueue()
+        self.queue = RequestQueue(max_depth=max_queue_depth)
         self._lock = threading.RLock()
         self._closed = False
+
+        # fault-containment state
+        self.stall_budget_s = (None if stall_budget_s is None
+                               else float(stall_budget_s))
+        # first call of a step variant compiles (seconds, not millis) —
+        # the watchdog must not misread XLA compilation as a stall
+        self.compile_budget_s = max(float(compile_budget_s),
+                                    self.stall_budget_s or 0.0)
+        self.max_queue_wait_s = (None if max_queue_wait_s is None
+                                 else float(max_queue_wait_s))
+        self.readmission_backoff_s = float(readmission_backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self._backoff_s = self.readmission_backoff_s
+        self._admit_after = 0.0          # monotonic; re-admission gate
+        self._worker: Optional[_StepWorker] = None
+        # test-only fault injection: fn(point, ctx) may raise, stall, or
+        # mutate ctx to simulate a fault at that point of the step pipeline
+        # (serving/faults.py; same discipline as checkpoint/manager.py)
+        self._fault_hook: Optional[Callable] = None
 
         # host mirrors shipped to the jitted step each call (fixed shapes)
         self._tokens = np.zeros((num_slots,), np.int64)
@@ -266,11 +520,23 @@ class ServingEngine:
         self._do_sample = np.zeros((num_slots,), bool)
 
         self._totals = {"steps": 0, "tokens": 0, "admitted": 0,
-                        "completed": 0, "prefill_chunks": 0}
+                        "completed": 0, "prefill_chunks": 0,
+                        # fault-containment counters (admission path SLOs)
+                        "failed": 0, "cancelled": 0, "timed_out": 0,
+                        "shed": 0, "quarantined": 0, "step_retries": 0,
+                        "recoveries": 0, "rebuilds": 0}
         self._step_emitted = 0           # tokens emitted in the current step
         self._last_metrics: dict = {}
 
-        cache = self.cache
+        self._build_steps()
+
+    def _build_steps(self):
+        """Compile-on-first-use prefill/decode closures over the CURRENT
+        page pool.  Called at init and again by ``_rebuild`` after a
+        stalled/crashed step: fresh closures capture the fresh pool
+        Tensors, so an abandoned zombie step's eventual write-backs land
+        in the ORPHANED old Tensors, never in live state."""
+        model, cache = self.model, self.cache
         from ..jit.api import to_static
 
         # two compiled variants per phase, chosen host-side per step: the
@@ -278,7 +544,8 @@ class ServingEngine:
         # gumbel, no RNG-state traffic) — all-greedy traffic, the common
         # serving case, never pays the sampling machinery.  Mixed batches
         # take the sampling variant, whose per-slot `do_sample` vector
-        # still reproduces greedy rows bit-exactly.
+        # still reproduces greedy rows bit-exactly.  Every variant ALSO
+        # returns the fused per-slot finiteness flags (the NaN sentry).
         def _mk_prefill(with_sampling):
             def prefill_step(ids, tables, positions, last_idx, temp, top_p,
                              top_k, do_sample):
@@ -287,12 +554,13 @@ class ServingEngine:
                     logits = model._paged_lm_logits(ids, cache, tables,
                                                     positions)
                     last = _take_position(logits, last_idx).astype("float32")
+                    fin = _slotwise_finite(last)
                     if with_sampling:
                         tok = _sample_per_slot(last, temp, top_p, top_k,
                                                do_sample)
                     else:
                         tok = ops.argmax(last, axis=-1)
-                return tok
+                return tok, fin
 
             return prefill_step
 
@@ -305,12 +573,13 @@ class ServingEngine:
                     logits = model._paged_lm_logits(ids, cache, tables,
                                                     positions)
                     last = logits[:, -1, :].astype("float32")
+                    fin = _slotwise_finite(last)
                     if with_sampling:
                         tok = _sample_per_slot(last, temp, top_p, top_k,
                                                do_sample)
                     else:
                         tok = ops.argmax(last, axis=-1)
-                return tok
+                return tok, fin
 
             return decode_step
 
@@ -323,15 +592,22 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int = 32, *,
                sampling: Optional[SamplingParams] = None,
                eos_token_id: Optional[int] = None,
-               on_token: Optional[Callable] = None) -> Request:
+               on_token: Optional[Callable] = None,
+               deadline_s: Optional[float] = None) -> Request:
         """Queue a request; returns immediately.  Validation happens here
-        so the step loop can never hit an unseatable request."""
+        so the step loop can never hit an unseatable request.  A full
+        bounded queue raises the typed ``Overloaded`` error (load shed);
+        ``deadline_s`` bounds the request's total lifetime — queued or
+        seated, it is retired TIMED_OUT at the first step boundary past
+        the deadline."""
         self._check_open()
         prompt = np.asarray(prompt, np.int64).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         total = prompt.size + int(max_new_tokens)
         if total > self.max_context:
             raise ValueError(
@@ -342,14 +618,26 @@ class ServingEngine:
                 f"request needs {self.scheduler.pages_needed(total)} pages "
                 f"but the pool holds only {self.allocator.capacity}")
         req = Request(prompt, max_new_tokens, sampling=sampling,
-                      eos_token_id=eos_token_id, on_token=on_token)
-        return self.queue.submit(req)
+                      eos_token_id=eos_token_id, on_token=on_token,
+                      deadline_s=deadline_s)
+        now = time.monotonic()
+        req.submit_t = now
+        if req.deadline_s is not None:
+            req.deadline = now + req.deadline_s
+        try:
+            return self.queue.submit(req)
+        except Overloaded:
+            self._totals["shed"] += 1
+            raise
 
     # -- the serving loop --------------------------------------------------
     def step(self) -> dict:
-        """One scheduler tick: admit what fits, run ONE batched decode
-        step over every active slot, retire finished requests (their pages
-        free immediately).  Returns this step's metrics."""
+        """One scheduler tick: reap cancelled/expired requests, admit what
+        fits, run ONE batched decode step over every active slot
+        (supervised, retried once, finiteness-checked), retire finished
+        requests (their pages free immediately).  A crashed or stalled
+        step never escapes: the implicated requests end FAILED and the
+        engine recovers.  Returns this step's metrics."""
         with self._lock, self._eval_mode():
             # under the lock: close() also serializes on it, so a racing
             # close cannot delete the pool between this check and the
@@ -357,29 +645,22 @@ class ServingEngine:
             self._check_open()
             t0 = time.perf_counter()
             self._step_emitted = 0
-            self._admit()
+            now = time.monotonic()
+            self._reap(now)
+            self._admit(now)
             sched = self.scheduler
             if sched.active_slots:
-                decode = (self._decode_sample if self._do_sample.any()
-                          else self._decode_greedy)
-                toks = decode(
-                    to_tensor(self._tokens),
-                    to_tensor(np.ascontiguousarray(sched.tables)),
-                    to_tensor(np.ascontiguousarray(sched.positions)),
-                    to_tensor(self._temp), to_tensor(self._top_p),
-                    to_tensor(self._top_k), to_tensor(self._do_sample))
-                toks_np = np.asarray(toks.numpy())
-                for i in range(self.num_slots):
-                    slot = sched.slots[i]
-                    if slot is None:
-                        continue
-                    # the step wrote the fed token's K/V at slot.pos
-                    sched.advance(i)
-                    tok = int(toks_np[i])
-                    self._tokens[i] = tok
-                    self._emit(slot.request, tok)
-                    if self._is_finished(slot.request, tok):
-                        self._finish(i)
+                try:
+                    out = self._run_decode()
+                except StepStalledError as e:
+                    self._recover(e, rebuild=True, stalled=True)
+                    out = None
+                except Exception as e:  # noqa: BLE001 — containment boundary
+                    self._recover(e, rebuild=not _state_intact(e))
+                    out = None
+                if out is not None:
+                    self._harvest_decode(*out)
+                    self._backoff_s = self.readmission_backoff_s
             dt = time.perf_counter() - t0
             emitted = self._step_emitted
             self._totals["steps"] += 1
@@ -393,25 +674,114 @@ class ServingEngine:
                 "tokens_this_step": emitted,
                 "tokens_per_sec": emitted / dt if dt > 0 else 0.0,
                 "step_seconds": dt,
+                # fault counters ride every step's metrics (admission SLOs)
+                "failed": self._totals["failed"],
+                "cancelled": self._totals["cancelled"],
+                "timed_out": self._totals["timed_out"],
+                "shed": self._totals["shed"],
+                "recoveries": self._totals["recoveries"],
             }
             return dict(self._last_metrics)
+
+    def _run_decode(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Dispatch the batched decode step under the watchdog; one
+        immediate retry on a (transient) exception.  A stall is never
+        retried — the worker is already wedged."""
+        decode = (self._decode_sample if self._do_sample.any()
+                  else self._decode_greedy)
+        budget = self._budget_for([decode])
+        thunk = lambda cancelled: self._decode_thunk(decode, cancelled)  # noqa: E731,E501
+        try:
+            return self._supervised(thunk, budget)
+        except StepStalledError:
+            raise
+        except Exception:  # noqa: BLE001 — transient device errors retry once
+            self._totals["step_retries"] += 1
+            return self._supervised(thunk, budget)
+
+    def _budget_for(self, static_fns, chunks: int = 1) -> Optional[float]:
+        """Watchdog budget for one supervised dispatch: the stall budget
+        per compiled program (× chunks for a chunked prefill), or the much
+        larger compile budget when ANY variant the dispatch will call has
+        not compiled yet — XLA compilation is slow, not stalled."""
+        if self.stall_budget_s is None:
+            return None
+        if any(not f.code_cache for f in static_fns):
+            return max(self.compile_budget_s, self.stall_budget_s * chunks)
+        return self.stall_budget_s * chunks
+
+    def _decode_thunk(self, decode, cancelled) -> Tuple[np.ndarray,
+                                                        np.ndarray]:
+        self._hook("before_decode")
+        if cancelled():          # abandoned while the fault hook stalled:
+            return None          # the result is discarded; skip dispatch
+        sched = self.scheduler
+        toks, fin = decode(
+            to_tensor(self._tokens),
+            to_tensor(np.ascontiguousarray(sched.tables)),
+            to_tensor(np.ascontiguousarray(sched.positions)),
+            to_tensor(self._temp), to_tensor(self._top_p),
+            to_tensor(self._top_k), to_tensor(self._do_sample))
+        return (np.asarray(toks.numpy()),
+                np.array(np.asarray(fin.numpy()), bool))
+
+    def _harvest_decode(self, toks_np: np.ndarray, fin_np: np.ndarray):
+        """Fold one decode step's results back into the request states:
+        quarantine NaN-poisoned slots, advance/emit the rest."""
+        ctx = {"tokens": toks_np, "finite": fin_np}
+        self._hook("after_decode", ctx)
+        sched = self.scheduler
+        for i in range(self.num_slots):
+            slot = sched.slots[i]
+            if slot is None:
+                continue
+            if not ctx["finite"][i]:
+                # finiteness sentry: quarantine the poisoned slot instead
+                # of streaming garbage; every other slot proceeds
+                self._totals["quarantined"] += 1
+                self._fail_slot(i, NaNLogitsError(
+                    f"request {slot.request.id}: non-finite logits at "
+                    f"position {slot.pos} (slot {i} quarantined)"))
+                continue
+            # the step wrote the fed token's K/V at slot.pos
+            sched.advance(i)
+            tok = int(ctx["tokens"][i])
+            self._tokens[i] = tok
+            self._emit(slot.request, tok)
+            if self._is_finished(slot.request, tok):
+                self._finish(i)
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> dict:
         """Step until queue and slots drain; returns cumulative metrics."""
         steps = 0
         while self.queue.depth or self.scheduler.active_slots:
-            self.step()
+            met = self.step()
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
+            if (not met["active_slots"] and not met["tokens_this_step"]
+                    and self.queue.depth):
+                # admission gated by post-recovery backoff: don't spin hot
+                time.sleep(0.001)
         return self.metrics()
 
-    def generate_batch(self, prompts, max_new_tokens: int = 32,
+    def generate_batch(self, prompts, max_new_tokens: int = 32, *,
+                       raise_on_failure: bool = True,
                        **kwargs) -> List[np.ndarray]:
         """Convenience: submit every prompt, drain, return each request's
-        prompt+generated ids (in submission order)."""
+        prompt+generated ids (in submission order).  A request that ends
+        in a non-DONE terminal state (cancelled / timed out / failed)
+        raises the typed error instead of silently returning a truncated
+        row; pass ``raise_on_failure=False`` to get whatever each request
+        produced and inspect states yourself."""
         reqs = [self.submit(p, max_new_tokens, **kwargs) for p in prompts]
         self.run_until_idle()
+        bad = [r for r in reqs if r.state != RequestState.DONE]
+        if bad and raise_on_failure:
+            detail = ", ".join(f"request {r.id}: {r.state}" for r in bad)
+            raise ServingError(
+                f"generate_batch: {len(bad)}/{len(reqs)} request(s) did "
+                f"not complete ({detail})") from bad[0].error
         return [r.output_ids() for r in reqs]
 
     # -- internals ---------------------------------------------------------
@@ -426,7 +796,75 @@ class ServingEngine:
             if was:
                 self.model.train()
 
-    def _admit(self):
+    def _hook(self, point: str, ctx: Optional[dict] = None):
+        if self._fault_hook is not None:
+            self._fault_hook(point, ctx)
+
+    def _supervised(self, fn, budget: Optional[float]):
+        """Run ``fn(cancelled)`` under the watchdog when a stall budget is
+        configured; inline otherwise."""
+        if budget is None:
+            return fn(lambda: False)
+        if self._worker is None or self._worker.dead:
+            if self._worker is not None:
+                # let the replaced worker's thread exit once its zombie
+                # thunk returns (otherwise one blocked daemon thread
+                # leaks per stall recovery)
+                self._worker.shutdown()
+            self._worker = _StepWorker(f"serving-step-{id(self):x}")
+        cache = self.cache
+
+        def cleanup():
+            # the zombie finally returned: its write-backs landed in the
+            # orphaned pool Tensors — release their device memory now
+            cache.release()
+
+        return self._worker.run(fn, budget, cleanup=cleanup)
+
+    # -- reaping: deadlines, cancellation, queue-wait shedding -------------
+    def _reap(self, now: float):
+        """Step-boundary retirement of cancelled/expired requests, both
+        queued and seated.  Pages return to the pool before admission runs
+        so freed capacity is reusable in the same step."""
+        max_wait = self.max_queue_wait_s
+
+        def expired(r: Request) -> bool:
+            return (r.cancelled
+                    or (r.deadline is not None and now >= r.deadline)
+                    or (max_wait is not None and r.submit_t is not None
+                        and now - r.submit_t >= max_wait))
+
+        for r in self.queue.remove_where(expired):
+            if r.cancelled:
+                self._terminalize(r, RequestState.CANCELLED,
+                                  RequestCancelled(f"request {r.id} "
+                                                   "cancelled while queued"))
+            elif r.deadline is not None and now >= r.deadline:
+                self._terminalize(r, RequestState.TIMED_OUT,
+                                  DeadlineExceeded(
+                                      f"request {r.id}: deadline_s="
+                                      f"{r.deadline_s} passed while queued"))
+            else:
+                self._totals["shed"] += 1
+                self._terminalize(r, RequestState.TIMED_OUT, Overloaded(
+                    f"request {r.id}: queued longer than "
+                    f"max_queue_wait_s={max_wait}"))
+        for i, slot in self.scheduler.seated():
+            r = slot.request
+            if r.cancelled:
+                self._retire_slot(i, RequestState.CANCELLED,
+                                  RequestCancelled(
+                                      f"request {r.id} cancelled"))
+            elif r.deadline is not None and now >= r.deadline:
+                self._retire_slot(i, RequestState.TIMED_OUT,
+                                  DeadlineExceeded(
+                                      f"request {r.id}: deadline_s="
+                                      f"{r.deadline_s} passed mid-decode"))
+
+    # -- admission ---------------------------------------------------------
+    def _admit(self, now: float):
+        if now < self._admit_after:
+            return                        # re-admission backoff after recovery
         sched = self.scheduler
         while sched.free_slot_indices():
             req = self.queue.pop()
@@ -445,7 +883,27 @@ class ServingEngine:
             self._top_p[idx] = np.float32(sp.top_p)
             self._top_k[idx] = np.int32(sp.top_k)
             self._do_sample[idx] = bool(sp.do_sample)
-            tok0 = self._run_prefill(idx, req)
+            try:
+                tok0, fin0 = self._run_prefill(idx, req)
+            except StepStalledError as e:
+                # the prefill worker is wedged: every seated request is
+                # implicated by the shared (possibly half-written) pool
+                self._recover(e, rebuild=True, stalled=True)
+                return
+            except Exception as e:  # noqa: BLE001 — containment boundary
+                if _state_intact(e):
+                    # the fault provably fired before any device work:
+                    # only THIS request is implicated
+                    self._fail_slot(idx, e)
+                    continue
+                self._recover(e, rebuild=True)
+                return
+            if not fin0:
+                self._totals["quarantined"] += 1
+                self._fail_slot(idx, NaNLogitsError(
+                    f"request {req.id}: non-finite prefill logits "
+                    "(request quarantined at admission)"))
+                continue
             sched.slots[idx].pos = req.prompt.size
             sched.positions[idx] = req.prompt.size
             self._tokens[idx] = tok0
@@ -454,12 +912,37 @@ class ServingEngine:
             if self._is_finished(req, tok0):
                 self._finish(idx)
 
-    def _run_prefill(self, idx: int, req: Request) -> int:
+    def _run_prefill(self, idx: int, req: Request) -> Tuple[int, bool]:
+        """Supervised chunked prefill with one retry (same transient-error
+        policy as decode).  Chunk writes are idempotent — a retry rewrites
+        the same K/V into the same reserved pages — so retrying the whole
+        prompt is safe.  The stall budget scales with the chunk count
+        (one budget per dispatched program)."""
+        n_chunks = -(-req.prompt.size // self.prefill_chunk)
+        # non-final chunks always run the greedy program (see
+        # _prefill_attempt), so the budget must consider BOTH variants a
+        # multi-chunk sampling prompt dispatches
+        variants = [self._prefill_sample if req.sampling.do_sample
+                    else self._prefill_greedy]
+        if n_chunks > 1:
+            variants.append(self._prefill_greedy)
+        budget = self._budget_for(variants, chunks=n_chunks)
+        thunk = lambda cancelled: self._prefill_attempt(idx, req, cancelled)  # noqa: E731,E501
+        try:
+            return self._supervised(thunk, budget)
+        except StepStalledError:
+            raise
+        except Exception:  # noqa: BLE001 — transient device errors retry once
+            self._totals["step_retries"] += 1
+            return self._supervised(thunk, budget)
+
+    def _prefill_attempt(self, idx: int, req: Request,
+                         cancelled) -> Tuple[int, bool]:
         """Chunked prefill of one admitted request: every chunk is the
         same [1, prefill_chunk] program (prompts pad the final chunk; pad
         writes sink into reserved-but-unread positions or the null page).
-        Returns the first generated token, sampled from the last REAL
-        prompt position's logits."""
+        Returns (first generated token, finiteness of the final chunk's
+        logits)."""
         req.state = RequestState.PREFILL
         c = self.prefill_chunk
         s0 = req.prompt.size
@@ -467,11 +950,14 @@ class ServingEngine:
         padded = np.zeros((n_chunks * c,), np.int64)
         padded[:s0] = req.prompt
         row = np.ascontiguousarray(self.scheduler.tables[idx:idx + 1])
-        tok = 0
+        tok, fin = 0, True
         sl = slice(idx, idx + 1)
         final_prefill = (self._prefill_sample if req.sampling.do_sample
                          else self._prefill_greedy)
         for ci in range(n_chunks):
+            self._hook("before_prefill")
+            if cancelled():
+                return 0, True           # abandoned: result discarded
             ids = padded[ci * c:(ci + 1) * c][None, :]
             pos = np.array([ci * c], np.int32)
             last_idx = np.int32(np.clip(s0 - 1 - ci * c, 0, c - 1))
@@ -482,23 +968,112 @@ class ServingEngine:
             # prefill_chunk sizing
             prefill = (final_prefill if ci == n_chunks - 1
                        else self._prefill_greedy)
-            out = prefill(
+            out, f = prefill(
                 to_tensor(ids), to_tensor(row), to_tensor(pos),
                 to_tensor(last_idx),
                 to_tensor(self._temp[sl]), to_tensor(self._top_p[sl]),
                 to_tensor(self._top_k[sl]), to_tensor(self._do_sample[sl]))
             self._totals["prefill_chunks"] += 1
             tok = int(np.asarray(out.numpy())[0])
-        return tok
+            fin = bool(np.asarray(f.numpy())[0])
+        ctx = {"token": tok, "finite": np.array([fin])}
+        self._hook("after_prefill", ctx)
+        return int(ctx["token"]), bool(ctx["finite"][0])
+
+    # -- recovery ----------------------------------------------------------
+    def _recover(self, error: BaseException, *, rebuild: bool,
+                 stalled: bool = False):
+        """Contain a crashed or stalled step: every seated request is
+        implicated (the pool they share may be half-written or consumed by
+        donation) and ends FAILED with ``error`` attached; queued requests
+        survive untouched.  With ``rebuild`` the device pool and compiled
+        steps are reconstructed from the scheduler's host mirrors.
+        Re-admission backs off exponentially (reset by a clean step)."""
+        self._totals["recoveries"] += 1
+        for i, _slot in self.scheduler.seated():
+            self._fail_slot(i, error)
+        if rebuild:
+            self._rebuild(release_old=not stalled)
+        now = time.monotonic()
+        self._admit_after = now + self._backoff_s
+        self._backoff_s = min(self._backoff_s * 2.0, self.backoff_max_s)
+
+    def _rebuild(self, release_old: bool = True):
+        """Reconstruct the engine's DEVICE state after a catastrophic step
+        failure: a fresh page pool + fresh compiled step closures.  Host
+        state (allocator free list, queue, counters) is authoritative and
+        survives as-is.  The old pool is released eagerly unless a zombie
+        worker may still touch it (a stall) — then the abandoned box's
+        cleanup releases it when the zombie returns, so its write-backs
+        land in orphaned Tensors, never in the new pool."""
+        assert self.scheduler.active_slots == 0, \
+            "rebuild with seated requests would strand their K/V"
+        assert self.allocator.used_pages == 0, \
+            f"rebuild leaked {self.allocator.used_pages} pages"
+        old = self.cache
+        self.cache = self.model.new_paged_kv_cache(
+            self.num_pages, self.page_size, dtype=self.cache_dtype)
+        self.scheduler.reset_mirrors()
+        self._build_steps()
+        if release_old:
+            old.release()
+        self._totals["rebuilds"] += 1
+
+    # -- terminal transitions ----------------------------------------------
+    def _clear_slot_mirrors(self, idx: int):
+        self._tokens[idx] = 0
+        self._temp[idx] = 1.0
+        self._top_p[idx] = 1.0
+        self._top_k[idx] = 0
+        self._do_sample[idx] = False
+
+    def _terminalize(self, req: Request, state: str,
+                     error: Optional[BaseException]):
+        """Finish a NEVER-SEATED request in a non-DONE terminal state."""
+        req.error = error
+        req.state = state
+        if state == RequestState.CANCELLED:
+            self._totals["cancelled"] += 1
+        elif state == RequestState.TIMED_OUT:
+            self._totals["timed_out"] += 1
+        elif state == RequestState.FAILED:
+            self._totals["failed"] += 1
+        req._done.set()
+
+    def _retire_slot(self, idx: int, state: str,
+                     error: Optional[BaseException]):
+        """Retire a SEATED request into a non-DONE terminal state; its
+        pages return to the pool immediately."""
+        req = self.scheduler.slots[idx].request
+        self.scheduler.retire(idx)
+        self._clear_slot_mirrors(idx)
+        self._terminalize(req, state, error)
+
+    def _fail_slot(self, idx: int, error: BaseException):
+        self._retire_slot(idx, RequestState.FAILED, error)
 
     def _emit(self, req: Request, tok: int):
         req.tokens.append(tok)
         self._step_emitted += 1
         if req.on_token is not None:
             try:
+                self._hook("callback")
                 req.on_token(req, tok)
-            except Exception:  # noqa: BLE001 — a callback must not kill serving
-                pass
+            except Exception as e:  # noqa: BLE001 — must not kill serving
+                # record the FIRST callback error on the request and warn
+                # once per request — never silently swallowed
+                if req.callback_error is None:
+                    req.callback_error = e
+                if not req._cb_warned:
+                    req._cb_warned = True
+                    import warnings
+
+                    warnings.warn(
+                        f"on_token callback for request {req.id} raised "
+                        f"{type(e).__name__}: {e} (recorded on "
+                        "request.callback_error; further errors for this "
+                        "request are suppressed)", RuntimeWarning,
+                        stacklevel=2)
 
     @staticmethod
     def _is_finished(req: Request, tok: int) -> bool:
@@ -509,11 +1084,7 @@ class ServingEngine:
     def _finish(self, idx: int):
         req = self.scheduler.slots[idx].request
         self.scheduler.retire(idx)         # pages free immediately
-        self._tokens[idx] = 0
-        self._temp[idx] = 1.0
-        self._top_p[idx] = 1.0
-        self._top_k[idx] = 0
-        self._do_sample[idx] = False
+        self._clear_slot_mirrors(idx)
         self._totals["completed"] += 1
         req.state = RequestState.DONE
         req._done.set()
@@ -560,3 +1131,13 @@ class ServingEngine:
             if not self._closed:
                 self._closed = True
                 self.cache.release()
+                if self._worker is not None:
+                    self._worker.shutdown()
+
+
+def _state_intact(e: BaseException) -> bool:
+    """True when the exception provably fired BEFORE any device work (an
+    injected fault flagged state_intact): device state is untouched, so
+    containment can stay surgical.  Real device errors report False and
+    recovery conservatively rebuilds."""
+    return bool(getattr(e, "state_intact", False))

@@ -99,6 +99,10 @@ class BlockAllocator:
         self.num_pages = num_pages
         self._free: deque = deque(range(1, num_pages))
         self._allocated: set = set()
+        # test-only fault injection: fn("alloc", ctx) may set
+        # ctx["force_none"] to simulate pool exhaustion (serving/faults.py;
+        # same discipline as checkpoint/manager.py's _fault_hook)
+        self._fault_hook = None
 
     @property
     def capacity(self) -> int:
@@ -117,6 +121,11 @@ class BlockAllocator:
         """n pages, or None (state unchanged) when fewer than n are free."""
         if n < 0:
             raise ValueError(f"alloc({n})")
+        if self._fault_hook is not None:
+            ctx = {"force_none": False, "n": n}
+            self._fault_hook("alloc", ctx)
+            if ctx["force_none"]:
+                return None          # injected exhaustion: state unchanged
         if n > len(self._free):
             return None
         pages = [self._free.popleft() for _ in range(n)]

@@ -20,7 +20,7 @@ request mix churns.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -61,6 +61,11 @@ class Scheduler:
 
     def free_slot_indices(self) -> List[int]:
         return [i for i, s in enumerate(self.slots) if s is None]
+
+    def seated(self) -> List[Tuple[int, Slot]]:
+        """(index, slot) of every occupied slot — snapshot list, safe to
+        retire slots while iterating (the reap/recovery paths do)."""
+        return [(i, s) for i, s in enumerate(self.slots) if s is not None]
 
     def active_mask(self) -> np.ndarray:
         return np.array([s is not None for s in self.slots], bool)
@@ -109,6 +114,15 @@ class Scheduler:
         self.slots[idx] = None
         self.tables[idx] = NULL_PAGE
         self.positions[idx] = 0
+
+    def reset_mirrors(self):
+        """Re-derive the host mirrors from the slot list (engine recovery:
+        after every implicated slot is retired, the mirrors must encode
+        exactly the inactive-slot pattern the fresh pool expects)."""
+        assert all(s is None for s in self.slots), \
+            "reset_mirrors with seated requests would corrupt their tables"
+        self.tables[:] = NULL_PAGE
+        self.positions[:] = 0
 
     def advance(self, idx: int, n: int = 1):
         """Record ``n`` more tokens written into slot ``idx``."""

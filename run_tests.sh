@@ -16,6 +16,12 @@
 # batching must stay retrace-free, match single-shot generate(), and keep
 # block accounting sound under pool backpressure; see docs/serving.md).
 # PADDLE_TPU_SKIP_SERVING_GATE=1 skips it.
+#
+# A serving fault-containment gate runs fourth (tools/serving_fault_gate.py
+# — injected step crashes/stalls/NaN logits/pool exhaustion must fail only
+# the implicated requests, keep page accounting exact, and preserve greedy
+# parity for every survivor; see docs/serving.md "Failure model & SLOs").
+# PADDLE_TPU_SKIP_FAULT_GATE=1 skips it.
 export JAX_PLATFORMS=cpu
 export PYTHONPATH=$(python - << 'PY'
 import os
@@ -48,6 +54,15 @@ if [ -z "$PADDLE_TPU_SKIP_SERVING_GATE" ]; then
     python "$(dirname "$0")/tools/serving_bench.py" --gate || {
         rc=$?
         echo "run_tests: serving gate FAILED (rc=$rc)"
+        exit $rc
+    }
+fi
+
+if [ -z "$PADDLE_TPU_SKIP_FAULT_GATE" ]; then
+    echo "run_tests: serving fault gate (tools/serving_fault_gate.py)"
+    python "$(dirname "$0")/tools/serving_fault_gate.py" || {
+        rc=$?
+        echo "run_tests: serving fault gate FAILED (rc=$rc)"
         exit $rc
     }
 fi

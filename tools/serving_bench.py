@@ -22,7 +22,20 @@ skips): a fast correctness gate in the crash/lint-gate mold —
   - block accounting must close: peak pages <= capacity, 0 in use at the
     end, backpressure observed (the pool is sized to force it).
 
-Exit codes: 0 ok, 1 gate/bench failure.
+Chaos mode (--chaos): drives the engine at a fixed offered load while
+serving/faults.py injects step crashes, NaN logits, and allocator
+exhaustion mid-run (and one stall when the watchdog is armed).  Prints
+one JSON line per measurement window:
+
+  {"metric": "serving_chaos", "window": "before|during|after",
+   "tokens_per_sec": ..., "recoveries": ..., "failed": ...}
+
+and asserts the degradation is GRACEFUL: the engine never dies, the
+"after" window recovers to a healthy fraction of the "before" throughput,
+every request reaches a typed terminal state, and page accounting closes
+exactly.  Exit 1 when recovery or accounting fails.
+
+Exit codes: 0 ok, 1 gate/bench/chaos failure.
 """
 from __future__ import annotations
 
@@ -190,16 +203,125 @@ def gate() -> int:
     return 0
 
 
+def chaos(n_requests: int = 36) -> int:
+    """Three offered-load phases through ONE engine — healthy, fault
+    storm, recovered — asserting throughput degrades gracefully under the
+    storm and RECOVERS after it, with exact page accounting throughout."""
+    import time as _time
+
+    import jax
+
+    from paddle_tpu.serving import FaultInjector, RequestState, ServingEngine
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    model, cfg, kw, prompt_lens, max_new = _build(on_tpu)
+    kw = dict(kw, stall_budget_s=2.0 if not on_tpu else 10.0)
+    rng = np.random.RandomState(0)
+    per_phase = max(n_requests // 3, 8)
+    eng = ServingEngine(model, **kw)
+    eng.submit(rng.randint(0, cfg.vocab_size, (prompt_lens[0],)), 2)
+    eng.run_until_idle()                         # warmup compiles
+
+    def run_phase(label):
+        prompts = [rng.randint(0, cfg.vocab_size,
+                               (prompt_lens[i % len(prompt_lens)],))
+                   for i in range(per_phase)]
+        reqs, it, steps = [], iter(prompts), 0
+        t0 = _time.perf_counter()
+        while len(reqs) < per_phase or eng.queue.depth \
+                or eng.scheduler.active_slots:
+            for _ in range(2):
+                try:
+                    reqs.append(eng.submit(next(it), max_new))
+                except StopIteration:
+                    break
+            met = eng.step()
+            steps += 1
+            if met["pages_used"] > eng.allocator.capacity:
+                raise AssertionError("pool over capacity")
+            if steps > 100000:
+                raise AssertionError("no progress")
+            if not met["active_slots"] and not met["tokens_this_step"]:
+                _time.sleep(0.001)               # post-recovery backoff
+        dt = _time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in reqs)
+        mets = eng.metrics()
+        rate = toks / dt if dt > 0 else 0.0
+        print(json.dumps({
+            "metric": "serving_chaos", "window": label,
+            "tokens_per_sec": round(rate, 1), "seconds": round(dt, 3),
+            "completed": sum(r.state == RequestState.DONE for r in reqs),
+            "requests": len(reqs),
+            "recoveries": mets["recoveries"], "failed": mets["failed"],
+            "quarantined": mets["quarantined"],
+            "platform": "tpu" if on_tpu else "cpu",
+        }))
+        sys.stdout.flush()
+        if not all(r.terminal for r in reqs):
+            raise AssertionError("non-terminal request after drain")
+        if eng.allocator.used_pages != 0:
+            raise AssertionError(
+                f"{eng.allocator.used_pages} pages leaked")
+        return rate
+
+    try:
+        healthy = run_phase("before")
+        # the storm: crashes (transient + persistent), a NaN slot, an
+        # exhaustion window, one stall that trips the watchdog + rebuild
+        inj = FaultInjector()
+        inj.inject("before_decode", at=2, kind="step_exception")
+        inj.inject("before_decode", at=6, kind="step_exception", times=2)
+        inj.inject("after_decode", at=10, kind="nan_logits", slots=[0])
+        inj.inject("alloc", at=2, times=4, kind="alloc_exhausted")
+        inj.inject("before_decode", at=14, kind="step_stall",
+                   duration=kw["stall_budget_s"] * 2)
+        inj.install(eng)
+        stormy = run_phase("during")
+        # storm over: occurrence-keyed plans are all exhausted; detach
+        eng._fault_hook = None
+        eng.allocator._fault_hook = None
+        # the stall-triggered rebuild recompiled the step programs; pay
+        # that compile in a warmup drain (as at engine start) so "after"
+        # measures the recovered STEADY STATE, not one compile
+        eng.submit(rng.randint(0, cfg.vocab_size, (prompt_lens[0],)), 2)
+        eng.run_until_idle()
+        recovered = run_phase("after")
+    except AssertionError as e:
+        print(f"serving_chaos: FAIL {e}")
+        return 1
+    mets = eng.metrics()
+    if mets["recoveries"] < 1 or mets["rebuilds"] < 1:
+        print("serving_chaos: FAIL the storm never forced a "
+              f"recovery/rebuild ({mets['recoveries']}/{mets['rebuilds']})")
+        return 1
+    if recovered < 0.5 * healthy:
+        print(f"serving_chaos: FAIL no recovery: after={recovered:.1f} "
+              f"vs before={healthy:.1f} tok/s")
+        return 1
+    print(f"serving_chaos: OK (failed={mets['failed']} "
+          f"recoveries={mets['recoveries']} rebuilds={mets['rebuilds']}; "
+          f"before/during/after = {healthy:.1f}/{stormy:.1f}/"
+          f"{recovered:.1f} tok/s)")
+    eng.close()
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--gate", action="store_true",
                     help="fast CI correctness gate (run_tests.sh)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="fault storm under offered load: assert graceful "
+                         "degradation + recovery")
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--loads", type=str, default="0.5,1,2,4",
                     help="comma-separated offered loads (requests/step)")
     args = ap.parse_args()
     if args.gate:
         return gate()
+    if args.chaos:
+        return chaos(max(args.requests, 36) if args.requests != 24
+                     else 36)
     return sweep(tuple(float(x) for x in args.loads.split(",")),
                  args.requests)
 

@@ -226,6 +226,51 @@ def main() -> int:
         finally:
             shutil.rmtree(d, ignore_errors=True)
 
+    # -- serving fault containment ON-CHIP: one injected step failure must
+    # fail only the seated requests, recovery must rebuild the REAL paged
+    # pool (fresh HBM, recompiled Mosaic step), and the queued remainder
+    # must finish token-for-token equal to single-shot generate() ---------
+    def serving_faults():
+        import paddle_tpu as pt
+        from paddle_tpu.models import GPTForPretraining, gpt_tiny
+        from paddle_tpu.serving import (
+            FaultInjector, RequestState, ServingEngine,
+        )
+
+        pt.seed(0)
+        cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+        m = GPTForPretraining(cfg)
+        m.eval()
+        srng = np.random.RandomState(5)
+        prompts = [srng.randint(0, cfg.vocab_size, (s,))
+                   for s in (6, 11, 9, 14)]
+        refs = [np.asarray(
+            m.generate(pt.to_tensor(p[None, :], dtype="int64"),
+                       max_new_tokens=4, max_seq_len=128,
+                       cache_dtype="bfloat16").numpy())[0]
+            for p in prompts]
+        eng = ServingEngine(m, num_slots=2, page_size=128, max_context=128,
+                            cache_dtype="bfloat16")
+        # a persistent (retry-defeating) mid-dispatch crash: recovery must
+        # rebuild the on-chip pool and keep serving
+        FaultInjector().inject("before_decode", at=1, times=2,
+                               kind="step_exception",
+                               state_intact=False).install(eng)
+        reqs = [eng.submit(p, 4) for p in prompts]
+        eng.run_until_idle(max_steps=500)
+        mets = eng.metrics()
+        assert mets["recoveries"] == 1 and mets["rebuilds"] == 1, mets
+        done = [r for r in reqs if r.state == RequestState.DONE]
+        failed = [r for r in reqs if r.state == RequestState.FAILED]
+        assert len(done) == 2 and len(failed) == 2, \
+            [r.state for r in reqs]
+        for r, ref in zip(reqs, refs):
+            if r.state == RequestState.DONE:
+                assert np.array_equal(r.output_ids(), ref), \
+                    f"survivor {r.id} diverged after on-chip recovery"
+        assert eng.allocator.used_pages == 0, "pages leaked on-chip"
+        eng.close()
+
     check("flash_attention", flash)
     check("decode_attention", decode_attention)
     check("paged_attention", paged_attention)
@@ -233,6 +278,7 @@ def main() -> int:
     check("rms_norm", rms_norm)
     check("graph_lint", graph_lint)
     check("checkpoint", checkpoint)
+    check("serving_faults", serving_faults)
 
     if failures:
         print(f"tpu_smoke: FAILED: {failures}")
