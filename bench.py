@@ -79,29 +79,52 @@ def _emit(metric, value, unit, vs_baseline):
     sys.stdout.flush()
 
 
-def _peak_flops_per_chip(device_kind: str) -> float:
-    """bf16 peak FLOP/s by TPU generation (public spec sheet numbers).
+def _chip_spec(device_kind: str):
+    """HardwareSpec (bf16 peak FLOP/s + HBM BW) by TPU generation — ONE
+    table, owned by analysis/cost_model.py, so the MFU denominator and the
+    roofline-fraction denominator can't drift apart.  device_kind strings
+    vary ('TPU v5', 'TPU v5 lite', 'TPU v5p', ...); the PALLAS_AXON_TPU_GEN
+    env override wins.  Child-only (imports paddle_tpu)."""
+    from paddle_tpu.analysis import chip_spec
 
-    device_kind strings vary ('TPU v5', 'TPU v5 lite', 'TPU v5p', ...);
-    'lite' marks the e-class parts, bare v5 is v5p-class."""
-    gen = (os.environ.get("PALLAS_AXON_TPU_GEN", "") or "").lower()
-    kind = (device_kind or "").lower()
-    for probe in (gen, kind):
-        if not probe:
-            continue
-        if "v6" in probe:
-            return 918e12
-        if "v5e" in probe or ("v5" in probe and "lite" in probe):
-            return 197e12
-        if "v5" in probe:
-            return 459e12
-        if "v4" in probe:
-            return 275e12
-        if "v3" in probe:
-            return 123e12
-        if "v2" in probe:
-            return 45e12
-    return 197e12  # conservative default (v5e class)
+    return chip_spec(os.environ.get("PALLAS_AXON_TPU_GEN", "") or "",
+                     device_kind or "")
+
+
+def _peak_flops_per_chip(device_kind: str) -> float:
+    return _chip_spec(device_kind).peak_flops
+
+
+def _emit_roofline(phase, name, cost_reports_with_counts, spec, seconds,
+                   on_tpu):
+    """One ``*_roofline_fraction`` line: achieved FLOP/s over roofline-
+    attainable FLOP/s for the phase's compiled program(s), from the static
+    cost model (FLAGS_graph_cost) + the measured wall time.  Makes the MFU
+    gap attributable per program: a low fraction on a memory-bound program
+    means the gap is HBM streaming, not MXU idling."""
+    try:
+        flops = sum(c.flops * n for c, n in cost_reports_with_counts)
+        nbytes = sum(c.bytes_upper * n for c, n in cost_reports_with_counts)
+        if not flops or seconds <= 0:
+            return
+        intensity = flops / max(nbytes, 1)
+        attainable = spec.attainable_flops(intensity)
+        frac = (flops / seconds) / attainable
+        progs = ",".join(f"{c.program}x{n}"
+                         for c, n in cost_reports_with_counts)
+        _emit(
+            f"gpt_{name}_{phase}_roofline_fraction",
+            round(frac, 4),
+            f"frac (programs={progs} gflop={flops / 1e9:.1f} "
+            f"hbm_mib={nbytes / 2**20:.0f} intensity={intensity:.1f} "
+            f"bound={'compute' if intensity >= spec.ridge else 'memory'} "
+            f"attainable={attainable / 1e12:.1f}e12 chip={spec.name} "
+            f"on {'tpu' if on_tpu else 'cpu'})",
+            0.0,
+        )
+    except Exception as e:  # noqa: BLE001 — a cost line must never kill a metric
+        sys.stderr.write(f"bench: roofline line ({phase}) failed: "
+                         f"{type(e).__name__}: {str(e)[:300]}\n")
 
 
 # ---------------------------------------------------------------------------
@@ -347,8 +370,14 @@ def main():
     import jax
 
     import paddle_tpu as pt
+    from paddle_tpu import analysis
     from paddle_tpu.core import memory as pt_memory
     from paddle_tpu.models import GPTStackedForPretraining, gpt_1p3b, gpt_small
+
+    # static roofline cost reports for every compiled program (one extra
+    # abstract trace per compile, zero compute): the *_roofline_fraction
+    # lines below attribute the MFU gap per program
+    pt.set_flags({"FLAGS_graph_cost": True})
 
     devs = jax.devices()
     on_tpu = devs[0].platform != "cpu"
@@ -459,7 +488,8 @@ def main():
     flops_per_iter = 72 * batch * seq * L * h * h * (1 + seq / (6 * h) + V / (12 * L * h))
     model_flops_per_sec = flops_per_iter * steps / dt
     kind = getattr(devs[0], "device_kind", "")
-    peak = _peak_flops_per_chip(kind)
+    spec = _chip_spec(kind)
+    peak = spec.peak_flops
     mfu = model_flops_per_sec / peak
     hbm = os.environ.get("BENCH_HBM_GIB", "?")
 
@@ -475,6 +505,10 @@ def main():
         f"on {'tpu' if on_tpu else 'cpu'})",
         round(mfu / 0.45, 4),
     )
+    train_costs = train_step.cost_reports()
+    if train_costs:
+        _emit_roofline("train", name, [(train_costs[0], steps)], spec, dt,
+                       on_tpu)
 
     # ---- decode (serving) metric: prefill + autoregressive decode over the
     # donated KV cache, same ladder model.  Two compiled programs total
@@ -493,7 +527,10 @@ def main():
     prompt = pt.to_tensor(
         rng.randint(0, cfg.vocab_size, (dec_bs, prompt_len)), dtype="int64")
     try:
-        # warmup compiles prefill + decode; the timed call reuses both
+        # warmup compiles prefill + decode; the timed call reuses both.
+        # cost registry cleared first so this phase's reports are
+        # unambiguously the decode engine's (names repeat across phases)
+        analysis.clear_cost_reports()
         model.generate(prompt, max_new_tokens=2, max_seq_len=max_seq_cache,
                        cache_dtype="bfloat16")
         mem_before = pt_memory.memory_allocated()
@@ -519,6 +556,12 @@ def main():
             f"traces={tc} on {'tpu' if on_tpu else 'cpu'})",
             0.0,
         )
+        dec_costs = {c.program: c for c in analysis.cost_reports()}
+        pairs = [(c, n) for c, n in (
+            (dec_costs.get("prefill_step"), 1),
+            (dec_costs.get("decode_step"), max(new_tokens - 1, 1)),
+        ) if c is not None]
+        _emit_roofline("decode", name, pairs, spec, dec_dt, on_tpu)
     except Exception as e:  # noqa: BLE001 — decode must not kill the train metric
         sys.stderr.write(f"bench: decode bench failed: {type(e).__name__}: "
                          f"{str(e)[:500]}\n")
@@ -544,10 +587,12 @@ def main():
                         cache_dtype="bfloat16")
             s_new, n_req, plens = 4, 4, (8, 20, 12, 16)
         reset_serve_trace_counts()
+        analysis.clear_cost_reports()  # this phase's programs only
         eng = ServingEngine(model, **s_kw)
         # warmup compiles prefill + decode; the timed run reuses both
         eng.submit(rng.randint(0, cfg.vocab_size, (plens[0],)), 2)
         eng.run_until_idle()
+        m0 = eng.metrics()
         mem_before = pt_memory.memory_allocated()
         t0 = time.perf_counter()
         s_reqs = [eng.submit(
@@ -571,6 +616,18 @@ def main():
             f"traces={tc} on {'tpu' if on_tpu else 'cpu'})",
             0.0,
         )
+        srv_costs = {c.program: c for c in analysis.cost_reports()}
+        # exact invocation counts from the engine's own counters: every
+        # prefill CHUNK runs one prefill_step (multi-chunk prompts run
+        # several), and decode_steps counts actual decode dispatches
+        # (idle/recovery ticks don't run the program)
+        pairs = [(c, n) for c, n in (
+            (srv_costs.get("prefill_step"),
+             max(int(mets["prefill_chunks"] - m0["prefill_chunks"]), 1)),
+            (srv_costs.get("decode_step"),
+             max(int(mets["decode_steps"] - m0["decode_steps"]), 1)),
+        ) if c is not None]
+        _emit_roofline("serving", name, pairs, spec, s_dt, on_tpu)
         eng.close()
     except Exception as e:  # noqa: BLE001 — serving must not kill prior metrics
         sys.stderr.write(f"bench: serving bench failed: {type(e).__name__}: "

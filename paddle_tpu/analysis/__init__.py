@@ -6,6 +6,7 @@ provenance; ``FLAGS_graph_lint`` / ``PADDLE_TPU_GRAPH_LINT=1`` lints every
 ``jit.to_static`` program at compile time; ``tools/graph_lint.py`` is the
 CI gate over the bench models.  See docs/graph_lint.md.
 """
+from . import autotune  # noqa: F401
 from .codes import (  # noqa: F401
     CODES,
     SEVERITY_RANK,
@@ -13,6 +14,19 @@ from .codes import (  # noqa: F401
     decode_gate_reason,
     flash_gate_reason,
     misaligned_dims,
+    padded_shape,
+    padding_waste_elems,
+)
+from .cost_model import (  # noqa: F401
+    CostReport,
+    EqnCost,
+    HardwareSpec,
+    chip_spec,
+    clear_cost_reports,
+    cost,
+    cost_jaxpr,
+    cost_reports,
+    cost_static_program,
 )
 from .graph_lint import (  # noqa: F401
     Baseline,
@@ -30,7 +44,11 @@ from .graph_lint import (  # noqa: F401
 
 __all__ = [
     "CODES", "SEVERITY_RANK", "GateReason", "decode_gate_reason",
-    "flash_gate_reason", "misaligned_dims",
+    "flash_gate_reason", "misaligned_dims", "padded_shape",
+    "padding_waste_elems",
+    "CostReport", "EqnCost", "HardwareSpec", "chip_spec",
+    "clear_cost_reports", "cost", "cost_jaxpr", "cost_reports",
+    "cost_static_program", "autotune",
     "Baseline", "Finding", "LintConfig", "LintReport", "churn_findings",
     "clear_reports", "lint", "lint_jaxpr", "lint_static_program", "reports",
     "set_announce",

@@ -1,0 +1,448 @@
+"""Measured-cost autotuner for the owned Pallas kernels.
+
+The flash / decode / paged attention kernels used to hard-code their block
+shapes (`codes.default_block`: largest 128-multiple divisor up to 512).  This
+module graduates that guess into a search:
+
+1. **Static candidate enumeration** — for a (kernel, shape, dtype) key,
+   enumerate every *legal* configuration from the shared tile rules in
+   ``analysis/codes.py`` (block sizes must be 128-multiple divisors of the
+   sequence axis, query sublane rows must be 8-multiples) filtered by a
+   static VMEM-footprint estimate.  Pure analysis — runs identically on
+   CPU, never touches a device.
+2. **Measured sweep** (TPU only) — time each candidate once on-device
+   (``sweep``; the caller provides the timing closure) and persist the
+   winner in a shape-keyed table.
+3. **Dispatch** — kernels ask :func:`kernel_params` at call sites; a table
+   hit returns the tuned config, a miss falls back to the historical
+   hard-coded choice.  Explicit ``FLAGS_flash_block_*`` overrides still
+   win over the table (user > tuner > default).
+
+The table key discipline mirrors ``core/op_cache``: the key is the full
+shape/dtype signature the kernel specializes on (``seq``/``max_seq``/
+``page_size`` + ``head_dim`` + dtype name), so a lookup can never apply a
+config tuned for a different specialization.  The table persists as JSON
+(default: ``analysis/autotune_table.json`` next to this module, override
+with ``PADDLE_TPU_AUTOTUNE_TABLE``) and **loads in validated replay
+mode**: every entry is re-checked against the *current* static gates at
+load time and entries that are no longer legal (rule changes, corrupted
+files) are dropped with a warning — CI validates, it never times.
+``tools/autotune.py --validate`` is the strict version (exit 1 on any
+invalid entry), wired into run_tests.sh; the sweep itself runs via
+``tools/autotune.py`` on a TPU host and ``tools/tpu_smoke.py``'s autotune
+case.  See docs/graph_lint.md "v2: autotuner".
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .codes import (TILE_LANE, TILE_SUBLANE, decode_gate_reason,
+                    default_block as _auto_block, flash_gate_reason,
+                    paged_gate_reason)
+
+__all__ = [
+    "KERNELS", "enumerate_candidates", "default_params", "static_rank",
+    "vmem_bytes_estimate", "table_key", "AutotuneTable", "table_path",
+    "load_table", "reset", "kernel_params", "force", "set_entry",
+    "validate_table", "sweep",
+]
+
+KERNELS = ("flash_attention", "decode_attention", "paged_attention")
+
+# static VMEM budget for candidate filtering: ~16 MiB/core physical, keep
+# headroom for Mosaic's own buffers and semaphores
+VMEM_BUDGET = 10 << 20
+
+_Q_ROWS_CHOICES = (8, 16)  # query sublane-broadcast rows (8-multiples)
+
+
+def _itemsize(dtype: str) -> int:
+    return {"bfloat16": 2, "float16": 2, "float32": 4, "bf16": 2,
+            "f32": 4, "fp16": 2}.get(str(dtype), 4)
+
+
+def _dtype_key(dtype) -> str:
+    """Canonical dtype token for table keys ('bfloat16', 'float32')."""
+    import numpy as np
+
+    try:
+        return np.dtype(dtype).name
+    except TypeError:
+        return str(dtype)
+
+
+def _legal_blocks(seq: int, cap: int = 1024) -> List[int]:
+    """128-multiple divisors of ``seq`` up to ``cap`` — the block sizes
+    the kernels' KV/Q blocking accepts (same rule the GL002 gates
+    encode)."""
+    seq = int(seq)
+    return [b for b in range(TILE_LANE, min(seq, cap) + 1, TILE_LANE)
+            if seq % b == 0]
+
+
+# ---------------------------------------------------------------------------
+# static VMEM footprint (per kernel, per candidate)
+# ---------------------------------------------------------------------------
+
+def vmem_bytes_estimate(kernel: str, shape: Dict[str, int], dtype: str,
+                        params: Dict[str, int]) -> int:
+    """Rough static VMEM footprint of one candidate: resident input/output
+    blocks (double-buffered — Pallas pipelines the DMA) plus the fp32
+    scratch accumulators.  Deliberately conservative; its job is to reject
+    candidates that cannot fit, not to model occupancy."""
+    it = _itemsize(dtype)
+    d = int(shape["head_dim"])
+    if kernel == "flash_attention":
+        bq = int(params["block_q"])
+        bkv = int(params["block_kv"])
+        # fwd: q,o (bq·d), k,v (bkv·d), lse (8·bq); scratch acc bq·d + 2·bq·128
+        fwd = 2 * ((2 * bq * d + 2 * bkv * d + 8 * bq) * it)
+        fwd += (bq * d + 2 * bq * 128) * 4
+        # bwd(dkv): q,do (bq·d), k,v (bkv·d), dk,dv out (bkv·d), lse+delta
+        bwd = 2 * ((2 * bq * d + 4 * bkv * d + 16 * bq) * it)
+        bwd += 2 * bkv * d * 4
+        return max(fwd, bwd)
+    if kernel == "decode_attention":
+        qr = int(params.get("q_rows", 8))
+        bkv = int(params["block_kv"])
+        est = 2 * ((2 * qr * d + 2 * bkv * d) * it)
+        est += (qr * d + 2 * qr * 128) * 4
+        return est
+    if kernel == "paged_attention":
+        qr = int(params.get("q_rows", 8))
+        ps = int(shape["page_size"])
+        est = 2 * ((2 * qr * d + 2 * ps * d) * it)
+        est += (qr * d + 2 * qr * 128) * 4
+        return est
+    raise ValueError(f"unknown kernel {kernel!r} (expected one of {KERNELS})")
+
+
+# ---------------------------------------------------------------------------
+# candidate enumeration (pure static analysis)
+# ---------------------------------------------------------------------------
+
+def enumerate_candidates(kernel: str, shape: Dict[str, int],
+                         dtype: str) -> List[Dict[str, int]]:
+    """Every legal configuration for (kernel, shape, dtype), from the
+    shared tile rules + the VMEM estimate.  Empty when the kernel's own
+    eligibility gate rejects the shape (then there is nothing to tune —
+    the kernel would fall back to XLA anyway)."""
+    d = int(shape["head_dim"])
+    out: List[Dict[str, int]] = []
+    if kernel == "flash_attention":
+        seq = int(shape["seq"])
+        if flash_gate_reason(seq, d) is not None:
+            return []
+        for bq in _legal_blocks(seq):
+            for bkv in _legal_blocks(seq):
+                out.append({"block_q": bq, "block_kv": bkv})
+    elif kernel == "decode_attention":
+        seq = int(shape["max_seq"])
+        if decode_gate_reason(seq, d) is not None:
+            return []
+        for bkv in _legal_blocks(seq):
+            for qr in _Q_ROWS_CHOICES:
+                out.append({"block_kv": bkv, "q_rows": qr})
+    elif kernel == "paged_attention":
+        ps = int(shape["page_size"])
+        if paged_gate_reason(ps, d) is not None:
+            return []
+        for qr in _Q_ROWS_CHOICES:
+            out.append({"q_rows": qr})
+    else:
+        raise ValueError(
+            f"unknown kernel {kernel!r} (expected one of {KERNELS})")
+    return [p for p in out
+            if vmem_bytes_estimate(kernel, shape, dtype, p) <= VMEM_BUDGET]
+
+
+def default_params(kernel: str, shape: Dict[str, int],
+                   dtype: str) -> Dict[str, int]:
+    """Today's hard-coded configuration — what the kernels pick with no
+    table entry.  Table misses fall back to exactly this."""
+    if kernel == "flash_attention":
+        b = _auto_block(int(shape["seq"]))
+        return {"block_q": b, "block_kv": b}
+    if kernel == "decode_attention":
+        return {"block_kv": _auto_block(int(shape["max_seq"])), "q_rows": 8}
+    if kernel == "paged_attention":
+        return {"q_rows": 8}
+    raise ValueError(f"unknown kernel {kernel!r} (expected one of {KERNELS})")
+
+
+def static_rank(kernel: str, shape: Dict[str, int], dtype: str,
+                candidates: Optional[List[Dict[str, int]]] = None
+                ) -> List[Dict[str, int]]:
+    """Candidates ordered best-first by a static cost estimate: per-grid-
+    step dispatch overhead (fewer, larger blocks win) with VMEM pressure
+    as the tie-breaker.  This is the *prior* a measured sweep starts from
+    — and the order ``tools/autotune.py --report`` prints; it never
+    replaces a measurement."""
+    cands = candidates if candidates is not None else enumerate_candidates(
+        kernel, shape, dtype)
+
+    def grid_steps(p: Dict[str, int]) -> int:
+        if kernel == "flash_attention":
+            seq = int(shape["seq"])
+            return (seq // p["block_q"]) * (seq // p["block_kv"])
+        if kernel == "decode_attention":
+            return int(shape["max_seq"]) // p["block_kv"]
+        return 1  # paged: the grid is fixed by max_pages
+
+    return sorted(cands, key=lambda p: (
+        grid_steps(p),
+        vmem_bytes_estimate(kernel, shape, dtype, p),
+        # deterministic final tie-break
+        tuple(sorted(p.items())),
+    ))
+
+
+# ---------------------------------------------------------------------------
+# the persisted table
+# ---------------------------------------------------------------------------
+
+def table_key(kernel: str, shape: Dict[str, int], dtype) -> str:
+    """Shape-keyed lookup key, op_cache discipline: the full specialization
+    signature, canonically ordered."""
+    dims = ",".join(f"{k}={int(v)}" for k, v in sorted(shape.items()))
+    return f"{kernel}|{dims}|{_dtype_key(dtype)}"
+
+
+class AutotuneTable:
+    """Shape-keyed winning configs.  Entries carry their provenance:
+    ``source="measured"`` (an on-device sweep, with ``measured_us``) or
+    ``source="static-default"`` (seeded from :func:`default_params` so
+    dispatch-through-the-table is exercised before any chip timed
+    anything)."""
+
+    VERSION = 1
+
+    def __init__(self):
+        self.entries: Dict[str, Dict[str, Any]] = {}
+
+    # -- mutation ----------------------------------------------------------
+    def put(self, kernel: str, shape: Dict[str, int], dtype, params,
+            measured_us: Optional[float] = None, source: str = "measured",
+            device: str = ""):
+        key = table_key(kernel, shape, dtype)
+        self.entries[key] = {
+            "kernel": kernel,
+            "shape": {k: int(v) for k, v in sorted(shape.items())},
+            "dtype": _dtype_key(dtype),
+            "params": {k: int(v) for k, v in sorted(params.items())},
+            "measured_us": (None if measured_us is None
+                            else round(float(measured_us), 3)),
+            "source": source,
+            "device": device,
+        }
+
+    def get(self, kernel: str, shape: Dict[str, int],
+            dtype) -> Optional[Dict[str, int]]:
+        e = self.entries.get(table_key(kernel, shape, dtype))
+        return dict(e["params"]) if e else None
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path: str):
+        data = {
+            "version": self.VERSION,
+            "entries": [self.entries[k] for k in sorted(self.entries)],
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    @classmethod
+    def load(cls, path: str) -> "AutotuneTable":
+        with open(path) as f:
+            data = json.load(f)
+        if data.get("version") != cls.VERSION:
+            raise ValueError(
+                f"autotune table {path}: unsupported version "
+                f"{data.get('version')}")
+        t = cls()
+        for e in data.get("entries", ()):
+            t.put(e["kernel"], e["shape"], e["dtype"], e["params"],
+                  measured_us=e.get("measured_us"),
+                  source=e.get("source", "measured"),
+                  device=e.get("device", ""))
+        return t
+
+
+def validate_table(table: AutotuneTable) -> List[str]:
+    """Replay validation: every entry's params must be in the CURRENT
+    static candidate set for its key.  Returns human-readable problems
+    (empty = valid).  Pure static analysis — no device, no timing."""
+    problems = []
+    for key, e in sorted(table.entries.items()):
+        try:
+            cands = enumerate_candidates(e["kernel"], e["shape"], e["dtype"])
+        except (ValueError, KeyError) as exc:
+            problems.append(f"{key}: unenumerable entry ({exc})")
+            continue
+        if not cands:
+            problems.append(
+                f"{key}: shape fails the kernel's eligibility gate — an "
+                "entry for it can never dispatch")
+        elif e["params"] not in cands:
+            problems.append(
+                f"{key}: params {e['params']} are not in the legal "
+                f"candidate set ({len(cands)} candidates)")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# process-wide dispatch state
+# ---------------------------------------------------------------------------
+
+_DEFAULT_TABLE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "autotune_table.json")
+
+
+def table_path() -> str:
+    return os.environ.get("PADDLE_TPU_AUTOTUNE_TABLE", _DEFAULT_TABLE)
+
+
+_lock = threading.RLock()
+_loaded: Optional[AutotuneTable] = None
+_load_failed = False
+_forced: Dict[str, Dict[str, int]] = {}  # kernel -> params (sweep probe)
+
+
+def load_table(path: Optional[str] = None,
+               strict: bool = False) -> AutotuneTable:
+    """Load + replay-validate the table at ``path`` (default:
+    :func:`table_path`).  Invalid entries are dropped with one stderr
+    warning (``strict=True`` raises instead — the CI gate).  A missing
+    file is an empty table."""
+    path = path or table_path()
+    if not os.path.exists(path):
+        return AutotuneTable()
+    table = AutotuneTable.load(path)
+    problems = validate_table(table)
+    if problems:
+        if strict:
+            raise ValueError(
+                f"autotune table {path}: {len(problems)} invalid entries:\n"
+                + "\n".join("  " + p for p in problems))
+        sys.stderr.write(
+            f"[paddle_tpu.autotune] {path}: dropping {len(problems)} "
+            "invalid entries (replay validation):\n"
+            + "".join(f"  {p}\n" for p in problems))
+        bad_keys = {p.split(":", 1)[0] for p in problems}
+        for k in bad_keys:
+            table.entries.pop(k, None)
+    return table
+
+
+def _table() -> AutotuneTable:
+    global _loaded, _load_failed
+    with _lock:
+        if _loaded is None:
+            try:
+                _loaded = load_table()
+            except Exception as e:  # noqa: BLE001 — a bad table must never
+                # break kernel dispatch; the kernels fall back to defaults
+                if not _load_failed:
+                    sys.stderr.write(
+                        f"[paddle_tpu.autotune] failed to load "
+                        f"{table_path()}: {type(e).__name__}: {e}; kernels "
+                        "use their hard-coded defaults\n")
+                _load_failed = True
+                _loaded = AutotuneTable()
+        return _loaded
+
+
+def reset():
+    """Drop the loaded table (and any forced params) so the next lookup
+    reloads from disk — tests point PADDLE_TPU_AUTOTUNE_TABLE at fixtures
+    and call this."""
+    global _loaded, _load_failed
+    with _lock:
+        _loaded = None
+        _load_failed = False
+        _forced.clear()
+
+
+def set_entry(kernel: str, shape: Dict[str, int], dtype, params,
+              **meta):
+    """Insert an entry into the LIVE table (not persisted) — the sweep and
+    tests use this; ``AutotuneTable.save`` persists."""
+    with _lock:
+        _table().put(kernel, shape, dtype, params, **meta)
+
+
+@contextlib.contextmanager
+def force(kernel: str, params: Dict[str, int]):
+    """Force ``kernel`` to use ``params`` inside the context — how the
+    sweep times one candidate through the kernels' public entry points.
+    Wins over the table; loses to explicit FLAGS overrides (a user pin
+    must beat the tuner)."""
+    with _lock:
+        prev = _forced.get(kernel)
+        _forced[kernel] = dict(params)
+    try:
+        yield
+    finally:
+        with _lock:
+            if prev is None:
+                _forced.pop(kernel, None)
+            else:
+                _forced[kernel] = prev
+
+
+def kernel_params(kernel: str, shape: Dict[str, int],
+                  dtype) -> Optional[Dict[str, int]]:
+    """The dispatch-time lookup the kernels call: forced params (sweep
+    probe) > persisted table entry > ``None`` (kernel falls back to its
+    hard-coded default).  Entries were replay-validated at load."""
+    with _lock:
+        f = _forced.get(kernel)
+        if f is not None:
+            return dict(f)
+    return _table().get(kernel, shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# the measured sweep (orchestration only; callers own the timing closure)
+# ---------------------------------------------------------------------------
+
+def sweep(kernel: str, shape: Dict[str, int], dtype,
+          timing_fn: Callable[[Dict[str, int]], float],
+          table: Optional[AutotuneTable] = None,
+          device: str = "") -> Tuple[Optional[Dict[str, int]],
+                                     List[Tuple[Dict[str, int], float]]]:
+    """Time every legal candidate once and record the winner.
+
+    ``timing_fn(params) -> seconds`` runs the kernel with ``params``
+    forced (use :func:`force`) and returns one measured execution; a
+    candidate whose timing raises is skipped (some configs die in Mosaic
+    for reasons no static model sees — that is *why* this is measured).
+    Returns ``(winner_params_or_None, [(params, seconds|inf), ...])`` and
+    records the winner in ``table`` (default: the live dispatch table).
+    """
+    results: List[Tuple[Dict[str, int], float]] = []
+    for params in static_rank(kernel, shape, dtype):
+        try:
+            seconds = float(timing_fn(params))
+        except Exception as e:  # noqa: BLE001 — a dead candidate is data
+            sys.stderr.write(
+                f"[paddle_tpu.autotune] {kernel} {params}: candidate "
+                f"failed ({type(e).__name__}: {str(e)[:200]})\n")
+            seconds = float("inf")
+        results.append((params, seconds))
+    timed = [(p, s) for p, s in results if s != float("inf")]
+    if not timed:
+        return None, results
+    winner, best = min(timed, key=lambda ps: ps[1])
+    tgt = table if table is not None else _table()
+    with _lock:
+        tgt.put(kernel, shape, dtype, winner, measured_us=best * 1e6,
+                source="measured", device=device)
+    return dict(winner), results

@@ -17,7 +17,8 @@ from typing import List, Optional, Tuple
 
 __all__ = [
     "CODES", "SEVERITY_RANK", "TILE_SUBLANE", "TILE_LANE",
-    "misaligned_dims", "GateReason", "flash_gate_reason",
+    "misaligned_dims", "padded_shape", "padding_waste_elems",
+    "default_block", "GateReason", "flash_gate_reason",
     "decode_gate_reason", "paged_gate_reason",
 ]
 
@@ -58,6 +59,57 @@ def misaligned_dims(shape) -> List[Tuple[int, int, int]]:
         if d > TILE_SUBLANE and d % TILE_SUBLANE:
             out.append((n - 2, d, TILE_SUBLANE))
     return out
+
+
+def _ceil_to(d: int, m: int) -> int:
+    return -(-int(d) // m) * m
+
+
+def default_block(s: int, cap: int = 512) -> int:
+    """The historical hard-coded block choice shared by every Pallas
+    kernel's no-table fallback AND the autotuner's seeded defaults
+    (``autotune.default_params`` / ``tools/autotune.py --seed``): halve
+    ``min(cap, s)`` until it divides ``s``, then floor at 128 when 128
+    still divides.  ONE implementation so a tuned fallback can't drift
+    from what the seeded table entries record."""
+    s = int(s)
+    b = min(cap, s)
+    while s % b:
+        b //= 2
+    return max(b, 128) if s % max(b, 128) == 0 else b
+
+
+def padded_shape(shape) -> Tuple[int, ...]:
+    """The (8, 128)-tile-padded layout shape the TPU actually materializes
+    for ``shape``: last dim rounded up to a lane multiple (128), second-
+    minor rounded up to a sublane multiple (8).  Scalars/empty shapes are
+    returned unchanged.  Shared by the GL002 cost annotation and the
+    roofline cost model (`analysis/cost_model.py`) so "padding waste" means one
+    thing everywhere."""
+    shape = tuple(int(d) for d in shape)
+    if not shape:
+        return shape
+    out = list(shape)
+    if out[-1] > 0:
+        out[-1] = _ceil_to(out[-1], TILE_LANE)
+    if len(out) >= 2 and out[-2] > 0:
+        out[-2] = _ceil_to(out[-2], TILE_SUBLANE)
+    return tuple(out)
+
+
+def padding_waste_elems(shape) -> int:
+    """Elements of pure tile padding in ``shape``'s physical layout:
+    prod(padded_shape) - prod(shape).  Multiply by the dtype's itemsize
+    for bytes."""
+    shape = tuple(int(d) for d in shape)
+    if not shape:
+        return 0
+    n = 1
+    p = 1
+    for d, pd in zip(shape, padded_shape(shape)):
+        n *= d
+        p *= pd
+    return max(p - n, 0)
 
 
 class GateReason:

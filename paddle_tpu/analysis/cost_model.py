@@ -1,0 +1,496 @@
+"""Static roofline cost model over jaxprs (Graph Lint v2).
+
+`graph_lint.py` tells you a program contains a hazard; this module tells
+you what the hazard *costs*.  It walks the same jaxprs (recursing into
+pjit/scan/cond/while/custom-vjp sub-jaxprs) and computes, per equation and
+per program:
+
+- **FLOPs** — exact for ``dot_general``/``conv_general_dilated`` (2·N·K
+  from the contraction dims), element-count heuristics elsewhere (1
+  flop/output element for arithmetic, 1 flop/input element for
+  reductions, 0 for pure data movement);
+- **HBM bytes** — two bounds, because fusion is unknowable statically:
+  ``bytes_upper`` sums every equation's operand+result bytes (the
+  nothing-fuses bound) and ``boundary_bytes`` counts only the program's
+  inputs+outputs (the everything-fuses bound).  The truth sits between;
+  the roofline verdict uses the upper bound (conservative attainable);
+- **arithmetic intensity** — FLOPs / HBM bytes, against a per-chip
+  :class:`HardwareSpec` (peak bf16 FLOP/s + HBM bandwidth) so a program
+  classifies compute-bound vs memory-bound and a *measured* wall time
+  turns into a roofline fraction (bench.py's ``*_roofline_fraction``
+  lines);
+- **(8, 128)-tile padding waste** — for every dot/reduce operand, the
+  bytes the physical layout spends on partial tiles
+  (``codes.padding_waste_elems``, the same rule GL002 fires on).
+
+Loop handling: ``scan`` bodies are multiplied by their trip count;
+``while`` bodies count once and set :attr:`CostReport.has_unbounded_loops`
+(the static model cannot bound them); ``cond`` takes its most expensive
+branch.  Equations that carry sub-jaxprs contribute ONLY their bodies
+(counting both the call eqn's operands and the body would double-count).
+
+Entry points mirror the linter: :func:`cost` traces a function
+abstractly, :func:`cost_jaxpr` takes a ClosedJaxpr,
+:func:`cost_static_program` costs one ``jit.to_static`` entry (the
+``FLAGS_graph_cost`` compile hook in ``jit/api.py`` calls it and stashes
+the report on the entry + the :func:`cost_reports` registry).  The CLI is
+``tools/graph_lint.py --cost``.  See docs/graph_lint.md "v2: cost model".
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .codes import padding_waste_elems
+
+from .graph_lint import (  # shared jaxpr plumbing — one walker idiom
+    _CLOSED_JAXPR,
+    _aval,
+    _dtype_of,
+    _fmt_aval,
+    _nbytes,
+    _provenance,
+    _shape_of,
+    _sub_jaxprs,
+)
+
+__all__ = [
+    "HardwareSpec", "chip_spec", "EqnCost", "CostReport",
+    "cost", "cost_jaxpr", "cost_static_program",
+    "cost_reports", "clear_cost_reports",
+    "dot_flops", "eqn_flops",
+]
+
+
+# ---------------------------------------------------------------------------
+# hardware specs (public spec-sheet numbers; bench.py routes through these
+# so the MFU and roofline denominators can't drift apart)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class HardwareSpec:
+    """One chip's roofline: bf16 peak FLOP/s and HBM bandwidth (bytes/s).
+    ``ridge`` is the arithmetic intensity (flops/byte) above which a
+    program is compute-bound."""
+
+    name: str
+    peak_flops: float
+    hbm_bw: float
+
+    @property
+    def ridge(self) -> float:
+        return self.peak_flops / self.hbm_bw
+
+    def attainable_flops(self, intensity: float) -> float:
+        """Roofline-attainable FLOP/s at ``intensity`` flops/byte."""
+        return min(self.peak_flops, max(intensity, 0.0) * self.hbm_bw)
+
+
+# substring probes in priority order ('v5e'/'lite' must win over bare
+# 'v5'); FLOPs are bf16 peak, BW is HBM per chip
+_CHIP_TABLE = (
+    (("v6",), HardwareSpec("v6e", 918e12, 1640e9)),
+    (("v5e", "lite"), HardwareSpec("v5e", 197e12, 819e9)),
+    (("v5",), HardwareSpec("v5p", 459e12, 2765e9)),
+    (("v4",), HardwareSpec("v4", 275e12, 1228e9)),
+    (("v3",), HardwareSpec("v3", 123e12, 900e9)),
+    (("v2",), HardwareSpec("v2", 45e12, 700e9)),
+)
+
+_DEFAULT_SPEC = HardwareSpec("v5e", 197e12, 819e9)  # conservative default
+
+
+def chip_spec(*probes: str) -> HardwareSpec:
+    """Resolve a :class:`HardwareSpec` from device-kind / generation
+    strings ('TPU v5 lite', 'v4', ...).  First matching probe wins; no
+    match returns the conservative v5e-class default (same fallback
+    bench.py has always used for MFU)."""
+    for probe in probes:
+        p = (probe or "").lower()
+        if not p:
+            continue
+        for keys, spec in _CHIP_TABLE:
+            if any(k in p for k in keys):
+                return spec
+    return _DEFAULT_SPEC
+
+
+# ---------------------------------------------------------------------------
+# per-equation FLOPs
+# ---------------------------------------------------------------------------
+
+def _elems(v) -> int:
+    aval = _aval(v)
+    if aval is None or not hasattr(aval, "shape"):
+        return 0
+    try:
+        return int(np.prod(aval.shape, dtype=np.int64))
+    except Exception:
+        return 0
+
+
+def dot_flops(eqn, padded: bool = False) -> int:
+    """Exact MXU FLOPs of a ``dot_general`` eqn: 2 · out_elems · K, with K
+    the product of the contraction dims.  ``padded=True`` computes the
+    same product over (8, 128)-tile-padded operand/output shapes — the
+    MXU work the hardware actually issues; the difference is GL002's
+    "FLOPs at risk"."""
+    try:
+        (lhs_c, _rhs_c), _batch = eqn.params["dimension_numbers"]
+        lhs_shape = _shape_of(eqn.invars[0])
+        out_shape = _shape_of(eqn.outvars[0])
+        if padded:
+            from .codes import padded_shape
+
+            lhs_shape = padded_shape(lhs_shape)
+            out_shape = padded_shape(out_shape)
+        k = 1
+        for ax in lhs_c:
+            k *= int(lhs_shape[ax])
+        out = 1
+        for d in out_shape:
+            out *= int(d)
+        return 2 * out * k
+    except Exception:
+        return 2 * _elems(eqn.outvars[0])
+
+
+def _conv_flops(eqn) -> int:
+    """conv_general_dilated ≈ 2 · out_elems · K, K = rhs elements per
+    output feature (window · in_features)."""
+    try:
+        dn = eqn.params["dimension_numbers"]
+        rhs_shape = _shape_of(eqn.invars[1])
+        out_feat = int(rhs_shape[dn.rhs_spec[0]])
+        k = 1
+        for d in rhs_shape:
+            k *= int(d)
+        k //= max(out_feat, 1)
+        return 2 * sum(_elems(v) for v in eqn.outvars) * k
+    except Exception:
+        return 2 * sum(_elems(v) for v in eqn.outvars)
+
+
+# pure data movement / bookkeeping: bytes, no flops
+_MOVEMENT_PRIMS = {
+    "reshape", "transpose", "broadcast_in_dim", "squeeze", "expand_dims",
+    "rev", "copy", "slice", "dynamic_slice", "dynamic_update_slice",
+    "gather", "scatter", "concatenate", "pad", "iota", "convert_element_type",
+    "bitcast_convert_type", "select_n", "stop_gradient", "device_put",
+    "split", "squeeze", "rng_bit_generator", "random_seed", "random_wrap",
+    "random_unwrap", "random_bits", "reduce_precision",
+}
+
+_REDUCE_FLOP_PRIMS = {
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "argmax", "argmin", "cumsum", "cumlogsumexp", "cummax",
+    "cummin", "cumprod", "sort",
+}
+
+# operands whose (8,128) padding waste we charge — same scope as GL002
+_TILED_OPERAND_PRIMS = {
+    "dot_general", "conv_general_dilated", "ragged_dot",
+} | _REDUCE_FLOP_PRIMS
+
+
+def eqn_flops(eqn) -> int:
+    """FLOPs of one equation under this model's counting rules (see
+    module docstring): exact for dots/convs, element-count heuristics
+    elsewhere."""
+    prim = eqn.primitive.name
+    if prim in ("dot_general", "ragged_dot"):
+        return dot_flops(eqn)
+    if prim == "conv_general_dilated":
+        return _conv_flops(eqn)
+    if prim in _REDUCE_FLOP_PRIMS:
+        return sum(_elems(v) for v in eqn.invars)
+    if prim in _MOVEMENT_PRIMS:
+        return 0
+    # arithmetic / transcendental / comparison: 1 flop per output element
+    return sum(_elems(v) for v in eqn.outvars)
+
+
+def _eqn_padding_waste(eqn) -> int:
+    """Bytes of (8,128) partial-tile padding across the eqn's tiled
+    operands (dot/reduce scope — where the MXU/VPU layout actually pays)."""
+    if eqn.primitive.name not in _TILED_OPERAND_PRIMS:
+        return 0
+    waste = 0
+    for v in eqn.invars[:2]:
+        dt = _dtype_of(v)
+        if dt is None:
+            continue
+        try:
+            itemsize = np.dtype(dt).itemsize
+        except TypeError:
+            continue  # extended dtypes (RNG keys) have no tile layout here
+        waste += padding_waste_elems(_shape_of(v)) * itemsize
+    return waste
+
+
+# ---------------------------------------------------------------------------
+# report datatypes
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class EqnCost:
+    """One equation's contribution (already multiplied by its loop trip
+    count)."""
+
+    primitive: str
+    flops: int
+    bytes: int
+    padding_waste_bytes: int
+    mult: int
+    out: str
+    provenance: str = ""
+
+    def render(self) -> str:
+        mult = f" x{self.mult}" if self.mult != 1 else ""
+        where = f" @ {self.provenance}" if self.provenance else ""
+        return (f"{self.primitive}{mult} -> {self.out}: "
+                f"{self.flops / 1e9:.3f} GFLOP, "
+                f"{self.bytes / 2**20:.1f} MiB"
+                + (f", {self.padding_waste_bytes / 2**20:.2f} MiB pad waste"
+                   if self.padding_waste_bytes else "")
+                + where)
+
+
+class CostReport:
+    """Static cost of one program.  ``bytes_upper`` is the per-equation
+    sum (nothing fuses), ``boundary_bytes`` the program inputs+outputs
+    (everything fuses); roofline verdicts use the conservative upper
+    bound."""
+
+    def __init__(self, program: str, eqns: List[EqnCost],
+                 boundary_bytes: int, has_unbounded_loops: bool = False):
+        self.program = program
+        self.eqns = eqns
+        self.boundary_bytes = int(boundary_bytes)
+        self.has_unbounded_loops = has_unbounded_loops
+        self.flops = sum(e.flops for e in eqns)
+        self.bytes_upper = sum(e.bytes for e in eqns)
+        self.padding_waste_bytes = sum(e.padding_waste_bytes for e in eqns)
+        self.by_primitive: Dict[str, Dict[str, int]] = {}
+        for e in eqns:
+            agg = self.by_primitive.setdefault(
+                e.primitive, {"flops": 0, "bytes": 0, "count": 0,
+                              "padding_waste_bytes": 0})
+            agg["flops"] += e.flops
+            agg["bytes"] += e.bytes
+            agg["count"] += 1
+            agg["padding_waste_bytes"] += e.padding_waste_bytes
+
+    # -- roofline ----------------------------------------------------------
+    @property
+    def intensity(self) -> float:
+        """flops/byte against the conservative (upper) byte bound."""
+        return self.flops / max(self.bytes_upper, 1)
+
+    @property
+    def boundary_intensity(self) -> float:
+        return self.flops / max(self.boundary_bytes, 1)
+
+    def attainable_flops(self, spec: HardwareSpec) -> float:
+        return spec.attainable_flops(self.intensity)
+
+    def est_seconds(self, spec: HardwareSpec) -> float:
+        """Static lower-bound step time: max of the compute roof and the
+        memory roof (upper byte bound)."""
+        return max(self.flops / spec.peak_flops,
+                   self.bytes_upper / spec.hbm_bw)
+
+    def roofline_fraction(self, spec: HardwareSpec,
+                          measured_seconds: float) -> float:
+        """Achieved / roofline-attainable FLOP/s for one measured
+        execution of this program."""
+        if measured_seconds <= 0:
+            return 0.0
+        attainable = self.attainable_flops(spec)
+        if attainable <= 0:
+            return 0.0
+        return (self.flops / measured_seconds) / attainable
+
+    # -- presentation ------------------------------------------------------
+    def summary(self, spec: Optional[HardwareSpec] = None) -> Dict[str, Any]:
+        spec = spec or _DEFAULT_SPEC
+        return {
+            "program": self.program,
+            "gflops": round(self.flops / 1e9, 3),
+            "hbm_mib_upper": round(self.bytes_upper / 2**20, 2),
+            "hbm_mib_boundary": round(self.boundary_bytes / 2**20, 2),
+            "intensity_flops_per_byte": round(self.intensity, 3),
+            "padding_waste_mib": round(self.padding_waste_bytes / 2**20, 4),
+            "bound": ("compute" if self.intensity >= spec.ridge
+                      else "memory"),
+            "est_step_seconds": self.est_seconds(spec),
+            "chip": spec.name,
+            "unbounded_loops": self.has_unbounded_loops,
+        }
+
+    def render(self, spec: Optional[HardwareSpec] = None,
+               top: int = 5) -> str:
+        spec = spec or _DEFAULT_SPEC
+        s = self.summary(spec)
+        lines = [
+            f"cost: {self.program}: {s['gflops']} GFLOP, "
+            f"{s['hbm_mib_upper']} MiB HBM (boundary "
+            f"{s['hbm_mib_boundary']} MiB), intensity "
+            f"{s['intensity_flops_per_byte']} flop/B -> {s['bound']}-bound "
+            f"on {spec.name} (ridge {spec.ridge:.0f}), est >= "
+            f"{s['est_step_seconds'] * 1e3:.3f} ms/step, pad waste "
+            f"{s['padding_waste_mib']} MiB"
+            + (" [has unbounded while loops]"
+               if self.has_unbounded_loops else "")
+        ]
+        hot = sorted(self.eqns, key=lambda e: -e.flops)[:top]
+        if hot:
+            lines.append("  hottest by FLOPs:")
+            lines += ["    " + e.render() for e in hot if e.flops]
+        heavy = sorted(self.eqns, key=lambda e: -e.bytes)[:top]
+        if heavy:
+            lines.append("  heaviest by bytes:")
+            lines += ["    " + e.render() for e in heavy if e.bytes]
+        return "\n".join(lines)
+
+    __str__ = render
+
+
+# ---------------------------------------------------------------------------
+# the walker
+# ---------------------------------------------------------------------------
+
+def _branch_jaxprs(params: Dict[str, Any]):
+    out = []
+    for v in params.get("branches", ()):
+        out.append(v.jaxpr if isinstance(v, _CLOSED_JAXPR) else v)
+    return out
+
+
+class _Acc:
+    def __init__(self):
+        self.eqns: List[EqnCost] = []
+        self.unbounded = False
+
+
+def _eqn_bytes(eqn) -> int:
+    return (sum(_nbytes(v) for v in eqn.invars)
+            + sum(_nbytes(v) for v in eqn.outvars))
+
+
+def _cost_walk(jaxpr, acc: _Acc, mult: int, depth: int = 0):
+    if depth > 32:  # defensive: malformed/cyclic params
+        return
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        subs = list(_sub_jaxprs(eqn.params))
+        if subs:
+            # call-like eqns contribute their bodies only (counting both
+            # the call's operands and the body would double-count)
+            if prim == "scan":
+                length = int(eqn.params.get("length", 1) or 1)
+                for sub in subs:
+                    _cost_walk(sub, acc, mult * max(length, 1), depth + 1)
+            elif prim == "while":
+                acc.unbounded = True
+                for sub in subs:
+                    _cost_walk(sub, acc, mult, depth + 1)
+            elif prim == "cond":
+                # worst case: the most FLOP-expensive branch
+                best: Optional[List[EqnCost]] = None
+                best_unbounded = False
+                for sub in _branch_jaxprs(eqn.params) or subs:
+                    probe = _Acc()
+                    _cost_walk(sub, probe, mult, depth + 1)
+                    if best is None or (sum(e.flops for e in probe.eqns)
+                                        > sum(e.flops for e in best)):
+                        best = probe.eqns
+                        best_unbounded = probe.unbounded
+                if best:
+                    acc.eqns.extend(best)
+                acc.unbounded = acc.unbounded or best_unbounded
+            else:
+                for sub in subs:
+                    _cost_walk(sub, acc, mult, depth + 1)
+            continue
+        flops = eqn_flops(eqn)
+        nbytes = _eqn_bytes(eqn)
+        waste = _eqn_padding_waste(eqn)
+        if flops == 0 and nbytes == 0:
+            continue
+        acc.eqns.append(EqnCost(
+            primitive=prim,
+            flops=flops * mult,
+            bytes=nbytes * mult,
+            padding_waste_bytes=waste * mult,
+            mult=mult,
+            out="/".join(_fmt_aval(v) for v in eqn.outvars),
+            provenance=_provenance(eqn),
+        ))
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def cost_jaxpr(closed, program: str = "<program>") -> CostReport:
+    """Cost a ``ClosedJaxpr`` (or ``Jaxpr``)."""
+    jaxpr = closed.jaxpr if isinstance(closed, _CLOSED_JAXPR) else closed
+    acc = _Acc()
+    _cost_walk(jaxpr, acc, 1)
+    boundary = (sum(_nbytes(v) for v in jaxpr.invars)
+                + sum(_nbytes(v) for v in jaxpr.outvars))
+    return CostReport(program, acc.eqns, boundary,
+                      has_unbounded_loops=acc.unbounded)
+
+
+def cost(fn, *args, static_argnums=(), program: Optional[str] = None,
+         **kwargs) -> CostReport:
+    """Trace ``fn(*args, **kwargs)`` abstractly (args may be
+    ``jax.ShapeDtypeStruct``s — nothing executes) and cost the jaxpr."""
+    closed = jax.make_jaxpr(fn, static_argnums=tuple(static_argnums))(
+        *args, **kwargs)
+    return cost_jaxpr(closed,
+                      program=program or getattr(fn, "__name__", "<fn>"))
+
+
+# -- the jit.to_static hook registry (mirrors graph_lint.reports()) --------
+
+_COST_LOCK = threading.Lock()
+_COST_REPORTS: List[CostReport] = []
+_MAX_COST_REPORTS = 256
+
+
+def cost_reports() -> List[CostReport]:
+    """CostReports collected by the ``FLAGS_graph_cost`` compile hook."""
+    with _COST_LOCK:
+        return list(_COST_REPORTS)
+
+
+def clear_cost_reports():
+    with _COST_LOCK:
+        _COST_REPORTS.clear()
+
+
+def _record(report: CostReport):
+    with _COST_LOCK:
+        _COST_REPORTS.append(report)
+        del _COST_REPORTS[:-_MAX_COST_REPORTS]
+
+
+def cost_static_program(pure_fn, arg_structs, mut_structs, ro_structs,
+                        program: str, jaxpr=None) -> CostReport:
+    """Cost one ``jit.to_static`` compiled entry (same calling convention
+    as ``graph_lint.lint_static_program``) and record it in
+    :func:`cost_reports`.  Pass an already-traced ``jaxpr`` to skip the
+    abstract trace (the compile hook shares one trace with the linter)."""
+    closed = (jaxpr if jaxpr is not None
+              else jax.make_jaxpr(pure_fn)(arg_structs, mut_structs,
+                                           ro_structs))
+    report = cost_jaxpr(closed, program=program)
+    _record(report)
+    return report

@@ -56,7 +56,8 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
 import jax
 import numpy as np
 
-from .codes import CODES, SEVERITY_RANK, misaligned_dims
+from .codes import (CODES, SEVERITY_RANK, misaligned_dims,
+                    padding_waste_elems)
 
 # the jaxpr datatypes have moved around across jax releases; probe the
 # private home last and never let a rename break `import paddle_tpu`
@@ -111,6 +112,11 @@ class Finding:
     primitive: str = ""
     provenance: str = ""
     program: str = "<program>"
+    # estimated cost of the hazard ("~X MiB padding waste, ~Y MFLOP at
+    # risk"), populated by the size-sensitive passes (GL002/GL006) from
+    # the static cost model.  NOT part of the fingerprint: baselines
+    # survive cost-model refinements.
+    cost: str = ""
 
     def __post_init__(self):
         if not self.severity:
@@ -127,8 +133,9 @@ class Finding:
     def render(self) -> str:
         name = CODES.get(self.code, ("?", ""))[0]
         where = f" @ {self.provenance}" if self.provenance else ""
+        est = f" [est: {self.cost}]" if self.cost else ""
         return (f"{self.code} [{self.severity}] {name}: {self.message}"
-                f"{where} (program={self.program})")
+                f"{est}{where} (program={self.program})")
 
 
 @dataclasses.dataclass
@@ -284,6 +291,31 @@ def _is_var(v) -> bool:
     return isinstance(v, _VAR) and not isinstance(v, _DROPVAR)
 
 
+def _gl002_cost(eqn, v) -> str:
+    """Estimated cost of a tile-misaligned operand: bytes of partial-tile
+    padding in its physical layout, plus (for contractions) the padded-away
+    MXU FLOPs — the numbers the autotuner/roofline model (analysis/cost_model.py)
+    computes, quoted on the finding so GL002 is a quantified suggestion
+    instead of a bare warning."""
+    try:
+        dt = _dtype_of(v)
+        itemsize = np.dtype(dt).itemsize if dt is not None else 0
+        waste = padding_waste_elems(_shape_of(v)) * itemsize
+        total = max(_nbytes(v) + waste, 1)
+        parts = [f"~{waste / 2**20:.2f} MiB padding waste "
+                 f"({100.0 * waste / total:.0f}% of the padded operand)"]
+        if eqn.primitive.name in _DOT_PRIMS:
+            from .cost_model import dot_flops  # lazy: it imports this module
+
+            at_risk = dot_flops(eqn, padded=True) - dot_flops(eqn)
+            if at_risk > 0:
+                parts.append(f"~{at_risk / 1e6:.1f} MFLOP of padded-away "
+                             "MXU work per execution")
+        return ", ".join(parts)
+    except Exception:  # noqa: BLE001 — annotation must never break a lint
+        return ""
+
+
 # ---------------------------------------------------------------------------
 # the jaxpr passes
 # ---------------------------------------------------------------------------
@@ -296,10 +328,10 @@ class _Ctx:
         self.seen: Set[str] = set()  # fingerprint dedup within one report
 
     def add(self, code, message, detail, primitive="", provenance="",
-            severity=""):
+            severity="", cost=""):
         f = Finding(code=code, message=message, detail=detail,
                     severity=severity, primitive=primitive,
-                    provenance=provenance, program=self.program)
+                    provenance=provenance, program=self.program, cost=cost)
         if f.fingerprint in self.seen:
             return
         self.seen.add(f.fingerprint)
@@ -426,7 +458,8 @@ def _walk(jaxpr: "_jcore.Jaxpr", ctx: _Ctx, depth: int = 0):
                         "padding on every tile row/column",
                         detail=f"{prim}:operand{opi}:{_fmt_aval(v)}",
                         primitive=prim, provenance=prov,
-                        severity="info" if lane_only else "warning")
+                        severity="info" if lane_only else "warning",
+                        cost=_gl002_cost(eqn, v))
 
         if "GL003" in cfg.passes and (prim in _SYNC_PRIMS
                                       or prim in _ASYNC_HOST_PRIMS):
@@ -453,7 +486,10 @@ def _walk(jaxpr: "_jcore.Jaxpr", ctx: _Ctx, depth: int = 0):
                     f"({out_bytes / max(in_bytes, 1):.0f}x) — intermediate "
                     "blowup; check it fuses or is really needed",
                     detail=f"{prim}:{'/'.join(_fmt_aval(v) for v in eqn.outvars)}",
-                    primitive=prim, provenance=prov)
+                    primitive=prim, provenance=prov,
+                    cost=f"+{(out_bytes - in_bytes) / 2**20:.1f} MiB HBM "
+                         "traffic and residency per execution if it fails "
+                         "to fuse")
 
         for sub in _sub_jaxprs(eqn.params):
             _walk(sub, ctx, depth + 1)
@@ -593,11 +629,16 @@ def clear_reports():
 
 def lint_static_program(pure_fn, arg_structs, mut_structs, ro_structs,
                         program: str,
-                        config: Optional[LintConfig] = None) -> LintReport:
+                        config: Optional[LintConfig] = None,
+                        jaxpr=None) -> LintReport:
     """Lint one jit.to_static compiled entry: trace ``pure_fn(raw_args,
     raw_mut, raw_ro)`` abstractly and mark the mutated-capture block as
-    donated (jit/api.py jits it with ``donate_argnums=(1,)``)."""
-    closed = jax.make_jaxpr(pure_fn)(arg_structs, mut_structs, ro_structs)
+    donated (jit/api.py jits it with ``donate_argnums=(1,)``).  Pass an
+    already-traced ``jaxpr`` to skip the abstract trace (the compile hook
+    shares one trace between this and the cost model)."""
+    closed = (jaxpr if jaxpr is not None
+              else jax.make_jaxpr(pure_fn)(arg_structs, mut_structs,
+                                           ro_structs))
     donated = set(range(len(arg_structs),
                         len(arg_structs) + len(mut_structs)))
     report = lint_jaxpr(closed, donated=donated, config=config,
@@ -634,12 +675,15 @@ def churn_findings(config: Optional[LintConfig] = None,
         op_stats = _op_cache.stats()
     for op, st in sorted(op_stats.items()):
         sk = int(st.get("shape_keys", 0))
-        if sk > cfg.churn_shape_keys:
+        overflow = bool(st.get("shape_keys_overflow", False))
+        if sk > cfg.churn_shape_keys or overflow:
+            bound = (f">= {sk} (tracking set saturated — the true count "
+                     "is higher)" if overflow else str(sk))
             ctx.add(
                 "GL007",
-                f"eager op '{op}' compiled under {sk} distinct shape keys "
-                f"(> {cfg.churn_shape_keys}) — shape churn retraces on the "
-                "hot path; pad/bucket the varying dim",
+                f"eager op '{op}' compiled under {bound} distinct shape "
+                f"keys (> {cfg.churn_shape_keys}) — shape churn retraces "
+                "on the hot path; pad/bucket the varying dim",
                 detail=f"op_cache:{op}", primitive=op)
 
     if static_fns is None:

@@ -74,5 +74,14 @@ define_flag("FLAGS_graph_lint",
             os.environ.get("PADDLE_TPU_GRAPH_LINT", "").lower()
             in ("1", "true", "yes", "on"),
             "run the jaxpr graph linter on every compiled to_static program")
+# Graph Lint v2 cost model: compute a static roofline CostReport (FLOPs,
+# HBM bytes, intensity, tile-padding waste) for every compiled to_static
+# program (paddle_tpu/analysis/cost_model.py).  bench.py turns these into
+# *_roofline_fraction metric lines; tools/graph_lint.py --cost prints them.
+define_flag("FLAGS_graph_cost",
+            os.environ.get("PADDLE_TPU_GRAPH_COST", "").lower()
+            in ("1", "true", "yes", "on"),
+            "compute a static roofline cost report for every compiled "
+            "to_static program")
 define_flag("FLAGS_log_level", 0, "framework VLOG level")
 define_flag("FLAGS_benchmark", False, "block on every op for timing")

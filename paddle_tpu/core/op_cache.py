@@ -80,9 +80,13 @@ _stats: Dict[str, Dict[str, Any]] = {}
 # per-op distinct input-aval signatures, for the graph linter's GL007
 # retrace-churn pass (and users): how many distinct shape keys each op was
 # dispatched under, visible WITHOUT enabling any logging.  Bounded per op —
-# past the cap the count saturates (the churn verdict is long since in).
+# past the cap the count saturates (the churn verdict is long since in),
+# and the op lands in _shape_key_overflow so stats() can say EXPLICITLY
+# that its count is a lower bound (GL007 must never under-report churn
+# silently).
 _SHAPE_KEY_CAP = 512
 _shape_keys: Dict[str, set] = {}
+_shape_key_overflow: set = set()
 
 
 class _Entry:
@@ -286,6 +290,10 @@ def acquire(op_name: str, raw_fn: Callable, fwd: Callable, raws, attrs,
         sk = _shape_keys.setdefault(op_name, set())
         if len(sk) < _SHAPE_KEY_CAP:
             sk.add(key[2])  # the input avals slot of the cache key
+        elif key[2] not in sk:
+            # the capped set is saturated AND this is a genuinely new
+            # signature: the count is now a lower bound — flag it
+            _shape_key_overflow.add(op_name)
         entry = _cache.get(key)
         if entry is not None:
             _cache.move_to_end(key)
@@ -360,11 +368,15 @@ def count_bwd(op_name: str, jitted: bool):
 def stats() -> Dict[str, Dict[str, Any]]:
     """Per-op dispatch counters (deep copy).  ``shape_keys`` is the number
     of distinct input-aval signatures the op was dispatched under (the
-    GL007 retrace-churn signal; saturates at the internal cap)."""
+    GL007 retrace-churn signal); ``shape_keys_overflow`` is True when the
+    capped tracking set saturated AND new signatures kept arriving — the
+    count is then a LOWER bound, and GL007 treats the op as churning
+    regardless of any threshold."""
     with _lock:
         return {
             name: {**st, "fallbacks": dict(st["fallbacks"]),
-                   "shape_keys": len(_shape_keys.get(name, ()))}
+                   "shape_keys": len(_shape_keys.get(name, ())),
+                   "shape_keys_overflow": name in _shape_key_overflow}
             for name, st in _stats.items()
         }
 
@@ -373,6 +385,7 @@ def reset_stats():
     with _lock:
         _stats.clear()
         _shape_keys.clear()
+        _shape_key_overflow.clear()
 
 
 def summary() -> Dict[str, Any]:
@@ -409,6 +422,7 @@ def clear(reset: bool = False):
         if reset:
             _stats.clear()
             _shape_keys.clear()
+            _shape_key_overflow.clear()
 
 
 def log_stats(stream=None, top: int = 20):

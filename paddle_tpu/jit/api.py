@@ -111,6 +111,7 @@ class _CompiledEntry:
         "stale_ordinals",
         "_scout_result",
         "lint_report",
+        "cost_report",
     )
 
     def __init__(self):
@@ -136,6 +137,8 @@ class _CompiledEntry:
         # LintReport from the FLAGS_graph_lint compile hook (None when the
         # flag is off or the lint itself failed)
         self.lint_report = None
+        # CostReport from the FLAGS_graph_cost compile hook (same contract)
+        self.cost_report = None
 
 
 # every StaticFunction ever built (weak): the GL007 retrace-churn pass
@@ -540,39 +543,74 @@ class StaticFunction:
                     t.grad = g
 
         entry.jitted = jax.jit(pure_fn, donate_argnums=(1,))
-        self._maybe_lint(entry, pure_fn, arg_structs)
+        self._maybe_analyze(entry, pure_fn, arg_structs)
 
-    def _maybe_lint(self, entry, pure_fn, arg_structs):
-        """FLAGS_graph_lint / PADDLE_TPU_GRAPH_LINT=1: lint the program
-        being installed (one extra abstract trace — zero compute) and
-        stash the report on the entry + the analysis report registry."""
+    def _maybe_analyze(self, entry, pure_fn, arg_structs):
+        """FLAGS_graph_lint / FLAGS_graph_cost compile hooks (env:
+        PADDLE_TPU_GRAPH_LINT / PADDLE_TPU_GRAPH_COST): lint and/or
+        roofline-cost the program being installed.  ONE shared abstract
+        trace (zero compute) feeds both analyses — `tools/graph_lint.py
+        --cost` turns both on and must not trace twice.  Reports land on
+        the entry (`lint_report` / `cost_report`) + the analysis
+        registries; bench.py reads cost reports for *_roofline_fraction
+        lines."""
         from ..core import flags as _flags
 
-        try:
-            if not _flags.flag("FLAGS_graph_lint"):
-                return
-        except KeyError:  # pragma: no cover - flags registry always has it
-            return
-        from .. import analysis as _analysis
+        def _on(flag_name):
+            try:
+                return bool(_flags.flag(flag_name))
+            except KeyError:  # pragma: no cover - registry always has them
+                return False
 
+        want_lint = _on("FLAGS_graph_lint")
+        want_cost = _on("FLAGS_graph_cost")
+        if not (want_lint or want_cost):
+            return
         name = getattr(self._fn, "__name__", None) or "to_static_fn"
         mk = lambda t: jax.ShapeDtypeStruct(  # noqa: E731
             tuple(t._value.shape), t._value.dtype)
         try:
-            entry.lint_report = _analysis.lint_static_program(
-                pure_fn, arg_structs,
-                [mk(t) for t in entry.mut_caps],
-                [mk(t) for t in entry.ro_caps],
-                program=name)
-        except Exception as e:  # noqa: BLE001 — lint must never break compile
+            mut_structs = [mk(t) for t in entry.mut_caps]
+            ro_structs = [mk(t) for t in entry.ro_caps]
+            closed = jax.make_jaxpr(pure_fn)(arg_structs, mut_structs,
+                                             ro_structs)
+        except Exception as e:  # noqa: BLE001 — analysis must never break compile
             sys.stderr.write(
-                f"[paddle_tpu.graph_lint] lint of '{name}' failed: "
-                f"{type(e).__name__}: {e}\n")
+                f"[paddle_tpu.graph_lint] abstract trace of '{name}' "
+                f"failed: {type(e).__name__}: {e}\n")
+            return
+        if want_lint:
+            from .. import analysis as _analysis
+
+            try:
+                entry.lint_report = _analysis.lint_static_program(
+                    pure_fn, arg_structs, mut_structs, ro_structs,
+                    program=name, jaxpr=closed)
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(
+                    f"[paddle_tpu.graph_lint] lint of '{name}' failed: "
+                    f"{type(e).__name__}: {e}\n")
+        if want_cost:
+            from ..analysis import cost_static_program as _cost_static
+
+            try:
+                entry.cost_report = _cost_static(
+                    pure_fn, arg_structs, mut_structs, ro_structs,
+                    program=name, jaxpr=closed)
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(
+                    f"[paddle_tpu.graph_cost] cost of '{name}' failed: "
+                    f"{type(e).__name__}: {e}\n")
 
     def lint_reports(self):
         """LintReports of every compiled entry (FLAGS_graph_lint runs)."""
         return [e.lint_report for e in self._cache.values()
                 if e.lint_report is not None]
+
+    def cost_reports(self):
+        """CostReports of every compiled entry (FLAGS_graph_cost runs)."""
+        return [e.cost_report for e in self._cache.values()
+                if e.cost_report is not None]
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):

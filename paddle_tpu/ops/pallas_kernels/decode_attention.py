@@ -127,16 +127,35 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
 
 
 def _pick_block_kv(s: int) -> int:
-    b = min(512, s)
-    while s % b:
-        b //= 2
-    return max(b, 128) if s % max(b, 128) == 0 else b
+    from ...analysis.codes import default_block
+
+    return default_block(s)
 
 
-def _decode_pallas(q, k, v, length, scale, interpret=False):
-    """q: [BH, 8, D] (row-broadcast query), k/v: [BH, S, D],
-    length: scalar int32 -> [BH, 8, D].  ``interpret=True`` runs the
-    kernel through the Pallas interpreter (CPU numerics check).
+def _pick_params(s: int, d: int, dtype):
+    """(block_kv, q_rows) for one cache specialization: the autotune
+    table's entry for this exact (max_seq, head_dim, dtype) key when one
+    exists (``analysis/autotune.py``), else the historical hard-coded
+    choice (largest 128-multiple divisor up to 512, 8 query sublane
+    rows)."""
+    from ...analysis import autotune as _autotune
+
+    tuned = _autotune.kernel_params(
+        "decode_attention", {"max_seq": s, "head_dim": d}, dtype)
+    if tuned:
+        bkv = int(tuned.get("block_kv", 0))
+        qr = int(tuned.get("q_rows", 8))
+        if bkv > 0 and s % bkv == 0 and qr > 0 and qr % 8 == 0:
+            return bkv, qr
+    return _pick_block_kv(s), 8
+
+
+def _decode_pallas(q, k, v, length, scale, interpret=False, block_kv=None):
+    """q: [BH, q_rows, D] (row-broadcast query; q_rows is the tunable
+    sublane layout, 8 by default), k/v: [BH, S, D], length: scalar int32
+    -> [BH, q_rows, D].  ``interpret=True`` runs the kernel through the
+    Pallas interpreter (CPU numerics check); ``block_kv`` overrides the
+    KV blocking (autotune table / sweep probes).
 
     ``length`` rides as a scalar-prefetch argument so the KV index maps
     can see it BEFORE each DMA is issued: blocks past the valid length are
@@ -145,7 +164,8 @@ def _decode_pallas(q, k, v, length, scale, interpret=False):
     streams O(p) cache from HBM, not O(max_seq).  (A pl.when alone would
     only skip the compute; BlockSpec copies fire regardless.)"""
     bh, s, d = k.shape
-    block_kv = _pick_block_kv(s)
+    qr = int(q.shape[1])
+    block_kv = int(block_kv or _pick_block_kv(s))
     n_kv = s // block_kv
     kernel = functools.partial(_decode_kernel, scale=scale,
                                block_kv=block_kv, n_kv=n_kv)
@@ -159,21 +179,21 @@ def _decode_pallas(q, k, v, length, scale, interpret=False):
         num_scalar_prefetch=1,
         grid=(bh, n_kv),
         in_specs=[
-            pl.BlockSpec((1, 8, d), lambda b, ki, len_ref: (b, 0, 0)),
+            pl.BlockSpec((1, qr, d), lambda b, ki, len_ref: (b, 0, 0)),
             pl.BlockSpec((1, block_kv, d), kv_index),
             pl.BlockSpec((1, block_kv, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, 8, d), lambda b, ki, len_ref: (b, 0, 0)),
+        out_specs=pl.BlockSpec((1, qr, d), lambda b, ki, len_ref: (b, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((8, d), jnp.float32),
-            pltpu.VMEM((8, 128), jnp.float32),
-            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((qr, d), jnp.float32),
+            pltpu.VMEM((qr, 128), jnp.float32),
+            pltpu.VMEM((qr, 128), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, 8, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, qr, d), q.dtype),
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
@@ -202,11 +222,14 @@ def decode_attention(q, k_cache, v_cache, length, *, sm_scale=None):
     scale = float(sm_scale if sm_scale is not None else 1.0 / (d ** 0.5))
     q = q.astype(k_cache.dtype)
     if _on_tpu() and decode_shape_supported(s, d):
-        # sublane-broadcast the query row to 8 so blocks are tile-legal
-        q8 = jnp.broadcast_to(q.reshape(b * h, 1, d), (b * h, 8, d))
+        # sublane-broadcast the query row so blocks are tile-legal; the
+        # row count and KV blocking come from the autotune table when a
+        # measured entry exists for this cache specialization
+        block_kv, qr = _pick_params(s, d, k_cache.dtype)
+        q8 = jnp.broadcast_to(q.reshape(b * h, 1, d), (b * h, qr, d))
         out = _decode_pallas(q8, k_cache.reshape(b * h, s, d),
                              v_cache.reshape(b * h, s, d),
-                             length, scale)
+                             length, scale, block_kv=block_kv)
         return out[:, 0, :].reshape(b, h, d)
     return _xla_decode_reference(q, k_cache, v_cache, length, scale)
 

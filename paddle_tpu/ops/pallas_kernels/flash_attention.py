@@ -352,19 +352,19 @@ _flags.define_flag("FLAGS_flash_block_kv", 0,
 
 
 def _auto_block(s: int) -> int:
-    b = min(512, s)
-    while s % b:
-        b //= 2
-    return max(b, 128) if s % max(b, 128) == 0 else b
+    from ...analysis.codes import default_block
+
+    return default_block(s)
 
 
-def _pick_blocks(s: int):
-    """Default block sizes, overridable PER SIDE for on-chip tuning
-    sweeps via FLAGS_flash_block_q / FLAGS_flash_block_kv (settable with
-    set_flags or the FLAGS_* env vars, like every other flag) — the
-    round-5 verdict's untried flash-block-tuning lever.  Invalid
-    overrides (non-positive, non-divisor) fall back to auto for that
-    side only."""
+def _pick_blocks(s: int, d: int = 0, dtype=None):
+    """Block sizes for one (seq, head_dim, dtype) specialization, in
+    priority order: explicit FLAGS_flash_block_q / FLAGS_flash_block_kv
+    overrides (a user pin beats the tuner, per side), then the autotune
+    table (``analysis/autotune.py`` — a measured or seeded entry for this
+    exact shape key; requires ``d``), then the historical ``_auto_block``
+    default.  Invalid flag overrides (non-positive, non-divisor) fall
+    back down the chain for that side only."""
     def override(name):
         try:
             v = int(_flags.flag(name) or 0)
@@ -376,8 +376,22 @@ def _pick_blocks(s: int):
                 return v
         return None
 
-    bq = override("FLAGS_flash_block_q") or _auto_block(s)
-    bkv = override("FLAGS_flash_block_kv") or _auto_block(s)
+    fq = override("FLAGS_flash_block_q")
+    fkv = override("FLAGS_flash_block_kv")
+    tuned = None
+    if d and (fq is None or fkv is None):
+        from ...analysis import autotune as _autotune
+
+        tuned = _autotune.kernel_params(
+            "flash_attention", {"seq": s, "head_dim": d}, dtype)
+        if tuned:
+            tbq = int(tuned.get("block_q") or 0)
+            tbkv = int(tuned.get("block_kv") or 0)
+            if tbq <= 0 or tbkv <= 0 or s % tbq or s % tbkv:
+                tuned = None  # forced/tampered/partial params that
+                #               cannot tile s — fall back whole
+    bq = fq or (tuned and int(tuned["block_q"])) or _auto_block(s)
+    bkv = fkv or (tuned and int(tuned["block_kv"])) or _auto_block(s)
     return bq, bkv
 
 
@@ -389,7 +403,7 @@ def _flash_bnsd(q, k, v, causal, scale):
 
 def _flash_bnsd_fwd(q, k, v, causal, scale):
     b, n, s, d = q.shape
-    bq, bkv = _pick_blocks(s)
+    bq, bkv = _pick_blocks(s, d, q.dtype)
     fq, fk, fv = (t.reshape(b * n, s, d) for t in (q, k, v))
     out, lse = _flash_fwd(fq, fk, fv, scale, causal, bq, bkv)
     return out.reshape(b, n, s, d), (q, k, v, out.reshape(b, n, s, d), lse)
@@ -398,7 +412,7 @@ def _flash_bnsd_fwd(q, k, v, causal, scale):
 def _flash_bnsd_bwd(causal, scale, res, g):
     q, k, v, out, lse = res
     b, n, s, d = q.shape
-    bq, bkv = _pick_blocks(s)
+    bq, bkv = _pick_blocks(s, d, q.dtype)
     dq, dk, dv = _flash_bwd(
         q.reshape(b * n, s, d), k.reshape(b * n, s, d), v.reshape(b * n, s, d),
         out.reshape(b * n, s, d), lse, g.reshape(b * n, s, d),

@@ -139,6 +139,7 @@ def _paged_pallas(q, k_pool, v_pool, page_tables, lengths, scale,
     compute."""
     p_, h, page_size, d = k_pool.shape
     s, max_pages = page_tables.shape
+    qr = int(q.shape[1])  # tunable query sublane rows (8 by default)
     kernel = functools.partial(_paged_kernel, scale=scale,
                                page_size=page_size, max_pages=max_pages,
                                num_heads=h)
@@ -155,22 +156,22 @@ def _paged_pallas(q, k_pool, v_pool, page_tables, lengths, scale,
         num_scalar_prefetch=2,
         grid=(s * h, max_pages),
         in_specs=[
-            pl.BlockSpec((1, 8, d), lambda sh, pi, pt_ref, len_ref: (sh, 0, 0)),
+            pl.BlockSpec((1, qr, d), lambda sh, pi, pt_ref, len_ref: (sh, 0, 0)),
             pl.BlockSpec((1, 1, page_size, d), kv_index),
             pl.BlockSpec((1, 1, page_size, d), kv_index),
         ],
-        out_specs=pl.BlockSpec((1, 8, d),
+        out_specs=pl.BlockSpec((1, qr, d),
                                lambda sh, pi, pt_ref, len_ref: (sh, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((8, d), jnp.float32),
-            pltpu.VMEM((8, 128), jnp.float32),
-            pltpu.VMEM((8, 128), jnp.float32),
+            pltpu.VMEM((qr, d), jnp.float32),
+            pltpu.VMEM((qr, 128), jnp.float32),
+            pltpu.VMEM((qr, 128), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((s * h, 8, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((s * h, qr, d), q.dtype),
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         ),
@@ -182,6 +183,21 @@ def _paged_pallas(q, k_pool, v_pool, page_tables, lengths, scale,
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
+
+def _pick_q_rows(page_size: int, d: int, dtype) -> int:
+    """Query sublane-broadcast rows for one pool specialization: the
+    autotune table's entry when one exists (``analysis/autotune.py``),
+    else the historical 8."""
+    from ...analysis import autotune as _autotune
+
+    tuned = _autotune.kernel_params(
+        "paged_attention", {"page_size": page_size, "head_dim": d}, dtype)
+    if tuned:
+        qr = int(tuned.get("q_rows", 8))
+        if qr > 0 and qr % 8 == 0:
+            return qr
+    return 8
+
 
 def gather_pages(pool, page_tables):
     """Materialize each slot's paged context as a contiguous view.
@@ -216,7 +232,8 @@ def paged_attention(q, k_pool, v_pool, page_tables, lengths, *,
     q = q.astype(k_pool.dtype)
     s = q.shape[0]
     if _on_tpu() and paged_shape_supported(page_size, d):
-        q8 = jnp.broadcast_to(q.reshape(s * h, 1, d), (s * h, 8, d))
+        qr = _pick_q_rows(page_size, d, k_pool.dtype)
+        q8 = jnp.broadcast_to(q.reshape(s * h, 1, d), (s * h, qr, d))
         out = _paged_pallas(q8, k_pool, v_pool, page_tables, lengths, scale)
         return out[:, 0, :].reshape(s, h, d)
     return _xla_paged_reference(q, k_pool, v_pool, page_tables, lengths,

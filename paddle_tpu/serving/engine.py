@@ -521,6 +521,7 @@ class ServingEngine:
 
         self._totals = {"steps": 0, "tokens": 0, "admitted": 0,
                         "completed": 0, "prefill_chunks": 0,
+                        "decode_steps": 0,
                         # fault-containment counters (admission path SLOs)
                         "failed": 0, "cancelled": 0, "timed_out": 0,
                         "shed": 0, "quarantined": 0, "step_retries": 0,
@@ -659,6 +660,10 @@ class ServingEngine:
                     self._recover(e, rebuild=not _state_intact(e))
                     out = None
                 if out is not None:
+                    # exact count of decode_step program executions —
+                    # bench.py's serving roofline denominator (ticks with
+                    # no active slots / failed dispatches don't run one)
+                    self._totals["decode_steps"] += 1
                     self._harvest_decode(*out)
                     self._backoff_s = self.readmission_backoff_s
             dt = time.perf_counter() - t0

@@ -22,6 +22,11 @@
 # the implicated requests, keep page accounting exact, and preserve greedy
 # parity for every survivor; see docs/serving.md "Failure model & SLOs").
 # PADDLE_TPU_SKIP_FAULT_GATE=1 skips it.
+#
+# An autotune-table replay gate runs fifth (tools/autotune.py --validate —
+# every committed entry must be legal under the CURRENT static tile/VMEM
+# gates; pure static analysis, never times; see docs/graph_lint.md
+# "v2: autotuner").  PADDLE_TPU_SKIP_AUTOTUNE_GATE=1 skips it.
 export JAX_PLATFORMS=cpu
 export PYTHONPATH=$(python - << 'PY'
 import os
@@ -63,6 +68,15 @@ if [ -z "$PADDLE_TPU_SKIP_FAULT_GATE" ]; then
     python "$(dirname "$0")/tools/serving_fault_gate.py" || {
         rc=$?
         echo "run_tests: serving fault gate FAILED (rc=$rc)"
+        exit $rc
+    }
+fi
+
+if [ -z "$PADDLE_TPU_SKIP_AUTOTUNE_GATE" ]; then
+    echo "run_tests: autotune-table replay gate (tools/autotune.py --validate)"
+    python "$(dirname "$0")/tools/autotune.py" --validate || {
+        rc=$?
+        echo "run_tests: autotune replay gate FAILED (rc=$rc)"
         exit $rc
     }
 fi

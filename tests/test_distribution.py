@@ -54,8 +54,21 @@ def test_normal_rsample_pathwise_grad():
     x = d.rsample((1000,))
     loss = paddle_tpu.mean(paddle_tpu.square(x))
     loss.backward()
-    # d/dscale E[(scale*eps)^2] = 2*scale = 2
-    assert float(scale.grad) == pytest.approx(2.0, abs=0.2)
+    # Pathwise identity: x = loc + scale*eps with loc=0, so for the eps
+    # ACTUALLY drawn, d loss/d scale = 2*scale*mean(eps^2) = 2*loss/scale
+    # EXACTLY.  This is what "reparameterized gradients flow" means — and
+    # it is seed-independent.  (The old `== 2.0 +- 0.2` form asserted the
+    # sampler's luck instead: seed 7's key draws mean(eps^2)=0.866, a
+    # ~3-sigma-low draw over 1000 samples (sigma = sqrt(2/N) ~ 0.045),
+    # and 1.731 vs 2.0 failed a perfectly correct gradient.)
+    assert float(scale.grad) == pytest.approx(2.0 * float(loss), rel=1e-4)
+    # statistical sanity kept, at a tolerance sized to the estimator:
+    # scale.grad ~ 2 + 2*N(0, sqrt(2/1000)); allow 5 sigma
+    assert float(scale.grad) == pytest.approx(
+        2.0, abs=2.0 * 5 * (2.0 / 1000) ** 0.5)
+    # loc pathwise identity: d loss/d loc = 2*mean(x) exactly
+    assert float(loc.grad) == pytest.approx(
+        2.0 * float(paddle_tpu.mean(x)), rel=1e-4, abs=1e-6)
 
 
 def test_uniform_beta_dirichlet():

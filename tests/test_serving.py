@@ -355,6 +355,39 @@ def test_out_of_pages_admission_backpressures():
     assert eng.metrics()["completed"] == 6
 
 
+def test_invocation_counters_exact():
+    """``prefill_chunks`` counts prefill_step executions (a multi-chunk
+    prompt counts each chunk) and ``decode_steps`` counts only ticks that
+    actually dispatched the decode program — bench.py's serving roofline
+    denominators."""
+    pt.seed(0)
+    cfg = _tiny_cfg()
+    m = GPTForPretraining(cfg)
+    m.eval()
+    rng = np.random.RandomState(0)
+    eng = ServingEngine(m, num_slots=2, page_size=16, max_context=64,
+                        cache_dtype="float32")
+    try:
+        m0 = eng.metrics()
+        assert m0["prefill_chunks"] == 0 and m0["decode_steps"] == 0
+        eng.step()  # idle tick: no active slots, no decode program ran
+        assert eng.metrics()["decode_steps"] == 0
+        assert eng.metrics()["steps"] == 1
+        # chunk = min(page_size, max_context) = 16: 20 tokens -> 2 chunks,
+        # 8 tokens -> 1 chunk
+        reqs = [eng.submit(rng.randint(0, cfg.vocab_size, (plen,)), 3)
+                for plen in (20, 8)]
+        eng.run_until_idle()
+        mets = eng.metrics()
+        assert all(len(r.tokens) == 3 for r in reqs)
+        assert mets["prefill_chunks"] == 3
+        # every decode dispatch is a tick, but not every tick dispatched
+        # (the idle tick above never ran the program)
+        assert 0 < mets["decode_steps"] < mets["steps"]
+    finally:
+        eng.close()
+
+
 def test_boundary_length_requests():
     """prompt + max_new == max_context (prefill padding reaches the table
     edge) and a prefill-only request (max_new=1, never decodes) both match

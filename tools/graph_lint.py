@@ -46,11 +46,14 @@ DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "graph_lint_baseline.json")
 
 # the scaled-down bench stand-in: tiny dims, but the SAME program structure
-# (stacked scan + remat, fused CE head, donated state, decode engine) and
-# the same pure-bf16 dtype regime as bench.py's headline rungs.  Fixed
-# shapes keep finding fingerprints stable for the baseline.
+# (stacked scan + remat, fused CE head, donated state, decode engine,
+# paged-serving engine) and the same pure-bf16 dtype regime as bench.py's
+# headline rungs.  Fixed shapes keep finding fingerprints stable for the
+# baseline.
 _TRAIN_BATCH, _TRAIN_SEQ = 2, 64
 _DEC_BATCH, _DEC_PROMPT, _DEC_NEW, _DEC_MAXSEQ = 2, 8, 3, 128
+_SRV_SLOTS, _SRV_PAGE, _SRV_CTX, _SRV_NEW = 2, 16, 64, 3
+_SRV_PROMPTS = (5, 9)
 
 
 def _build_model(pt, cfg):
@@ -106,6 +109,27 @@ def _lint_decode(pt, np):
                    max_seq_len=_DEC_MAXSEQ, cache_dtype="bfloat16")
 
 
+def _lint_serve(pt, np):
+    """The serving paged decode step — the hottest program under load, now
+    a DEFAULT lint target instead of only being reachable via
+    ``ServingEngine.lint_reports()``."""
+    from paddle_tpu.models import gpt_tiny
+    from paddle_tpu.serving import ServingEngine
+
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    model = _build_model(pt, cfg)
+    model.eval()
+    rng = np.random.RandomState(2)
+    eng = ServingEngine(model, num_slots=_SRV_SLOTS, page_size=_SRV_PAGE,
+                        max_context=_SRV_CTX, cache_dtype="bfloat16")
+    try:
+        for plen in _SRV_PROMPTS:
+            eng.submit(rng.randint(0, cfg.vocab_size, (plen,)), _SRV_NEW)
+        eng.run_until_idle()
+    finally:
+        eng.close()
+
+
 def _inject(analysis, code: str):
     """A deliberately-hazardous test model per code: proves the gate exits
     1 with the right GL code and eqn provenance."""
@@ -151,9 +175,19 @@ def run(argv=None) -> int:
                     default=None, metavar="PATH",
                     help="write current gate-relevant findings to PATH "
                          "(keeps existing justifications) and exit 0")
-    ap.add_argument("--targets", default="train,decode,churn",
-                    help="comma list of train,decode,churn,none "
+    ap.add_argument("--targets", default="train,decode,serve,churn",
+                    help="comma list of train,decode,serve,churn,none "
                          "(default: all)")
+    ap.add_argument("--cost", action="store_true",
+                    help="also compute static roofline cost reports "
+                         "(FLAGS_graph_cost) and print a per-program "
+                         "summary: GFLOPs, HBM bytes, intensity, "
+                         "compute/memory-bound verdict, tile-padding "
+                         "waste")
+    ap.add_argument("--chip", default=None, metavar="KIND",
+                    help="hardware spec for the --cost roofline (e.g. "
+                         "'v5e', 'v4'; default: probe the local device, "
+                         "falling back to v5e)")
     ap.add_argument("--inject", action="append", default=[],
                     metavar="CODE", help="add a deliberately-hazardous test "
                     "model (gl001|gl004); the gate must exit 1")
@@ -171,13 +205,16 @@ def run(argv=None) -> int:
         from paddle_tpu import analysis
 
         pt.set_flags({"FLAGS_graph_lint": True})
+        if args.cost:
+            pt.set_flags({"FLAGS_graph_cost": True})
+            analysis.clear_cost_reports()
         # the hook announces findings to stderr as programs compile; this
         # CLI renders the collected reports itself — don't print twice
         analysis.set_announce(False)
         analysis.clear_reports()
 
         targets = [t for t in args.targets.split(",") if t]
-        known = {"train", "decode", "churn", "none"}
+        known = {"train", "decode", "serve", "churn", "none"}
         for t in targets:
             if t not in known:
                 raise ValueError(f"unknown target {t!r} (expected "
@@ -186,6 +223,8 @@ def run(argv=None) -> int:
             _lint_train(pt, np)
         if "decode" in targets:
             _lint_decode(pt, np)
+        if "serve" in targets:
+            _lint_serve(pt, np)
 
         all_reports = list(analysis.reports())
         if "churn" in targets:
@@ -219,13 +258,29 @@ def run(argv=None) -> int:
                 print(json.dumps({
                     "code": f.code, "severity": f.severity,
                     "program": f.program, "primitive": f.primitive,
-                    "message": f.message, "provenance": f.provenance,
+                    "message": f.message, "cost": f.cost,
+                    "provenance": f.provenance,
                     "fingerprint": f.fingerprint,
                     "new": not baseline.suppresses(f),
                 }))
         else:
             for rep in all_reports:
                 print(rep.render())
+        if args.cost:
+            import jax
+
+            spec = analysis.chip_spec(
+                args.chip or "",
+                getattr(jax.devices()[0], "device_kind", ""))
+            creps = analysis.cost_reports()
+            if args.json:
+                for c in creps:
+                    print(json.dumps({"cost": c.summary(spec)}))
+            else:
+                print(f"graph_lint: --cost roofline summaries "
+                      f"({len(creps)} program(s), chip {spec.name}):")
+                for c in creps:
+                    print(c.render(spec))
         n_sup = sum(1 for f in gate if baseline.suppresses(f))
         print(f"graph_lint: {len(findings)} finding(s) over "
               f"{len(all_reports)} program(s); {n_sup} baseline-suppressed; "

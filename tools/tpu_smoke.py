@@ -271,6 +271,65 @@ def main() -> int:
         assert eng.allocator.used_pages == 0, "pages leaked on-chip"
         eng.close()
 
+    # -- autotune: ONE real measured candidate sweep on-chip (decode
+    # kernel, small cache), winner must be legal, parity must hold with
+    # the winner forced, and the table must round-trip through replay
+    # validation ----------------------------------------------------------
+    def autotune_sweep():
+        import os
+        import tempfile
+        import time
+
+        import jax
+        import jax.numpy as jnp
+
+        import paddle_tpu.ops.pallas_kernels.decode_attention as da
+        from paddle_tpu.analysis import autotune
+
+        kernel = "decode_attention"
+        shape = {"max_seq": 256, "head_dim": 64}
+        rng2 = np.random.RandomState(7)
+        q = jnp.array(rng2.randn(2, 4, 64), jnp.bfloat16)
+        k = jnp.array(rng2.randn(2, 4, 256, 64), jnp.bfloat16)
+        v = jnp.array(rng2.randn(2, 4, 256, 64), jnp.bfloat16)
+        length = jnp.int32(200)
+
+        def timing(params):
+            # a FRESH jit per candidate: forced params are read at trace
+            # time, and identical avals would otherwise reuse the previous
+            # candidate's compiled executable
+            jitted = jax.jit(lambda *xs: da.decode_attention(*xs))
+            with autotune.force(kernel, params):
+                out = jitted(q, k, v, length)
+                jax.block_until_ready(out)
+                t0 = time.perf_counter()
+                out = jitted(q, k, v, length)
+                jax.block_until_ready(out)
+                return time.perf_counter() - t0
+
+        table = autotune.AutotuneTable()
+        winner, results = autotune.sweep(kernel, shape, "bfloat16", timing,
+                                         table=table, device="tpu_smoke")
+        cands = autotune.enumerate_candidates(kernel, shape, "bfloat16")
+        assert winner is not None and winner in cands, (winner, results)
+        print(f"tpu_smoke: autotune winner {winner} over "
+              f"{len(cands)} candidates")
+        # parity with the winner forced vs the XLA oracle
+        ref = np.asarray(da._xla_decode_reference(
+            q, k, v, length, 0.125), np.float32)
+        with autotune.force(kernel, dict(winner, **{})):
+            got = np.asarray(jax.jit(
+                lambda *xs: da.decode_attention(*xs, sm_scale=0.125))(
+                    q, k, v, length), np.float32)
+        err = float(np.abs(got - ref).max())
+        assert err < 2e-2, f"winner-config parity err={err}"
+        # round-trip + replay validation of the measured entry
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "t.json")
+            table.save(path)
+            loaded = autotune.load_table(path, strict=True)
+            assert loaded.get(kernel, shape, "bfloat16") == winner
+
     check("flash_attention", flash)
     check("decode_attention", decode_attention)
     check("paged_attention", paged_attention)
@@ -279,6 +338,7 @@ def main() -> int:
     check("graph_lint", graph_lint)
     check("checkpoint", checkpoint)
     check("serving_faults", serving_faults)
+    check("autotune_sweep", autotune_sweep)
 
     if failures:
         print(f"tpu_smoke: FAILED: {failures}")
