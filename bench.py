@@ -569,10 +569,11 @@ def main():
               "tokens/s (decode bench failed; see stderr)", 0.0)
 
     # ---- serving (continuous batching) metric: paged KV cache + ONE
-    # batched decode step over all slots, offered load > slot count so
-    # admission/retirement churn is part of the measurement.  Two compiled
-    # programs total; trace counters recorded in the unit prove the step
-    # never retraced as the request mix changed.
+    # fused mixed prefill/decode step over all slots (ragged work-list
+    # kernel), offered load > slot count so admission/retirement churn is
+    # part of the measurement.  One compiled program (all-greedy traffic);
+    # trace counters + ragged grid occupancy recorded in the unit prove
+    # the step never retraced and show how full the launch ran.
     try:
         from paddle_tpu.serving import (
             ServingEngine, reset_serve_trace_counts, serve_trace_counts,
@@ -589,7 +590,7 @@ def main():
         reset_serve_trace_counts()
         analysis.clear_cost_reports()  # this phase's programs only
         eng = ServingEngine(model, **s_kw)
-        # warmup compiles prefill + decode; the timed run reuses both
+        # warmup compiles the fused greedy step; the timed run reuses it
         eng.submit(rng.randint(0, cfg.vocab_size, (plens[0],)), 2)
         eng.run_until_idle()
         m0 = eng.metrics()
@@ -604,6 +605,15 @@ def main():
         s_tokens = sum(len(r.tokens) for r in s_reqs)
         mets = eng.metrics()
         tc = serve_trace_counts()
+        # occupancy over the measured window only: the engine totals are
+        # cumulative and include the warmup request's mostly-empty steps
+        # (same subtraction as tools/serving_bench.py)
+        d_wcap = mets["work_capacity"] - m0["work_capacity"]
+        d_rcap = mets["block_row_capacity"] - m0["block_row_capacity"]
+        grid_occ = ((mets["work_items"] - m0["work_items"]) / d_wcap
+                    if d_wcap else 0.0)
+        q_row_occ = ((mets["block_rows"] - m0["block_rows"]) / d_rcap
+                     if d_rcap else 0.0)
         pt_memory.log_memory("after serving bench")
         _emit(
             f"gpt_{name}_serving_tokens_per_sec_per_chip",
@@ -612,20 +622,19 @@ def main():
             f"page={s_kw['page_size']} ctx={s_kw['max_context']} "
             f"new={s_new} pool={eng.allocator.capacity}pages "
             f"completed={mets['completed']} "
+            f"grid_occ={grid_occ:.3f} "
+            f"q_row_occ={q_row_occ:.3f} "
             f"mem_delta={(mem_after - mem_before) / 2**20:.1f}MiB "
             f"traces={tc} on {'tpu' if on_tpu else 'cpu'})",
             0.0,
         )
         srv_costs = {c.program: c for c in analysis.cost_reports()}
-        # exact invocation counts from the engine's own counters: every
-        # prefill CHUNK runs one prefill_step (multi-chunk prompts run
-        # several), and decode_steps counts actual decode dispatches
-        # (idle/recovery ticks don't run the program)
+        # exact invocation counts from the engine's own counter:
+        # fused_steps counts actual fused dispatches (idle/recovery ticks
+        # don't run the program)
         pairs = [(c, n) for c, n in (
-            (srv_costs.get("prefill_step"),
-             max(int(mets["prefill_chunks"] - m0["prefill_chunks"]), 1)),
-            (srv_costs.get("decode_step"),
-             max(int(mets["decode_steps"] - m0["decode_steps"]), 1)),
+            (srv_costs.get("fused_step"),
+             max(int(mets["fused_steps"] - m0["fused_steps"]), 1)),
         ) if c is not None]
         _emit_roofline("serving", name, pairs, spec, s_dt, on_tpu)
         eng.close()

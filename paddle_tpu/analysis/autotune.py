@@ -43,7 +43,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .codes import (TILE_LANE, TILE_SUBLANE, decode_gate_reason,
                     default_block as _auto_block, flash_gate_reason,
-                    paged_gate_reason)
+                    paged_gate_reason, ragged_gate_reason)
 
 __all__ = [
     "KERNELS", "enumerate_candidates", "default_params", "static_rank",
@@ -52,13 +52,18 @@ __all__ = [
     "validate_table", "sweep",
 ]
 
-KERNELS = ("flash_attention", "decode_attention", "paged_attention")
+KERNELS = ("flash_attention", "decode_attention", "paged_attention",
+           "ragged_paged_attention")
 
 # static VMEM budget for candidate filtering: ~16 MiB/core physical, keep
 # headroom for Mosaic's own buffers and semaphores
 VMEM_BUDGET = 10 << 20
 
 _Q_ROWS_CHOICES = (8, 16)  # query sublane-broadcast rows (8-multiples)
+# ragged fused-step token blocks: how many flat query tokens pack into one
+# work item's MXU pass (8-multiples; larger blocks amortize page DMAs over
+# prefill runs, smaller ones waste fewer padded rows on decode tokens)
+_TOKEN_BLOCK_CHOICES = (8, 16, 32)
 
 
 def _itemsize(dtype: str) -> int:
@@ -119,6 +124,13 @@ def vmem_bytes_estimate(kernel: str, shape: Dict[str, int], dtype: str,
         est = 2 * ((2 * qr * d + 2 * ps * d) * it)
         est += (qr * d + 2 * qr * 128) * 4
         return est
+    if kernel == "ragged_paged_attention":
+        tb = int(params.get("token_block", 8))
+        ps = int(shape["page_size"])
+        # q/out blocks (tb·d), one page of k/v (ps·d), fp32 scratch
+        est = 2 * ((2 * tb * d + 2 * ps * d) * it)
+        est += (tb * d + 2 * tb * 128) * 4
+        return est
     raise ValueError(f"unknown kernel {kernel!r} (expected one of {KERNELS})")
 
 
@@ -154,6 +166,12 @@ def enumerate_candidates(kernel: str, shape: Dict[str, int],
             return []
         for qr in _Q_ROWS_CHOICES:
             out.append({"q_rows": qr})
+    elif kernel == "ragged_paged_attention":
+        ps = int(shape["page_size"])
+        if ragged_gate_reason(ps, d) is not None:
+            return []
+        for tb in _TOKEN_BLOCK_CHOICES:
+            out.append({"token_block": tb})
     else:
         raise ValueError(
             f"unknown kernel {kernel!r} (expected one of {KERNELS})")
@@ -172,6 +190,8 @@ def default_params(kernel: str, shape: Dict[str, int],
         return {"block_kv": _auto_block(int(shape["max_seq"])), "q_rows": 8}
     if kernel == "paged_attention":
         return {"q_rows": 8}
+    if kernel == "ragged_paged_attention":
+        return {"token_block": 8}
     raise ValueError(f"unknown kernel {kernel!r} (expected one of {KERNELS})")
 
 

@@ -19,7 +19,7 @@ __all__ = [
     "CODES", "SEVERITY_RANK", "TILE_SUBLANE", "TILE_LANE",
     "misaligned_dims", "padded_shape", "padding_waste_elems",
     "default_block", "GateReason", "flash_gate_reason",
-    "decode_gate_reason", "paged_gate_reason",
+    "decode_gate_reason", "paged_gate_reason", "ragged_gate_reason",
 ]
 
 # code -> (short name, default severity).  Severities: "error" (correctness
@@ -167,6 +167,27 @@ def paged_gate_reason(page_size: int, head_dim: int) -> Optional[GateReason]:
     KV blocking of ``max_seq``."""
     return _attention_gate(page_size, head_dim, "paged_attention",
                            "page_size")
+
+
+def ragged_gate_reason(page_size: int, head_dim: int,
+                       token_block: int = 8) -> Optional[GateReason]:
+    """None when the ragged paged-attention kernel accepts the (pool,
+    work-list) layout, else the GL002-coded reason it falls back to the
+    XLA gather reference.  Pool rules are the paged kernel's verbatim (a
+    page is one KV block); the query token block additionally must be a
+    sublane multiple — the q rows of every work item form one (8, 128)
+    tile column."""
+    base = _attention_gate(page_size, head_dim, "ragged_paged_attention",
+                           "page_size")
+    problems = [base.detail] if base is not None else []
+    if token_block < TILE_SUBLANE or token_block % TILE_SUBLANE:
+        problems.append(
+            f"token_block={token_block} is not an {TILE_SUBLANE}-multiple "
+            f">= {TILE_SUBLANE} (query sublane rows)")
+    if not problems:
+        return None
+    return GateReason("GL002", "ragged_paged_attention",
+                      "; ".join(problems))
 
 
 # one line per DISTINCT reason (kernel + shape) per process: a decode loop

@@ -62,7 +62,7 @@ __all__ = [
     "HardwareSpec", "chip_spec", "EqnCost", "CostReport",
     "cost", "cost_jaxpr", "cost_static_program",
     "cost_reports", "clear_cost_reports",
-    "dot_flops", "eqn_flops",
+    "dot_flops", "eqn_flops", "ragged_padding_waste",
 ]
 
 
@@ -230,6 +230,44 @@ def _eqn_padding_waste(eqn) -> int:
             continue  # extended dtypes (RNG keys) have no tile layout here
         waste += padding_waste_elems(_shape_of(v)) * itemsize
     return waste
+
+
+def ragged_padding_waste(n_tokens: int, n_blocks: int, n_items: int,
+                         token_block: int, page_size: int, head_dim: int,
+                         dtype="bfloat16") -> dict:
+    """The ragged fused step's HOST-PACKED padding cost — the GL002-style
+    annotation for waste the jaxpr-level pass cannot see, because the
+    padding lives in the kernel's work-list layout, not in any array's
+    (8, 128) tile shape.
+
+    A work item computes one ``[token_block, page_size]`` score tile and
+    one ``[token_block, head_dim]`` accumulator pass whether or not every
+    block row carries a real token; decode tokens fill 1 row of
+    ``token_block``.  Given one step's plan stats (``n_tokens`` real query
+    tokens, ``n_blocks`` packed blocks, ``n_items`` work items) this
+    quotes the padded-away MXU work and the padded q-row bytes with the
+    SAME units GL002's dot annotation uses (``dot_flops(padded=True)``
+    delta), so lint output and serving metrics describe one quantity.
+
+    Returns ``{"padded_rows", "wasted_flops", "wasted_q_bytes"}``."""
+    padded_rows = n_blocks * int(token_block) - int(n_tokens)
+    if padded_rows < 0:
+        raise ValueError(f"n_tokens={n_tokens} exceeds "
+                         f"{n_blocks} x {token_block} block rows")
+    # rows are padded uniformly across a block's work items; each item
+    # pays 2·D·page_size MXU flops per row (QK^T) + 2·D·page_size (P·V)
+    rows_frac = padded_rows / max(n_blocks * int(token_block), 1)
+    item_flops = 4 * int(head_dim) * int(page_size) * int(token_block)
+    wasted_flops = int(round(n_items * item_flops * rows_frac))
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        itemsize = 2
+    return {
+        "padded_rows": padded_rows,
+        "wasted_flops": wasted_flops,
+        "wasted_q_bytes": padded_rows * int(head_dim) * itemsize,
+    }
 
 
 # ---------------------------------------------------------------------------

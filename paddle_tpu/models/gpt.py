@@ -292,27 +292,36 @@ def _attend_with_cache(q: Tensor, k: Tensor, v: Tensor, ck_t: Tensor,
 
 
 def _raw_attend_paged(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
-                      page_size):
+                      page_size, ragged_plan=None):
     """Raw (traced) paged cache write + attend for continuous batching.
 
-    qh/kh/vh: [S, N, C, D] head-major fresh projections (S decode slots);
+    qh/kh/vh: [S, N, C, D] head-major fresh projections (S decode slots —
+    or, on the ragged fused-step path, S flat query TOKENS with C == 1);
     pkr/pvr: [P, N, page_size, D] global page pools; tables: [S, max_pages]
-    int32 page tables; posr: [S] traced per-slot positions.  Returns
+    int32 page tables (per-token rows on the ragged path); posr: [S]
+    traced per-slot/per-token positions.  Returns
     (out [S, N, C, D], new_k_pool, new_v_pool).
 
     Every write translates an absolute position through the page table:
     position p of slot s lands at ``pool[tables[s, p//page_size], :,
     p%page_size]``.  Inactive slots and prefill padding carry null-page
     table entries, so their writes sink into page 0 (never validly read).
-    C == 1 is the batched decode step: scatter one token per slot, then
+    C == 1 is the batched decode step: scatter one token per row, then
     the paged flash-decode kernel (XLA gather fallback off-TPU) over each
-    slot's own pages.  C > 1 is chunked prefill for one admitted request:
-    the chunk scatters into (possibly non-contiguous) pages and attends
-    over the whole gathered context with an absolute-position causal mask,
-    so earlier chunks stay visible — the paged analog of the contiguous
-    chunked-prefill path."""
+    row's own pages — or, with ``ragged_plan`` (the serving engine's fused
+    mixed prefill/decode step), the ragged work-list kernel over the same
+    write: every row is one flat query token whose causal context is its
+    own position, so decode tokens and prefill chunk tokens share the ONE
+    launch (ops/pallas_kernels/ragged_paged_attention.py).  C > 1 is the
+    retired-from-serving chunked prefill path (kept for direct
+    ``_paged_lm_logits`` callers): the chunk scatters into (possibly
+    non-contiguous) pages and attends over the whole gathered context
+    with an absolute-position causal mask."""
     from ..ops.pallas_kernels.paged_attention import (
         gather_pages, paged_attention,
+    )
+    from ..ops.pallas_kernels.ragged_paged_attention import (
+        ragged_paged_attention,
     )
 
     s_, nh, c, d = qh.shape
@@ -322,8 +331,8 @@ def _raw_attend_paged(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
     tbl = tables.astype(jnp.int32)
     abs_pos = pos[:, None] + jax.lax.broadcasted_iota(
         jnp.int32, (s_, c), 1)                               # [S, C]
-    # the clip is defensive: the engine sizes max_ctx to a chunk multiple
-    # so prefill padding never runs past the table (see serving/engine.py)
+    # the clip is defensive: the engine reserves every page a request can
+    # touch up front, so real token positions never run past the table
     page_slot = jnp.clip(abs_pos // page_size, 0, max_pages - 1)
     page_ids = jnp.take_along_axis(tbl, page_slot, axis=1)   # [S, C]
     offs = abs_pos % page_size
@@ -332,7 +341,11 @@ def _raw_attend_paged(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
         jnp.transpose(kh, (0, 2, 1, 3)).astype(pkr.dtype))
     pv2 = pvr.at[page_ids, :, offs, :].set(
         jnp.transpose(vh, (0, 2, 1, 3)).astype(pvr.dtype))
-    if c == 1:
+    if c == 1 and ragged_plan is not None:
+        out = ragged_paged_attention(qh[:, :, 0, :], pk2, pv2, tbl,
+                                     pos + 1, ragged_plan, sm_scale=scale)
+        out = out[:, :, None, :].astype(qh.dtype)
+    elif c == 1:
         out = paged_attention(qh[:, :, 0, :], pk2, pv2, tbl, pos + 1,
                               sm_scale=scale)
         out = out[:, :, None, :].astype(qh.dtype)
@@ -356,21 +369,26 @@ def _raw_attend_paged(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
 
 def _attend_paged(q: Tensor, k: Tensor, v: Tensor, pk_t: Tensor,
                   pv_t: Tensor, tables: Tensor, pos: Tensor,
-                  cfg: GPTConfig) -> Tensor:
+                  cfg: GPTConfig, ragged_plan=None) -> Tensor:
     """Tensor-level paged attention for the layered decoder.  q/k/v:
     [S, C, nh, hd]; mutates the pool Tensors in place (mutation-logged, so
-    jit.to_static donates them to the compiled serving step)."""
+    jit.to_static donates them to the compiled serving step).
+    ``ragged_plan`` (a tuple of RAGGED_PLAN_FIELDS Tensors) routes the
+    C == 1 flat-token path through the ragged work-list kernel."""
     page_size = int(pk_t.shape[-2])
+    plan = tuple(ragged_plan) if ragged_plan is not None else ()
 
-    def raw(qr, kr, vr, pkr, pvr, tbl, posr):
+    def raw(qr, kr, vr, pkr, pvr, tbl, posr, *planr):
         qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (qr, kr, vr))
         out, pk2, pv2 = _raw_attend_paged(
             qh, kh, vh, pkr, pvr, tbl, posr,
-            head_dim=cfg.head_dim, page_size=page_size)
+            head_dim=cfg.head_dim, page_size=page_size,
+            ragged_plan=planr if planr else None)
         return jnp.swapaxes(out, 1, 2), pk2, pv2
 
     out, pk_new, pv_new = ops.dispatch.apply(
-        raw, q, k, v, pk_t, pv_t, tables, pos, op_name="paged_attention")
+        raw, q, k, v, pk_t, pv_t, tables, pos, *plan,
+        op_name="paged_attention")
     pk_t._set_value(pk_new._value)
     pv_t._set_value(pv_new._value)
     return out
@@ -419,7 +437,8 @@ class GPTAttention(Layer):
 
     def forward(self, x: Tensor, attn_mask: Optional[Tensor] = None,
                 layer_kv=None, cache_index=None,
-                page_tables: Optional[Tensor] = None) -> Tensor:
+                page_tables: Optional[Tensor] = None,
+                ragged_plan=None) -> Tensor:
         cfg = self._cfg
         b, s = x.shape[0], x.shape[1]
         nh, hd = cfg.num_heads, cfg.head_dim
@@ -441,9 +460,11 @@ class GPTAttention(Layer):
             ck_t, cv_t = layer_kv
             if page_tables is not None:
                 # continuous-batching path: page-table-translated write
-                # into the global pool, paged decode-attention kernel
+                # into the global pool, paged decode-attention kernel (or
+                # the ragged work-list kernel on the fused mixed step)
                 out = _attend_paged(q, k, v, ck_t, cv_t, page_tables,
-                                    _as_pos(cache_index), cfg)
+                                    _as_pos(cache_index), cfg,
+                                    ragged_plan=ragged_plan)
             else:
                 out = _attend_with_cache(q, k, v, ck_t, cv_t,
                                          _as_pos(cache_index), cfg)
@@ -500,9 +521,11 @@ class GPTDecoderLayer(Layer):
 
     def forward(self, x: Tensor, attn_mask: Optional[Tensor] = None,
                 layer_kv=None, cache_index=None,
-                page_tables: Optional[Tensor] = None) -> Tensor:
+                page_tables: Optional[Tensor] = None,
+                ragged_plan=None) -> Tensor:
         x = x + self.attn(self.ln1(x), attn_mask, layer_kv=layer_kv,
-                          cache_index=cache_index, page_tables=page_tables)
+                          cache_index=cache_index, page_tables=page_tables,
+                          ragged_plan=ragged_plan)
         x = x + self.mlp(self.ln2(x))
         return _seq_shard(x, self._cfg)
 
@@ -522,7 +545,8 @@ class GPTModel(Layer):
     def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None,
                 attn_mask: Optional[Tensor] = None, kv_cache=None,
                 cache_index=None,
-                page_tables: Optional[Tensor] = None) -> Tensor:
+                page_tables: Optional[Tensor] = None,
+                ragged_plan=None) -> Tensor:
         paged = bool(getattr(kv_cache, "paged", False))
         if paged and page_tables is None:
             raise ValueError("a paged KV cache needs page_tables "
@@ -543,7 +567,8 @@ class GPTModel(Layer):
             if kv_cache is not None:
                 h = layer(h, attn_mask, layer_kv=kv_cache.layer(i),
                           cache_index=pos,
-                          page_tables=page_tables if paged else None)
+                          page_tables=page_tables if paged else None,
+                          ragged_plan=ragged_plan if paged else None)
             elif k and (i % k == 0) and self.training:
                 h = recompute(layer, h, attn_mask)
             else:
@@ -567,10 +592,16 @@ class GPTForPretraining(Layer, GenerationMixin):
     def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None,
                 attn_mask: Optional[Tensor] = None, kv_cache=None,
                 cache_index=None,
-                page_tables: Optional[Tensor] = None) -> Tensor:
+                page_tables: Optional[Tensor] = None,
+                ragged_plan=None, out_rows: Optional[Tensor] = None) -> Tensor:
         h = self.gpt(input_ids, position_ids, attn_mask,
                      kv_cache=kv_cache, cache_index=cache_index,
-                     page_tables=page_tables)
+                     page_tables=page_tables, ragged_plan=ragged_plan)
+        if out_rows is not None:
+            # serving fused step: gather each slot's output row BEFORE the
+            # vocab projection, so the LM head projects [S] rows instead of
+            # the whole padded flat-token axis
+            h = ops.gather(h, out_rows, axis=0)
         w = self.gpt.embeddings.word_embeddings.weight  # [V, H]
         logits = ops.matmul(h, w, transpose_y=True)     # [B, S, V]
         return logits
@@ -597,11 +628,16 @@ class GPTForPretraining(Layer, GenerationMixin):
                             stacked=False)
 
     def _paged_lm_logits(self, input_ids, paged_cache, page_tables,
-                         positions):
+                         positions, ragged_plan=None, out_rows=None):
         """[B, S, V] logits over the paged pool: ``positions`` is the
-        per-slot position vector [B], ``page_tables`` [B, max_pages]."""
+        per-slot position vector [B], ``page_tables`` [B, max_pages].
+        With ``ragged_plan`` (the serving engine's fused mixed step),
+        B is the flat token axis (S == 1) and attention runs through the
+        ragged work-list kernel; ``out_rows`` [S] gathers each slot's
+        output row before the vocab projection (-> [S, 1, V])."""
         return self.forward(input_ids, kv_cache=paged_cache,
-                            cache_index=positions, page_tables=page_tables)
+                            cache_index=positions, page_tables=page_tables,
+                            ragged_plan=ragged_plan, out_rows=out_rows)
 
 
 class GPTStackedDecoder(Layer):
@@ -823,7 +859,7 @@ class GPTStackedDecoder(Layer):
         def ln(x, g, b):
             return _ln_f32(x, g, b, eps)
 
-        def block(p, h, kc, vc, tbl, pos):
+        def block(p, h, kc, vc, tbl, pos, ragged_plan=None):
             (l1g, l1b, qkvw, qkvb, pw, pb, l2g, l2b, f1w, f1b, f2w, f2b) = p
             if cdt is not None:
                 qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b = (
@@ -834,7 +870,8 @@ class GPTStackedDecoder(Layer):
             qkv = (x @ qkvw + qkvb).reshape(b, s, 3, nh, hd)
             q, k, v = (jnp.swapaxes(qkv[:, :, i], 1, 2) for i in range(3))
             out, kc, vc = _raw_attend_paged(
-                q, k, v, kc, vc, tbl, pos, head_dim=hd, page_size=page_size)
+                q, k, v, kc, vc, tbl, pos, head_dim=hd, page_size=page_size,
+                ragged_plan=ragged_plan)
             out = jnp.swapaxes(out, 1, 2).reshape(b, s, hidden)
             h = h + (out.astype(pw.dtype) @ pw + pb).astype(h.dtype)
             y = ln(h, l2g, l2b).astype(f1w.dtype)
@@ -844,32 +881,40 @@ class GPTStackedDecoder(Layer):
         return block
 
     def _forward_paged(self, hidden: Tensor, paged_cache, page_tables,
-                       cache_index) -> Tensor:
+                       cache_index, ragged_plan=None) -> Tensor:
         """Serving step over the stacked parameters with a STACKED
         [L, P, H, page_size, D] page pool: lax.scan carries the hidden
         state and scans the per-layer pool slices as xs/ys, exactly like
         _forward_cached scans the contiguous cache.  The updated pool is
         written back in place (mutation-logged -> donated under
-        jit.to_static)."""
+        jit.to_static).  ``ragged_plan`` Tensors are scan constants: one
+        work list serves every layer of the fused mixed step."""
         from ..ops import dispatch
 
         pos = _as_pos(cache_index)
         block = self._paged_block_fn(int(paged_cache.page_size))
+        plan = tuple(ragged_plan) if ragged_plan is not None else ()
+        n_plan = len(plan)
 
-        def raw(h, posr, tbl, pk, pv, *stacked):
+        def raw(h, posr, tbl, *rest):
+            planr = rest[:n_plan] if n_plan else None
+            pk, pv, *stacked = rest[n_plan:]
+
             def step(carry, xs):
                 params, kc, vc = xs[:-2], xs[-2], xs[-1]
                 h2, kc2, vc2 = block(params, carry, kc, vc,
                                      tbl.astype(jnp.int32),
-                                     posr.astype(jnp.int32))
+                                     posr.astype(jnp.int32),
+                                     ragged_plan=planr)
                 return h2, (kc2, vc2)
 
             h2, (pk2, pv2) = jax.lax.scan(step, h, tuple(stacked) + (pk, pv))
             return h2, pk2, pv2
 
         out, pk_new, pv_new = dispatch.apply(
-            raw, hidden, pos, page_tables, paged_cache.k, paged_cache.v,
-            *self._stacked(), op_name="gpt_stacked_decoder_paged")
+            raw, hidden, pos, page_tables, *plan, paged_cache.k,
+            paged_cache.v, *self._stacked(),
+            op_name="gpt_stacked_decoder_paged")
         paged_cache.k._set_value(pk_new._value)
         paged_cache.v._set_value(pv_new._value)
         return out
@@ -905,7 +950,8 @@ class GPTStackedDecoder(Layer):
 
     def forward(self, hidden: Tensor, n_micro: int = 1, kv_cache=None,
                 cache_index=None,
-                page_tables: Optional[Tensor] = None) -> Tensor:
+                page_tables: Optional[Tensor] = None,
+                ragged_plan=None) -> Tensor:
         """hidden: [B, S, H]. With a pp axis > 1, splits B into n_micro
         microbatches and pipelines; else scans layers.  With ``kv_cache``
         (serving), runs the cached decode scan instead — the paged scan
@@ -918,7 +964,8 @@ class GPTStackedDecoder(Layer):
                 if page_tables is None:
                     raise ValueError("a paged KV cache needs page_tables")
                 return self._forward_paged(hidden, kv_cache, page_tables,
-                                           cache_index)
+                                           cache_index,
+                                           ragged_plan=ragged_plan)
             return self._forward_cached(hidden, kv_cache, cache_index)
 
         cfg = self._cfg
@@ -986,7 +1033,8 @@ class GPTStackedForPretraining(Layer, GenerationMixin):
     def forward(self, input_ids: Tensor, position_ids: Optional[Tensor] = None,
                 labels: Optional[Tensor] = None, kv_cache=None,
                 cache_index=None,
-                page_tables: Optional[Tensor] = None) -> Tensor:
+                page_tables: Optional[Tensor] = None,
+                ragged_plan=None, out_rows: Optional[Tensor] = None) -> Tensor:
         """Without ``labels``: returns [B, S, V] logits.  With ``labels``:
         returns the scalar LM loss through the fused linear+cross-entropy
         head (chunked over tokens, logits never fully materialized — the
@@ -999,8 +1047,14 @@ class GPTStackedForPretraining(Layer, GenerationMixin):
                     max=self.config.max_position_embeddings - 1)
         h = self.embeddings(input_ids, position_ids)
         h = self.decoder(h, n_micro=self.n_micro, kv_cache=kv_cache,
-                         cache_index=cache_index, page_tables=page_tables)
+                         cache_index=cache_index, page_tables=page_tables,
+                         ragged_plan=ragged_plan)
         h = self.final_ln(h)
+        if out_rows is not None:
+            # serving fused step: gather each slot's output row BEFORE the
+            # vocab projection, so the LM head projects [S] rows instead of
+            # the whole padded flat-token axis
+            h = ops.gather(h, out_rows, axis=0)
         w = self.embeddings.word_embeddings.weight
         if labels is not None:
             from ..amp.auto_cast import _amp_state
@@ -1031,9 +1085,10 @@ class GPTStackedForPretraining(Layer, GenerationMixin):
                             stacked=True)
 
     def _paged_lm_logits(self, input_ids, paged_cache, page_tables,
-                         positions):
+                         positions, ragged_plan=None, out_rows=None):
         return self.forward(input_ids, kv_cache=paged_cache,
-                            cache_index=positions, page_tables=page_tables)
+                            cache_index=positions, page_tables=page_tables,
+                            ragged_plan=ragged_plan, out_rows=out_rows)
 
 
 class GPTPretrainingCriterion(Layer):

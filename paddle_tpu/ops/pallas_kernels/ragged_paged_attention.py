@@ -1,0 +1,400 @@
+"""Ragged paged attention on TPU — ONE fused launch for mixed
+prefill/decode traffic over the paged KV block pool.
+
+The serving engine's two-phase structure (a ``[1, chunk]`` prefill program
+per admitted request plus a separate all-slots decode step, PR 5) left the
+``(S*H, max_pages)`` paged grid mostly idle whenever request lengths were
+skewed — exactly what production traffic looks like.  Following "Ragged
+Paged Attention: A High-Performance and Flexible LLM Inference Kernel for
+TPU" (PAPERS.md, arxiv 2604.15464), this kernel flattens the step's work
+into token granularity:
+
+- every query token of the step — decode tokens (q_len 1) and prefill
+  chunk tokens (q_len > 1) alike — is one row of a flat ``[T, H, D]``
+  query buffer; the host packs rows into fixed-size **token blocks**
+  (``token_block`` sublane rows, one slot per block, consecutive
+  positions) so a prefill chunk fills an MXU pass that the old design
+  spent on a single broadcast decode row;
+- the grid iterates a host-built **work list** of (token-block, page)
+  tuples — one entry per page a block actually has to read, built from
+  the scheduler's host mirrors (``build_ragged_plan``).  The work-list
+  arrays ride as **scalar-prefetch** arguments so the KV index map
+  resolves each entry's POOL page id before its DMA is issued;
+- entries past the real item count are clamped (the host repeats the last
+  real entry), so their block indices repeat and Pallas elides both the
+  copy and (via ``pl.when``) the compute — the same discipline as the
+  paged kernel's clamped page-slots, now applied to the whole launch;
+- online softmax accumulates across a block's work items (running max m,
+  denominator l, fp32 acc); per-item masking is causal at token
+  granularity: row i of block b (absolute position ``blk_base[b] + i``)
+  attends pool positions ``<=`` its own, rows past ``blk_rows[b]`` are
+  padding (masked everywhere, output rows discarded by the host gather).
+
+Eligibility (``ragged_shape_supported``): the paged kernel's pool rules
+verbatim (``page_size`` a 128-multiple, ``head_dim`` a 64-multiple — a
+page is one KV block) plus ``token_block`` an 8-multiple (one sublane
+tile column); ``analysis/codes.ragged_gate_reason`` is the ONE GL002
+definition.  CPU and ineligible shapes run ``_xla_ragged_reference`` — the
+paged gather oracle applied per token — which is also the parity oracle
+for ``tools/tpu_smoke.py``'s ragged case.  Forward-only: serving never
+differentiates through the pool.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .decode_attention import NEG_INF, _CompilerParams, _dot
+from .flash_attention import _on_tpu
+
+__all__ = [
+    "ragged_paged_attention",
+    "ragged_shape_supported",
+    "ragged_shape_unsupported_reason",
+    "ragged_token_block",
+    "build_ragged_plan",
+    "RAGGED_PLAN_FIELDS",
+]
+
+# the ordered field names of a ragged plan — the host builder emits them,
+# the serving engine ships them (as traced int32 Tensors) into the fused
+# step, and the kernel consumes them positionally
+RAGGED_PLAN_FIELDS = (
+    "blk_tok",      # [NB, QB]  flat token index feeding each block row
+    "tok_blk",      # [T]       inverse map: token -> its block
+    "tok_row",      # [T]       inverse map: token -> its row in the block
+    "blk_base",     # [NB]      absolute position of each block's row 0
+    "blk_rows",     # [NB]      valid rows per block (0 = padding block)
+    "wl_blk",       # [WL]      work item -> token block
+    "wl_page",      # [WL]      work item -> POOL page id (pre-translated)
+    "wl_pageslot",  # [WL]      work item -> page-slot (for position math)
+    "n_items",      # [1]       real work items (tail entries are clamped)
+)
+
+
+def ragged_shape_unsupported_reason(page_size: int, head_dim: int,
+                                    token_block: int = 8):
+    """``None`` when the kernel accepts the layout, else the structured
+    GL002-coded reason (shared with the graph linter)."""
+    from ...analysis.codes import ragged_gate_reason
+
+    return ragged_gate_reason(page_size, head_dim, token_block)
+
+
+def ragged_shape_supported(page_size: int, head_dim: int,
+                           token_block: int = 8) -> bool:
+    """The ONE eligibility gate for this kernel (mirrors
+    paged_attention.paged_shape_supported): pool rules verbatim plus the
+    token block a sublane multiple.  On TPU hosts an ineligible layout is
+    reported once per shape with its GL002 reason instead of silently
+    falling back to the gather reference."""
+    reason = ragged_shape_unsupported_reason(page_size, head_dim,
+                                             token_block)
+    if reason is not None and _on_tpu():
+        from ...analysis.codes import note_fallback
+
+        note_fallback(reason)
+    return reason is None
+
+
+def ragged_token_block(page_size: int, head_dim: int, dtype) -> int:
+    """The query token-block size (sublane rows per work item) for one
+    pool specialization: the autotune table's entry when one exists
+    (``analysis/autotune.py``), else the historical 8.  The serving
+    engine asks ONCE at construction — the host-built plan bakes the
+    block size into every step's work list."""
+    from ...analysis import autotune as _autotune
+
+    tuned = _autotune.kernel_params(
+        "ragged_paged_attention",
+        {"page_size": page_size, "head_dim": head_dim}, dtype)
+    if tuned:
+        tb = int(tuned.get("token_block", 8))
+        if tb >= 8 and tb % 8 == 0:
+            return tb
+    return 8
+
+
+# ---------------------------------------------------------------------------
+# host-side plan construction (numpy; built from the scheduler mirrors)
+# ---------------------------------------------------------------------------
+
+def build_ragged_plan(runs: Sequence[Tuple[int, int, np.ndarray]], *,
+                      token_block: int, page_size: int,
+                      t_max: int, nb_max: int, wl_max: int
+                      ) -> Tuple[Dict[str, np.ndarray], Dict[str, int]]:
+    """Flatten one fused step's work into the kernel's plan arrays.
+
+    ``runs``: one entry per contiguous token run — a decode slot (count 1)
+    or a prefill chunk (count up to the step's token budget) — as
+    ``(base_pos, count, table_row)`` where ``table_row`` is the slot's
+    int32 page-table row.  Token flat order is run-major: run r's tokens
+    occupy flat indices ``[start_r, start_r + count_r)`` in submission
+    order (``stats["run_starts"]`` reports the starts).
+
+    Every array is padded to its fixed maximum (``t_max``/``nb_max``/
+    ``wl_max``) so the compiled step never retraces; the work-list tail
+    REPEATS the last real entry — its block and page indices then repeat,
+    Pallas elides the DMAs, and ``pl.when(w < n_items)`` skips the
+    compute.  Padding block-gather rows point at the block's first token
+    (a valid index; the row is masked in-kernel and discarded by the
+    output gather).
+
+    Returns ``(plan_arrays, stats)``: the arrays keyed by
+    :data:`RAGGED_PLAN_FIELDS`, and stats with ``n_tokens``/``n_blocks``/
+    ``n_items``/``run_starts`` plus the grid-occupancy numerators the
+    serving metrics report."""
+    qb = int(token_block)
+    blk_tok = np.zeros((nb_max, qb), np.int32)
+    tok_blk = np.zeros((t_max,), np.int32)
+    tok_row = np.zeros((t_max,), np.int32)
+    blk_base = np.zeros((nb_max,), np.int32)
+    blk_rows = np.zeros((nb_max,), np.int32)
+    items: List[Tuple[int, int, int]] = []     # (block, pool page, page-slot)
+    t = 0
+    b = 0
+    run_starts: List[int] = []
+    for base, count, table in runs:
+        base, count = int(base), int(count)
+        if count < 1:
+            raise ValueError(f"run with count={count}; every run must "
+                             "carry at least one token")
+        run_starts.append(t)
+        if t + count > t_max:
+            raise ValueError(f"plan overflow: {t + count} tokens > "
+                             f"t_max={t_max}")
+        off = 0
+        while off < count:
+            rows = min(qb, count - off)
+            if b >= nb_max:
+                raise ValueError(f"plan overflow: block {b} >= "
+                                 f"nb_max={nb_max}")
+            blk_tok[b, :rows] = np.arange(t + off, t + off + rows, dtype=np.int32)
+            blk_tok[b, rows:] = t + off
+            blk_base[b] = base + off
+            blk_rows[b] = rows
+            tok_blk[t + off:t + off + rows] = b
+            tok_row[t + off:t + off + rows] = np.arange(rows, dtype=np.int32)
+            last_pos = base + off + rows - 1
+            n_pages = last_pos // page_size + 1
+            for ps_i in range(n_pages):
+                items.append((b, int(table[ps_i]), ps_i))
+            off += rows
+            b += 1
+        t += count
+    n_items = len(items)
+    if n_items > wl_max:
+        raise ValueError(f"plan overflow: {n_items} work items > "
+                         f"wl_max={wl_max}")
+    if n_items == 0:
+        raise ValueError("empty plan: the fused step must not be "
+                         "dispatched with no runs")
+    wl_blk = np.full((wl_max,), items[-1][0], np.int32)
+    wl_page = np.full((wl_max,), items[-1][1], np.int32)
+    wl_ps = np.full((wl_max,), items[-1][2], np.int32)
+    for w, (bi, pg, psi) in enumerate(items):
+        wl_blk[w] = bi
+        wl_page[w] = pg
+        wl_ps[w] = psi
+    plan = {
+        "blk_tok": blk_tok, "tok_blk": tok_blk, "tok_row": tok_row,
+        "blk_base": blk_base, "blk_rows": blk_rows,
+        "wl_blk": wl_blk, "wl_page": wl_page, "wl_pageslot": wl_ps,
+        "n_items": np.array([n_items], np.int32),
+    }
+    stats = {
+        "n_tokens": t, "n_blocks": b, "n_items": n_items,
+        "run_starts": run_starts,
+        # grid occupancy: the fraction of the fixed launch doing real work
+        # (items) and of the block rows carrying real queries (rows)
+        "wl_capacity": wl_max,
+        "row_capacity": b * qb,
+    }
+    return plan, stats
+
+
+# ---------------------------------------------------------------------------
+# kernel
+# ---------------------------------------------------------------------------
+
+def _ragged_kernel(blk_ref, page_ref, ps_ref, ni_ref, base_ref, rows_ref,
+                   q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc, *,
+                   scale, page_size, wl_max):
+    w = pl.program_id(1)
+    n = ni_ref[0]
+    blk = blk_ref[w]
+    live = w < n
+    # block boundaries derived from the prefetched work list: a block's
+    # items are contiguous, so its first/last entries bracket its online-
+    # softmax accumulation.  The tail's clamped entries repeat the last
+    # real block, so `last` fires exactly at item n-1 (not in the tail).
+    first = jnp.logical_or(w == 0, blk_ref[jnp.maximum(w - 1, 0)] != blk)
+    last = jnp.logical_or(w == n - 1,
+                          blk_ref[jnp.minimum(w + 1, wl_max - 1)] != blk)
+
+    @pl.when(jnp.logical_and(live, first))
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0, 0]                             # [QB, D]
+        k = k_ref[0, 0]                             # [page_size, D]
+        v = v_ref[0, 0]
+        s = _dot(q, k, ((1,), (1,))) * np.float32(scale)   # [QB, page_size]
+        rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        cols = ps_ref[w] * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        # token-granular causality: row i sits at absolute position
+        # blk_base + i and may read every pool position <= its own; rows
+        # past blk_rows are block padding (masked everywhere — their
+        # output rows are finite garbage the host gather never reads)
+        row_pos = base_ref[blk] + rows
+        valid = jnp.logical_and(cols <= row_pos, rows < rows_ref[blk])
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_sc[:, :1]                        # [QB, 1]
+        l_prev = l_sc[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        l_cur = jnp.sum(p, axis=-1, keepdims=True)
+        alpha = jnp.exp(m_prev - m_new)
+        acc_sc[...] = acc_sc[...] * alpha + _dot(p.astype(v.dtype), v,
+                                                 ((1,), (0,)))
+        m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[...] = jnp.broadcast_to(alpha * l_prev + l_cur, l_sc.shape)
+
+    @pl.when(jnp.logical_and(live, last))
+    def _finish():
+        l = l_sc[:, :1]
+        l_safe = jnp.where(l == 0.0, np.float32(1.0), l)
+        o_ref[0, 0] = (acc_sc[...] / l_safe).astype(o_ref.dtype)
+
+
+def _ragged_pallas(q_blocks, k_pool, v_pool, wl_blk, wl_page, wl_ps,
+                   n_items, blk_base, blk_rows, scale, interpret=False):
+    """q_blocks: [NB, H, QB, D] host-packed token blocks; k/v pool:
+    [P, H, page_size, D]; work-list + per-block arrays as documented on
+    :data:`RAGGED_PLAN_FIELDS` -> [NB, H, QB, D].  ``interpret=True`` runs
+    the Pallas interpreter (CPU numerics check).
+
+    The grid is ``(H, WL)`` — heads parallel, work items sequential so a
+    block's online softmax accumulates across its pages.  All plan arrays
+    ride as scalar prefetch: the KV index map reads the work item's POOL
+    page id (pre-translated on host) before each DMA, the q/out index
+    maps its block.  Consecutive items of one block repeat the q/out block
+    index (copies elided); the clamped tail repeats the last real entry
+    (everything elided) and ``pl.when(w < n_items)`` skips its compute."""
+    nb, h, qb, d = q_blocks.shape
+    page_size = k_pool.shape[2]
+    wl_max = wl_blk.shape[0]
+    kernel = functools.partial(_ragged_kernel, scale=scale,
+                               page_size=page_size, wl_max=wl_max)
+
+    def q_index(hh, w, blk_ref, page_ref, ps_ref, ni_ref, base_ref,
+                rows_ref):
+        return (blk_ref[w], hh, 0, 0)
+
+    def kv_index(hh, w, blk_ref, page_ref, ps_ref, ni_ref, base_ref,
+                 rows_ref):
+        return (page_ref[w], hh, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(h, wl_max),
+        in_specs=[
+            pl.BlockSpec((1, 1, qb, d), q_index),
+            pl.BlockSpec((1, 1, page_size, d), kv_index),
+            pl.BlockSpec((1, 1, page_size, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, qb, d), q_index),
+        scratch_shapes=[
+            pltpu.VMEM((qb, d), jnp.float32),
+            pltpu.VMEM((qb, 128), jnp.float32),
+            pltpu.VMEM((qb, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nb, h, qb, d), q_blocks.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(wl_blk.astype(jnp.int32), wl_page.astype(jnp.int32),
+      wl_ps.astype(jnp.int32), jnp.reshape(n_items, (1,)).astype(jnp.int32),
+      blk_base.astype(jnp.int32), blk_rows.astype(jnp.int32),
+      q_blocks, k_pool, v_pool)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+def ragged_paged_attention(q, k_pool, v_pool, token_tables, lengths, plan,
+                           *, sm_scale=None, interpret=False):
+    """Token-granular attention over the paged KV pool for one fused
+    mixed prefill/decode step.
+
+    q:            [T, H, D]   — EVERY query token of the step, flat
+                  (decode tokens and prefill chunk tokens mixed)
+    k_pool:       [P, H, page_size, D] — the global page pool
+    v_pool:       [P, H, page_size, D]
+    token_tables: [T, max_pages] int32 — each token's SLOT page-table row
+                  (consumed by the gather fallback; the kernel path reads
+                  pool pages straight from the pre-translated work list)
+    lengths:      [T] int32 — valid context per token (position + 1)
+    plan:         the :data:`RAGGED_PLAN_FIELDS` arrays from
+                  :func:`build_ragged_plan`
+    returns       [T, H, D]
+
+    Routes to the Pallas ragged kernel on TPU when the layout is eligible,
+    else the XLA gather reference (identical numerics; also the CPU
+    serving path)."""
+    p_, h, page_size, d = k_pool.shape
+    scale = float(sm_scale if sm_scale is not None else 1.0 / (d ** 0.5))
+    q = q.astype(k_pool.dtype)
+    (blk_tok, tok_blk, tok_row, blk_base, blk_rows,
+     wl_blk, wl_page, wl_ps, n_items) = plan
+    qb = int(blk_tok.shape[1])
+    use_kernel = (_on_tpu() and ragged_shape_supported(page_size, d, qb)) \
+        or interpret
+    if use_kernel:
+        nb = blk_tok.shape[0]
+        qg = jnp.take(q, jnp.reshape(blk_tok, (-1,)), axis=0)
+        qg = jnp.transpose(qg.reshape(nb, qb, h, d), (0, 2, 1, 3))
+        out = _ragged_pallas(qg, k_pool, v_pool, wl_blk, wl_page, wl_ps,
+                             n_items, blk_base, blk_rows, scale,
+                             interpret=interpret)
+        flat = jnp.transpose(out, (0, 2, 1, 3)).reshape(nb * qb, h, d)
+        idx = tok_blk.astype(jnp.int32) * qb + tok_row.astype(jnp.int32)
+        return jnp.take(flat, idx, axis=0)
+    return _xla_ragged_reference(q, k_pool, v_pool, token_tables, lengths,
+                                 scale)
+
+
+def _xla_ragged_reference(q, k_pool, v_pool, token_tables, lengths, scale):
+    """jnp-composed reference: the paged gather oracle applied per TOKEN —
+    each flat query token gathers its slot's pages and runs masked
+    single-query attention over its own ``length`` positions (fp32
+    softmax).  BITWISE ``paged_attention._xla_paged_reference`` with the
+    per-token tables/lengths, which makes the old per-slot decode
+    semantics a strict special case (T == num_slots, one token per slot).
+    The fallback AND the parity oracle for tpu_smoke's ragged case;
+    length-0 tokens return zeros."""
+    from .paged_attention import _xla_paged_reference
+
+    return _xla_paged_reference(q, k_pool, v_pool, token_tables, lengths,
+                                scale)

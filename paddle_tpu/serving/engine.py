@@ -1,21 +1,29 @@
-"""ServingEngine: continuous batching over the paged KV cache.
+"""ServingEngine: continuous batching over the paged KV cache with ONE
+fused mixed prefill/decode step.
 
-One engine serves an arbitrary stream of requests with TWO compiled
-programs (greedy traffic — the common case) for the whole lifetime of the
-process, plus two more only if sampling requests ever arrive:
+One engine serves an arbitrary stream of requests with ONE compiled
+program (greedy traffic — the common case) for the whole lifetime of the
+process, plus one more only if sampling requests ever arrive:
 
-- **prefill** — ``[1, prefill_chunk]`` ids for one admitted request,
-  page-table-translated writes into its reserved pages (chunked prompts
-  reuse the same program per chunk; the final chunk samples the first
-  generated token from the last real position's logits);
-- **decode** — ONE donated, retrace-free step over ALL slots at once:
-  ``[num_slots]`` last tokens + per-slot positions/page tables/sampling
-  params in, next tokens out.  Inactive slots ride along masked (null-page
-  table rows, position 0) so the step's shapes never change as requests
-  arrive and finish — zero retraces under churn, asserted by
+- **fused step** — every tick dispatches a single donated, retrace-free
+  program serving ALL seated decode slots AND a budgeted number of
+  prefill tokens from admitting requests (``prefill_token_budget``), at
+  token granularity: the step's inputs are a flat ``[T, 1]`` token list
+  (decode tokens and prefill chunk tokens mixed), per-token positions and
+  page-table rows, and the host-built ragged work list that
+  ``ops/pallas_kernels/ragged_paged_attention.py`` iterates on TPU.
+  Every token's K/V scatters into the pool at its absolute position, then
+  attends causally over its own slot's pages up to itself — so a prefill
+  chunk's tokens see each other through the pool within the SAME launch,
+  and there is no prefill/decode phase barrier left (the PR-5
+  per-request ``[1, chunk]`` prefill program is retired).  A slot whose
+  prompt completes this step samples its first generated token from its
+  last prompt row — prefill piggybacks on decode, vLLM-style.  Padding
+  tokens ride with null-page tables and position 0 so the shapes never
+  change as the mix churns — zero retraces, asserted by
   ``serve_trace_counts()`` exactly like ``models/generation``.
 
-Each phase has a greedy variant (pure argmax — no full-vocab sort,
+The step has a greedy variant (pure argmax — no full-vocab sort,
 softmax, or RNG traffic on the hot path) and a sampling variant (per-slot
 traced temperature/top-k/top-p vectors; greedy rows inside a mixed batch
 stay bit-exact).  The host picks per step; both stay cached, so the
@@ -77,10 +85,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import ops
+from ..analysis.cost_model import ragged_padding_waste
 from ..ops import dispatch
+from ..ops.pallas_kernels.ragged_paged_attention import (
+    RAGGED_PLAN_FIELDS, build_ragged_plan, ragged_token_block,
+)
 from ..tensor import Tensor, to_tensor
 from .paged_cache import BlockAllocator
-from .scheduler import Scheduler
+from .scheduler import Scheduler, StepWork
 
 __all__ = [
     "RequestState", "SamplingParams", "Request", "RequestQueue",
@@ -279,8 +291,9 @@ class RequestQueue:
 
 # python-body execution counters (same invariant as models/generation):
 # the step bodies run ONLY while tracing — frozen counters across N steps
-# of request churn == the retrace-freedom proof.
-_SERVE_TRACE_COUNTS = {"prefill": 0, "decode": 0}
+# of request churn == the retrace-freedom proof.  One key since the fused
+# step collapsed the prefill/decode phase pair.
+_SERVE_TRACE_COUNTS = {"fused": 0}
 
 
 def serve_trace_counts() -> dict:
@@ -288,8 +301,7 @@ def serve_trace_counts() -> dict:
 
 
 def reset_serve_trace_counts():
-    _SERVE_TRACE_COUNTS["prefill"] = 0
-    _SERVE_TRACE_COUNTS["decode"] = 0
+    _SERVE_TRACE_COUNTS["fused"] = 0
 
 
 def _sample_per_slot(logits: Tensor, temperature: Tensor, top_p: Tensor,
@@ -328,14 +340,17 @@ def _sample_per_slot(logits: Tensor, temperature: Tensor, top_p: Tensor,
                                   do_sample, _cacheable=False)
 
 
-def _take_position(logits: Tensor, idx: Tensor) -> Tensor:
-    """logits [1, C, V], traced scalar idx -> [1, V] (the last REAL prompt
-    position of a padded prefill chunk)."""
-    def fn(lg, i):
-        sl = jax.lax.dynamic_slice_in_dim(lg, i.astype(jnp.int32), 1, axis=1)
-        return sl[:, 0, :]
+def _drop_seq_axis(logits: Tensor) -> Tensor:
+    """logits [S, 1, V] (the fused step's PRE-GATHERED slot-output rows —
+    the model gathers ``out_rows`` before its vocab projection, so only
+    [S] rows are ever projected) -> [S, V].  Each row is a slot's OUTPUT
+    token — its decode token, or the last prompt token of a prefill run
+    completing this step.  Slots with no output this step point at row 0;
+    the host ignores their sampled token/finiteness."""
+    def fn(lg):
+        return lg[:, -1, :]
 
-    return dispatch.apply_nondiff(fn, logits, idx)
+    return dispatch.apply_nondiff(fn, logits)
 
 
 def _slotwise_finite(logits: Tensor) -> Tensor:
@@ -450,6 +465,7 @@ class ServingEngine:
                  page_size: int = 128, max_context: Optional[int] = None,
                  num_pages: Optional[int] = None,
                  cache_dtype: str = "bfloat16",
+                 prefill_token_budget: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  stall_budget_s: Optional[float] = None,
                  compile_budget_s: float = 300.0,
@@ -467,13 +483,18 @@ class ServingEngine:
             raise ValueError(
                 f"max_context={max_context} must be a multiple of "
                 f"page_size={page_size}")
-        prefill_chunk = int(prefill_chunk or min(page_size, max_context))
-        if max_context % prefill_chunk:
-            # guarantees prefill padding never runs past a slot's table
-            # (see _raw_attend_paged's defensive clip)
+        # the per-step prefill token budget (``prefill_chunk`` accepted as
+        # the historical alias): how many prompt tokens may piggyback on
+        # one fused step alongside every decode slot.  Any value >= 1 is
+        # legal — runs never pad past a slot's table because every real
+        # token's position sits inside its admission-reserved pages.
+        if prefill_token_budget is None:
+            prefill_token_budget = prefill_chunk
+        prefill_token_budget = int(prefill_token_budget
+                                   or min(page_size, max_context))
+        if prefill_token_budget < 1:
             raise ValueError(
-                f"max_context={max_context} must be a multiple of "
-                f"prefill_chunk={prefill_chunk}")
+                f"prefill_token_budget={prefill_token_budget} must be >= 1")
         max_pages_per_slot = max_context // page_size
         if num_pages is None:
             num_pages = num_slots * max_pages_per_slot + 1  # + null page
@@ -481,7 +502,7 @@ class ServingEngine:
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
         self.max_context = max_context
-        self.prefill_chunk = prefill_chunk
+        self.prefill_token_budget = prefill_token_budget
         self.cache_dtype = str(cache_dtype)
         self.num_pages = int(num_pages)
         self.cache = model.new_paged_kv_cache(num_pages, page_size,
@@ -492,6 +513,24 @@ class ServingEngine:
         self.queue = RequestQueue(max_depth=max_queue_depth)
         self._lock = threading.RLock()
         self._closed = False
+
+        # fixed fused-step geometry: the flat token axis, block count, and
+        # work-list length are engine constants (retrace-freedom); the
+        # token-block size comes from the autotune table for this pool
+        # specialization (ops/pallas_kernels/ragged_paged_attention.py)
+        self.head_dim = int(cfg.head_dim)
+        self.token_block = ragged_token_block(self.page_size, cfg.head_dim,
+                                              self.cache_dtype)
+        self._t_max = self.num_slots + self.prefill_token_budget
+        # blocks: a slot contributes ONE run per step — a decode token
+        # (one block) or a prefill run of c tokens (1 + (c-1)//qb blocks).
+        # With P prefill runs sharing the budget, total blocks <=
+        # (D + P) + (budget - P)//qb <= num_slots + budget//qb — tight,
+        # with no double count for decode-vs-prefill (a slot is never
+        # both in one step)
+        self._nb_max = (self.num_slots
+                        + self.prefill_token_budget // self.token_block)
+        self._wl_max = self._nb_max * max_pages_per_slot
 
         # fault-containment state
         self.stall_budget_s = (None if stall_budget_s is None
@@ -518,76 +557,108 @@ class ServingEngine:
         self._top_p = np.ones((num_slots,), np.float32)
         self._top_k = np.zeros((num_slots,), np.int32)
         self._do_sample = np.zeros((num_slots,), bool)
+        # all int32 step inputs (tables/positions/out_rows + the 9 ragged
+        # plan arrays) ship as ONE packed flat vector: one host->device
+        # transfer per step instead of twelve — at serving step rates the
+        # per-array device_put overhead dominates the tiny payloads.
+        # Layout is fixed at construction; the compiled step slices it
+        # back apart with static offsets (free under XLA).
+        mp_ = max_pages_per_slot
+        self._pack_layout = [
+            ("tables", (self._t_max, mp_)),
+            ("positions", (self._t_max,)),
+            ("out_rows", (self.num_slots,)),
+            ("blk_tok", (self._nb_max, self.token_block)),
+            ("tok_blk", (self._t_max,)),
+            ("tok_row", (self._t_max,)),
+            ("blk_base", (self._nb_max,)),
+            ("blk_rows", (self._nb_max,)),
+            ("wl_blk", (self._wl_max,)),
+            ("wl_page", (self._wl_max,)),
+            ("wl_pageslot", (self._wl_max,)),
+            ("n_items", (1,)),
+        ]
+        self._pack_slices = {}
+        off = 0
+        for name, shp in self._pack_layout:
+            n = int(np.prod(shp))
+            self._pack_slices[name] = (off, off + n, shp)
+            off += n
+        self._pack_total = off
+        # the sampling vectors only change at admission/retirement: cache
+        # their device copies and re-upload only when a mirror mutates
+        self._sampling_cache = None
 
         self._totals = {"steps": 0, "tokens": 0, "admitted": 0,
-                        "completed": 0, "prefill_chunks": 0,
-                        "decode_steps": 0,
+                        "completed": 0,
+                        # fused-step accounting: exact dispatch count (the
+                        # bench roofline denominator), prefill tokens that
+                        # piggybacked, and the ragged grid-occupancy
+                        # numerators/denominators (see metrics())
+                        "fused_steps": 0, "prefill_tokens": 0,
+                        "work_items": 0, "work_capacity": 0,
+                        "block_rows": 0, "block_row_capacity": 0,
+                        # host-packing padding cost in GL002's units
+                        # (analysis/cost_model.ragged_padding_waste): block
+                        # rows that carried no real token and the MXU flops
+                        # the launch spent on them anyway
+                        "padded_rows": 0, "padded_flops": 0,
                         # fault-containment counters (admission path SLOs)
                         "failed": 0, "cancelled": 0, "timed_out": 0,
                         "shed": 0, "quarantined": 0, "step_retries": 0,
                         "recoveries": 0, "rebuilds": 0}
         self._step_emitted = 0           # tokens emitted in the current step
         self._last_metrics: dict = {}
+        self._last_occupancy = (0.0, 0.0)   # (grid, q-row) of the last step
 
         self._build_steps()
 
     def _build_steps(self):
-        """Compile-on-first-use prefill/decode closures over the CURRENT
-        page pool.  Called at init and again by ``_rebuild`` after a
+        """Compile-on-first-use fused-step closures over the CURRENT page
+        pool.  Called at init and again by ``_rebuild`` after a
         stalled/crashed step: fresh closures capture the fresh pool
         Tensors, so an abandoned zombie step's eventual write-backs land
         in the ORPHANED old Tensors, never in live state."""
         model, cache = self.model, self.cache
         from ..jit.api import to_static
 
-        # two compiled variants per phase, chosen host-side per step: the
-        # greedy one is a pure argmax (no full-vocab sort / softmax /
-        # gumbel, no RNG-state traffic) — all-greedy traffic, the common
-        # serving case, never pays the sampling machinery.  Mixed batches
-        # take the sampling variant, whose per-slot `do_sample` vector
-        # still reproduces greedy rows bit-exactly.  Every variant ALSO
-        # returns the fused per-slot finiteness flags (the NaN sentry).
-        def _mk_prefill(with_sampling):
-            def prefill_step(ids, tables, positions, last_idx, temp, top_p,
-                             top_k, do_sample):
-                _SERVE_TRACE_COUNTS["prefill"] += 1
+        # two compiled variants, chosen host-side per step: the greedy
+        # one is a pure argmax (no full-vocab sort / softmax / gumbel, no
+        # RNG-state traffic) — all-greedy traffic, the common serving
+        # case, never pays the sampling machinery.  Mixed batches take
+        # the sampling variant, whose per-slot `do_sample` vector still
+        # reproduces greedy rows bit-exactly.  Both variants ALSO return
+        # the fused per-slot finiteness flags (the NaN sentry) gathered
+        # at each slot's output row — zero extra host syncs.
+        slices = [self._pack_slices[name] for name, _ in self._pack_layout]
+
+        def _unpack(p):
+            return tuple(jnp.reshape(p[a:b], shp) for a, b, shp in slices)
+
+        def _mk_fused(with_sampling):
+            def fused_step(ids, packed, temp, top_p, top_k, do_sample):
+                _SERVE_TRACE_COUNTS["fused"] += 1
+                (token_tables, positions, out_rows, *plan) = \
+                    dispatch.apply_nondiff(_unpack, packed)
+                plan = tuple(plan)
                 with dispatch.no_grad():
-                    logits = model._paged_lm_logits(ids, cache, tables,
-                                                    positions)
-                    last = _take_position(logits, last_idx).astype("float32")
-                    fin = _slotwise_finite(last)
+                    logits = model._paged_lm_logits(ids, cache,
+                                                    token_tables, positions,
+                                                    ragged_plan=plan,
+                                                    out_rows=out_rows)
+                    rows = _drop_seq_axis(logits).astype("float32")
+                    fin = _slotwise_finite(rows)
                     if with_sampling:
-                        tok = _sample_per_slot(last, temp, top_p, top_k,
+                        tok = _sample_per_slot(rows, temp, top_p, top_k,
                                                do_sample)
                     else:
-                        tok = ops.argmax(last, axis=-1)
+                        tok = ops.argmax(rows, axis=-1)
                 return tok, fin
 
-            return prefill_step
+            return fused_step
 
-        def _mk_decode(with_sampling):
-            def decode_step(tokens, tables, positions, temp, top_p, top_k,
-                            do_sample):
-                _SERVE_TRACE_COUNTS["decode"] += 1
-                with dispatch.no_grad():
-                    ids = ops.reshape(tokens, [-1, 1])
-                    logits = model._paged_lm_logits(ids, cache, tables,
-                                                    positions)
-                    last = logits[:, -1, :].astype("float32")
-                    fin = _slotwise_finite(last)
-                    if with_sampling:
-                        tok = _sample_per_slot(last, temp, top_p, top_k,
-                                               do_sample)
-                    else:
-                        tok = ops.argmax(last, axis=-1)
-                return tok, fin
-
-            return decode_step
-
-        self._prefill_greedy = to_static(_mk_prefill(False))
-        self._prefill_sample = to_static(_mk_prefill(True))
-        self._decode_greedy = to_static(_mk_decode(False))
-        self._decode_sample = to_static(_mk_decode(True))
+        self._fused_greedy = to_static(_mk_fused(False))
+        self._fused_sample = to_static(_mk_fused(True))
 
     # -- submission --------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, *,
@@ -634,15 +705,16 @@ class ServingEngine:
     # -- the serving loop --------------------------------------------------
     def step(self) -> dict:
         """One scheduler tick: reap cancelled/expired requests, admit what
-        fits, run ONE batched decode step over every active slot
-        (supervised, retried once, finiteness-checked), retire finished
-        requests (their pages free immediately).  A crashed or stalled
-        step never escapes: the implicated requests end FAILED and the
-        engine recovers.  Returns this step's metrics."""
+        fits (admission only reserves pages and seats — no dispatch), then
+        run ONE fused mixed prefill/decode step over every seated slot's
+        work (supervised, retried once, finiteness-checked), retire
+        finished requests (their pages free immediately).  A crashed or
+        stalled step never escapes: the implicated requests end FAILED and
+        the engine recovers.  Returns this step's metrics."""
         with self._lock, self._eval_mode():
             # under the lock: close() also serializes on it, so a racing
             # close cannot delete the pool between this check and the
-            # decode dispatch
+            # fused dispatch
             self._check_open()
             t0 = time.perf_counter()
             self._step_emitted = 0
@@ -650,9 +722,14 @@ class ServingEngine:
             self._reap(now)
             self._admit(now)
             sched = self.scheduler
-            if sched.active_slots:
+            work = sched.plan_step(self.prefill_token_budget)
+            if work:
+                # the step's flat inputs are a pure function of the host
+                # mirrors, which only advance on success — a retry after a
+                # transient failure rebuilds the SAME idempotent scatter
+                inputs, stats = self._build_step_inputs(work)
                 try:
-                    out = self._run_decode()
+                    out = self._run_fused(inputs)
                 except StepStalledError as e:
                     self._recover(e, rebuild=True, stalled=True)
                     out = None
@@ -660,16 +737,17 @@ class ServingEngine:
                     self._recover(e, rebuild=not _state_intact(e))
                     out = None
                 if out is not None:
-                    # exact count of decode_step program executions —
-                    # bench.py's serving roofline denominator (ticks with
-                    # no active slots / failed dispatches don't run one)
-                    self._totals["decode_steps"] += 1
-                    self._harvest_decode(*out)
+                    # exact count of fused program executions — bench.py's
+                    # serving roofline denominator (ticks with no seated
+                    # work / failed dispatches don't run one)
+                    self._totals["fused_steps"] += 1
+                    self._harvest_fused(work, stats, *out)
                     self._backoff_s = self.readmission_backoff_s
             dt = time.perf_counter() - t0
             emitted = self._step_emitted
             self._totals["steps"] += 1
             self._totals["tokens"] += emitted
+            grid_occ, row_occ = self._last_occupancy
             self._last_metrics = {
                 "active_slots": sched.active_slots,
                 "queue_depth": self.queue.depth,
@@ -679,6 +757,11 @@ class ServingEngine:
                 "tokens_this_step": emitted,
                 "tokens_per_sec": emitted / dt if dt > 0 else 0.0,
                 "step_seconds": dt,
+                # ragged-launch occupancy of the last dispatched step:
+                # real work items / fixed work-list length, and real query
+                # rows / packed block rows (the MXU-side figure)
+                "grid_occupancy": grid_occ,
+                "q_row_occupancy": row_occ,
                 # fault counters ride every step's metrics (admission SLOs)
                 "failed": self._totals["failed"],
                 "cancelled": self._totals["cancelled"],
@@ -688,73 +771,161 @@ class ServingEngine:
             }
             return dict(self._last_metrics)
 
-    def _run_decode(self) -> Tuple[np.ndarray, np.ndarray]:
-        """Dispatch the batched decode step under the watchdog; one
-        immediate retry on a (transient) exception.  A stall is never
-        retried — the worker is already wedged."""
-        decode = (self._decode_sample if self._do_sample.any()
-                  else self._decode_greedy)
-        budget = self._budget_for([decode])
-        thunk = lambda cancelled: self._decode_thunk(decode, cancelled)  # noqa: E731,E501
+    def _run_fused(self, inputs) -> Tuple[np.ndarray, np.ndarray]:
+        """Dispatch the fused step under the watchdog; one immediate retry
+        on a (transient) exception.  A stall is never retried — the worker
+        is already wedged."""
+        fused = (self._fused_sample if self._do_sample.any()
+                 else self._fused_greedy)
+        budget = self._budget_for([fused])
+        thunk = lambda cancelled: self._fused_thunk(fused, inputs, cancelled)  # noqa: E731,E501
         try:
-            return self._supervised(thunk, budget)
+            toks, fin, built = self._supervised(thunk, budget)
         except StepStalledError:
             raise
         except Exception:  # noqa: BLE001 — transient device errors retry once
             self._totals["step_retries"] += 1
-            return self._supervised(thunk, budget)
+            toks, fin, built = self._supervised(thunk, budget)
+        if built is not None:
+            # commit on THIS thread, under the step lock: _supervised only
+            # returns results of non-abandoned runs, so a zombie's build
+            # never lands here
+            self._sampling_cache = built
+        return toks, fin
 
-    def _budget_for(self, static_fns, chunks: int = 1) -> Optional[float]:
+    def _budget_for(self, static_fns) -> Optional[float]:
         """Watchdog budget for one supervised dispatch: the stall budget
-        per compiled program (× chunks for a chunked prefill), or the much
-        larger compile budget when ANY variant the dispatch will call has
-        not compiled yet — XLA compilation is slow, not stalled."""
+        per compiled program, or the much larger compile budget when the
+        variant the dispatch will call has not compiled yet — XLA
+        compilation is slow, not stalled."""
         if self.stall_budget_s is None:
             return None
         if any(not f.code_cache for f in static_fns):
-            return max(self.compile_budget_s, self.stall_budget_s * chunks)
-        return self.stall_budget_s * chunks
+            return max(self.compile_budget_s, self.stall_budget_s)
+        return self.stall_budget_s
 
-    def _decode_thunk(self, decode, cancelled) -> Tuple[np.ndarray,
-                                                        np.ndarray]:
+    def _build_step_inputs(self, work) -> Tuple[tuple, dict]:
+        """Flatten one tick's :class:`StepWork` plan into the fused step's
+        fixed-shape numpy inputs: the flat token list (decode tokens from
+        the last-sampled mirrors, prefill tokens from each slot's pending
+        prompt), per-token positions and page-table rows, each slot's
+        output-row index, and the ragged work-list arrays from
+        ``build_ragged_plan``.  Padding tokens carry id 0, position 0 and
+        the null-page table row — their writes sink into page 0 and their
+        output rows are never gathered."""
+        sched = self.scheduler
+        ids = np.zeros((self._t_max,), np.int64)
+        # fresh buffer per step (never reused: an abandoned zombie worker
+        # may still be reading the previous step's arrays)
+        packed = np.zeros((self._pack_total,), np.int32)
+
+        def view(name):
+            a, b, shp = self._pack_slices[name]
+            return packed[a:b].reshape(shp)
+
+        tables = view("tables")
+        positions = view("positions")
+        out_rows = view("out_rows")
+        runs = []
+        t = 0
+        for w in work:
+            slot = sched.slots[w.slot]
+            if w.kind == "prefill":
+                ids[t:t + w.count] = slot.pending[:w.count]
+            else:
+                ids[t] = self._tokens[w.slot]
+            row = sched.tables[w.slot]
+            tables[t:t + w.count] = row
+            positions[t:t + w.count] = w.base + np.arange(w.count,
+                                                          dtype=np.int32)
+            if w.has_output:
+                out_rows[w.slot] = t + w.count - 1
+            runs.append((w.base, w.count, row))
+            t += w.count
+        plan, stats = build_ragged_plan(
+            runs, token_block=self.token_block, page_size=self.page_size,
+            t_max=self._t_max, nb_max=self._nb_max, wl_max=self._wl_max)
+        for k in RAGGED_PLAN_FIELDS:
+            view(k)[...] = plan[k]
+        return (ids[:, None], packed), stats
+
+    def _fused_thunk(self, fused, inputs, cancelled):
         self._hook("before_decode")
         if cancelled():          # abandoned while the fault hook stalled:
             return None          # the result is discarded; skip dispatch
-        sched = self.scheduler
-        toks, fin = decode(
-            to_tensor(self._tokens),
-            to_tensor(np.ascontiguousarray(sched.tables)),
-            to_tensor(np.ascontiguousarray(sched.positions)),
-            to_tensor(self._temp), to_tensor(self._top_p),
-            to_tensor(self._top_k), to_tensor(self._do_sample))
+        cache = self._sampling_cache
+        built = None
+        if cache is None:
+            # snapshot copies: the cached device Tensors must not alias
+            # the live mirrors a later admission mutates.  Built into a
+            # LOCAL — _run_fused commits it only when this run finishes
+            # within budget, so an abandoned zombie (racing a recovery
+            # that already invalidated the cache and re-admitted with new
+            # sampling params) can never overwrite live sampling state.
+            built = cache = (
+                to_tensor(self._temp.copy()), to_tensor(self._top_p.copy()),
+                to_tensor(self._top_k.copy()),
+                to_tensor(self._do_sample.copy()))
+        toks, fin = fused(
+            *(to_tensor(np.ascontiguousarray(a)) for a in inputs),
+            *cache)
         return (np.asarray(toks.numpy()),
-                np.array(np.asarray(fin.numpy()), bool))
+                np.array(np.asarray(fin.numpy()), bool), built)
 
-    def _harvest_decode(self, toks_np: np.ndarray, fin_np: np.ndarray):
-        """Fold one decode step's results back into the request states:
-        quarantine NaN-poisoned slots, advance/emit the rest."""
+    def _harvest_fused(self, work, stats, toks_np: np.ndarray,
+                       fin_np: np.ndarray):
+        """Fold one fused step's results back into the request states:
+        consume prefill runs, quarantine NaN-poisoned output slots,
+        advance/emit the rest.  Mirrors and pending prompts only move
+        HERE — a failed dispatch leaves them untouched for the retry."""
         ctx = {"tokens": toks_np, "finite": fin_np}
         self._hook("after_decode", ctx)
         sched = self.scheduler
-        for i in range(self.num_slots):
-            slot = sched.slots[i]
+        self._totals["prefill_tokens"] += sum(
+            w.count for w in work if w.kind == "prefill")
+        self._totals["work_items"] += stats["n_items"]
+        self._totals["work_capacity"] += stats["wl_capacity"]
+        self._totals["block_rows"] += stats["n_tokens"]
+        self._totals["block_row_capacity"] += stats["row_capacity"]
+        waste = ragged_padding_waste(
+            stats["n_tokens"], stats["n_blocks"], stats["n_items"],
+            self.token_block, self.page_size, self.head_dim,
+            dtype=self.cache_dtype)
+        self._totals["padded_rows"] += waste["padded_rows"]
+        self._totals["padded_flops"] += waste["wasted_flops"]
+        self._last_occupancy = (
+            stats["n_items"] / stats["wl_capacity"],
+            stats["n_tokens"] / max(stats["row_capacity"], 1))
+        for w in work:
+            slot = sched.slots[w.slot]
             if slot is None:
                 continue
-            if not ctx["finite"][i]:
+            if w.kind == "prefill":
+                slot.pending = slot.pending[w.count:]
+            if w.has_output and not ctx["finite"][w.slot]:
                 # finiteness sentry: quarantine the poisoned slot instead
                 # of streaming garbage; every other slot proceeds
                 self._totals["quarantined"] += 1
-                self._fail_slot(i, NaNLogitsError(
+                self._fail_slot(w.slot, NaNLogitsError(
                     f"request {slot.request.id}: non-finite logits at "
-                    f"position {slot.pos} (slot {i} quarantined)"))
+                    f"position {slot.pos + w.count - 1} "
+                    f"(slot {w.slot} quarantined)"))
                 continue
-            # the step wrote the fed token's K/V at slot.pos
-            sched.advance(i)
-            tok = int(ctx["tokens"][i])
-            self._tokens[i] = tok
-            self._emit(slot.request, tok)
-            if self._is_finished(slot.request, tok):
-                self._finish(i)
+            # the step wrote this run's K/V at positions base..base+count-1
+            sched.advance(w.slot, w.count)
+            if not w.has_output:
+                continue                 # mid-prefill: nothing sampled yet
+            req = slot.request
+            tok = int(ctx["tokens"][w.slot])
+            if w.kind == "prefill":
+                # the prompt completed THIS step: the sampled token is the
+                # request's first generated token (prefill piggybacked on
+                # the decode batch) and the slot decodes from here on
+                req.state = RequestState.DECODE
+            self._tokens[w.slot] = tok
+            self._emit(req, tok)
+            if self._is_finished(req, tok):
+                self._finish(w.slot)
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> dict:
         """Step until queue and slots drain; returns cumulative metrics."""
@@ -868,6 +1039,12 @@ class ServingEngine:
 
     # -- admission ---------------------------------------------------------
     def _admit(self, now: float):
+        """Seat queued requests while slots AND pages allow.  Admission is
+        pure host bookkeeping now — pages reserved all-or-nothing, the
+        prompt parked on ``Slot.pending`` — and the very same tick's fused
+        step starts consuming the prompt under the token budget (no
+        per-request prefill dispatch: the PR-5 ``[1, chunk]`` program is
+        retired)."""
         if now < self._admit_after:
             return                        # re-admission backoff after recovery
         sched = self.scheduler
@@ -888,102 +1065,9 @@ class ServingEngine:
             self._top_p[idx] = np.float32(sp.top_p)
             self._top_k[idx] = np.int32(sp.top_k)
             self._do_sample[idx] = bool(sp.do_sample)
-            try:
-                tok0, fin0 = self._run_prefill(idx, req)
-            except StepStalledError as e:
-                # the prefill worker is wedged: every seated request is
-                # implicated by the shared (possibly half-written) pool
-                self._recover(e, rebuild=True, stalled=True)
-                return
-            except Exception as e:  # noqa: BLE001 — containment boundary
-                if _state_intact(e):
-                    # the fault provably fired before any device work:
-                    # only THIS request is implicated
-                    self._fail_slot(idx, e)
-                    continue
-                self._recover(e, rebuild=True)
-                return
-            if not fin0:
-                self._totals["quarantined"] += 1
-                self._fail_slot(idx, NaNLogitsError(
-                    f"request {req.id}: non-finite prefill logits "
-                    "(request quarantined at admission)"))
-                continue
-            sched.slots[idx].pos = req.prompt.size
-            sched.positions[idx] = req.prompt.size
-            self._tokens[idx] = tok0
-            req.state = RequestState.DECODE
-            self._emit(req, tok0)
-            if self._is_finished(req, tok0):
-                self._finish(idx)
-
-    def _run_prefill(self, idx: int, req: Request) -> Tuple[int, bool]:
-        """Supervised chunked prefill with one retry (same transient-error
-        policy as decode).  Chunk writes are idempotent — a retry rewrites
-        the same K/V into the same reserved pages — so retrying the whole
-        prompt is safe.  The stall budget scales with the chunk count
-        (one budget per dispatched program)."""
-        n_chunks = -(-req.prompt.size // self.prefill_chunk)
-        # non-final chunks always run the greedy program (see
-        # _prefill_attempt), so the budget must consider BOTH variants a
-        # multi-chunk sampling prompt dispatches
-        variants = [self._prefill_sample if req.sampling.do_sample
-                    else self._prefill_greedy]
-        if n_chunks > 1:
-            variants.append(self._prefill_greedy)
-        budget = self._budget_for(variants, chunks=n_chunks)
-        thunk = lambda cancelled: self._prefill_attempt(idx, req, cancelled)  # noqa: E731,E501
-        try:
-            return self._supervised(thunk, budget)
-        except StepStalledError:
-            raise
-        except Exception:  # noqa: BLE001 — transient device errors retry once
-            self._totals["step_retries"] += 1
-            return self._supervised(thunk, budget)
-
-    def _prefill_attempt(self, idx: int, req: Request,
-                         cancelled) -> Tuple[int, bool]:
-        """Chunked prefill of one admitted request: every chunk is the
-        same [1, prefill_chunk] program (prompts pad the final chunk; pad
-        writes sink into reserved-but-unread positions or the null page).
-        Returns (first generated token, finiteness of the final chunk's
-        logits)."""
-        req.state = RequestState.PREFILL
-        c = self.prefill_chunk
-        s0 = req.prompt.size
-        n_chunks = -(-s0 // c)
-        padded = np.zeros((n_chunks * c,), np.int64)
-        padded[:s0] = req.prompt
-        row = np.ascontiguousarray(self.scheduler.tables[idx:idx + 1])
-        tok, fin = 0, True
-        sl = slice(idx, idx + 1)
-        final_prefill = (self._prefill_sample if req.sampling.do_sample
-                         else self._prefill_greedy)
-        for ci in range(n_chunks):
-            self._hook("before_prefill")
-            if cancelled():
-                return 0, True           # abandoned: result discarded
-            ids = padded[ci * c:(ci + 1) * c][None, :]
-            pos = np.array([ci * c], np.int32)
-            last_idx = np.int32(np.clip(s0 - 1 - ci * c, 0, c - 1))
-            # only the FINAL chunk's token survives: earlier chunks run
-            # the greedy program (their argmax is discarded), so a
-            # sampling request pays the sampling machinery — and advances
-            # the global RNG — exactly once per admission, independent of
-            # prefill_chunk sizing
-            prefill = (final_prefill if ci == n_chunks - 1
-                       else self._prefill_greedy)
-            out, f = prefill(
-                to_tensor(ids), to_tensor(row), to_tensor(pos),
-                to_tensor(last_idx),
-                to_tensor(self._temp[sl]), to_tensor(self._top_p[sl]),
-                to_tensor(self._top_k[sl]), to_tensor(self._do_sample[sl]))
-            self._totals["prefill_chunks"] += 1
-            tok = int(np.asarray(out.numpy())[0])
-            fin = bool(np.asarray(f.numpy())[0])
-        ctx = {"token": tok, "finite": np.array([fin])}
-        self._hook("after_prefill", ctx)
-        return int(ctx["token"]), bool(ctx["finite"][0])
+            self._sampling_cache = None
+            sched.slots[idx].pending = np.asarray(req.prompt, np.int64)
+            req.state = RequestState.PREFILL
 
     # -- recovery ----------------------------------------------------------
     def _recover(self, error: BaseException, *, rebuild: bool,
@@ -1031,6 +1115,7 @@ class ServingEngine:
         self._top_p[idx] = 1.0
         self._top_k[idx] = 0
         self._do_sample[idx] = False
+        self._sampling_cache = None
 
     def _terminalize(self, req: Request, state: str,
                      error: Optional[BaseException]):
@@ -1100,7 +1185,11 @@ class ServingEngine:
 
     # -- observability -----------------------------------------------------
     def metrics(self) -> dict:
-        """Cumulative totals + the last step's gauges."""
+        """Cumulative totals + the last step's gauges.  The ragged-launch
+        occupancy means make the fused step's win measurable: how full the
+        fixed work-list grid ran (``mean_grid_occupancy``) and how many of
+        the packed query-block rows carried real tokens
+        (``mean_q_row_occupancy``) across every dispatched step."""
         out = dict(self._totals)
         out.update(self._last_metrics)
         out["queue_depth"] = self.queue.depth
@@ -1109,19 +1198,24 @@ class ServingEngine:
         out["pages_capacity"] = self.allocator.capacity
         out["occupancy"] = self.scheduler.occupancy
         out["cache_bytes"] = self.cache.nbytes if not self._closed else 0
+        wc = self._totals["work_capacity"]
+        rc = self._totals["block_row_capacity"]
+        out["mean_grid_occupancy"] = (self._totals["work_items"] / wc
+                                      if wc else 0.0)
+        out["mean_q_row_occupancy"] = (self._totals["block_rows"] / rc
+                                       if rc else 0.0)
         return out
 
     @property
     def _static_fns(self):
-        return (self._prefill_greedy, self._prefill_sample,
-                self._decode_greedy, self._decode_sample)
+        return (self._fused_greedy, self._fused_sample)
 
     @property
     def compiled_programs(self) -> int:
         return sum(len(f.code_cache) for f in self._static_fns)
 
     def lint_reports(self):
-        """Graph-lint reports of the compiled prefill/decode programs
+        """Graph-lint reports of the compiled fused-step programs
         (populated when FLAGS_graph_lint / PADDLE_TPU_GRAPH_LINT=1 was on
         at compile time; see docs/graph_lint.md)."""
         return [r for f in self._static_fns for r in f.lint_reports()]

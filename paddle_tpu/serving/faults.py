@@ -10,20 +10,26 @@ stochastically:
 ======================  =====================  ==============================
 kind                    hook point             effect
 ======================  =====================  ==============================
-``step_exception``      before_decode /        raise :class:`InjectedFault`
-                        before_prefill         (``state_intact=True`` — the
+``step_exception``      before_decode          raise :class:`InjectedFault`
+                                               (``state_intact=True`` — the
                                                fault fires before dispatch)
-``step_stall``          before_decode /        ``time.sleep(duration)`` so
-                        before_prefill         the watchdog trips; the thunk
+``step_stall``          before_decode          ``time.sleep(duration)`` so
+                                               the watchdog trips; the thunk
                                                then honors ``cancelled()``
-``nan_logits``          after_decode /         flip ``ctx["finite"]`` for
-                        after_prefill          the chosen slots (simulating
+``nan_logits``          after_decode           flip ``ctx["finite"]`` for
+                                               the chosen slots (simulating
                                                NaN-poisoned logits)
 ``alloc_exhausted``     alloc                  ``ctx["force_none"] = True``
                                                (pool reports no free pages)
 ``callback_error``      callback               raise inside the engine's
                                                ``on_token`` invocation
 ======================  =====================  ==============================
+
+(The PR-5 two-phase engine also exposed ``before_prefill``/
+``after_prefill``; the fused mixed step retired the separate prefill
+dispatch, so prefill work now crosses the SAME ``before_decode``/
+``after_decode`` points — plans targeting the old prefill points would
+be dead and are rejected at validation.)
 
 Injection points are keyed on the Nth OCCURRENCE of the point (per-point
 call counters), so a schedule is reproducible independent of wall clock.
@@ -52,9 +58,9 @@ KINDS = ("step_exception", "step_stall", "nan_logits", "alloc_exhausted",
          "callback_error")
 
 _KIND_POINTS = {
-    "step_exception": ("before_decode", "before_prefill"),
-    "step_stall": ("before_decode", "before_prefill"),
-    "nan_logits": ("after_decode", "after_prefill"),
+    "step_exception": ("before_decode",),
+    "step_stall": ("before_decode",),
+    "nan_logits": ("after_decode",),
     "alloc_exhausted": ("alloc",),
     "callback_error": ("callback",),
 }

@@ -73,6 +73,23 @@ def test_dot_general_padding_waste_golden():
     assert cm.dot_flops(eqn, padded=True) == 2 * 512 * 1024 * 256
 
 
+def test_ragged_padding_waste_golden():
+    # one full prefill block (8 real rows) + one decode token alone in its
+    # block: 7 padded rows out of 16, uniformly spread over 3 work items
+    w = cm.ragged_padding_waste(n_tokens=9, n_blocks=2, n_items=3,
+                                token_block=8, page_size=128, head_dim=64,
+                                dtype="bfloat16")
+    assert w["padded_rows"] == 7
+    # per item: 4*D*page_size*QB flops, rows_frac = 7/16
+    assert w["wasted_flops"] == round(3 * 4 * 64 * 128 * 8 * 7 / 16)
+    assert w["wasted_q_bytes"] == 7 * 64 * 2
+    # a fully-packed plan wastes nothing
+    full = cm.ragged_padding_waste(16, 2, 3, 8, 128, 64)
+    assert full["padded_rows"] == 0 and full["wasted_flops"] == 0
+    with pytest.raises(ValueError):
+        cm.ragged_padding_waste(17, 2, 3, 8, 128, 64)
+
+
 def test_scan_of_dots_golden():
     L, M = 5, 256
 

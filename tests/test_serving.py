@@ -1,18 +1,23 @@
-"""Continuous-batching serving engine: paged KV cache + Pallas paged
-decode-attention + scheduler/engine (docs/serving.md).
+"""Continuous-batching serving engine: paged KV cache + ragged paged
+attention + ONE fused mixed prefill/decode step (docs/serving.md).
 
-Covers the PR's acceptance criteria:
+Covers the acceptance criteria:
 - retrace-freedom under churn (>= 20 varying-length requests through a
-  4-slot engine, decode compiles <= 2, outputs token-for-token equal to
-  single-shot greedy generate());
-- paged-kernel parity vs the XLA gather reference (interpret= on CPU),
-  incl. length-0 slots and boundary pages, and vs decode_attention on
-  single-page layouts;
+  4-slot engine, the fused step compiles <= 1 program, outputs
+  token-for-token equal to single-shot greedy generate());
+- fused mixed-step parity across interleaved arrivals for fp32+bf16 and
+  layered+stacked layouts;
+- ragged-kernel parity vs the per-token XLA gather oracle (interpret= on
+  CPU), incl. page-straddling token blocks, shuffled work lists, zero
+  lengths, and the plan builder's overflow guards;
+- paged-kernel parity vs the XLA gather reference (the q-len-1 kernel
+  stays the generate()/decode-engine path), incl. length-0 slots;
 - block accounting soundness (reuse after free, occupancy never exceeds
   capacity, out-of-pages admission backpressures);
-plus the satellites: chunked prefill into non-contiguous pages (fp32/bf16,
-layered/stacked), LRU eviction releasing KV-cache buffers, PredictorPool
-concurrency, and the GL001/GL004-clean serving step."""
+plus the satellites: chunked prefill into non-contiguous pages (the
+direct ``_paged_lm_logits`` path), LRU eviction releasing KV-cache
+buffers, PredictorPool concurrency, and the GL001/GL004-clean fused
+step."""
 import threading
 
 import numpy as np
@@ -141,6 +146,136 @@ def test_paged_attention_kernel_parity_tpu():
 
 
 # ---------------------------------------------------------------------------
+# ragged paged attention: the fused mixed prefill/decode kernel
+# ---------------------------------------------------------------------------
+
+def _mk_ragged_case(runs, T_MAX, NB_MAX, WL_MAX, MP, token_block=8,
+                    page_size=128):
+    """Plan + per-token tables/lengths for a synthetic run mix."""
+    from paddle_tpu.ops.pallas_kernels import ragged_paged_attention as ra
+
+    plan_np, stats = ra.build_ragged_plan(
+        runs, token_block=token_block, page_size=page_size,
+        t_max=T_MAX, nb_max=NB_MAX, wl_max=WL_MAX)
+    tables = np.zeros((T_MAX, MP), np.int32)
+    lengths = np.zeros((T_MAX,), np.int32)
+    for (base, count, tbl), start in zip(runs, stats["run_starts"]):
+        for i in range(count):
+            tables[start + i] = tbl
+            lengths[start + i] = base + i + 1
+    return plan_np, stats, tables, lengths
+
+
+def test_ragged_kernel_parity_interpret():
+    """Mixed decode + prefill runs through the work-list kernel
+    (interpreter) vs the per-token gather oracle: page-straddling token
+    blocks, shuffled pool pages, boundary positions, fp32 + bf16."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas_kernels import ragged_paged_attention as ra
+
+    rng = np.random.RandomState(0)
+    P, H, PS, D = 11, 2, 128, 64
+    MP = 4
+    runs = [
+        (200, 1, np.array([4, 2, 9, 1], np.int32)),    # decode, 2 pages
+        (0, 1, np.array([3, 0, 0, 0], np.int32)),      # decode at pos 0
+        (120, 16, np.array([7, 5, 8, 6], np.int32)),   # prefill straddling
+        (17, 5, np.array([10, 0, 0, 0], np.int32)),    # short prefill tail
+    ]
+    T_MAX, NB_MAX, WL_MAX = 32, 8, 32
+    plan_np, stats, tables, lengths = _mk_ragged_case(runs, T_MAX, NB_MAX,
+                                                      WL_MAX, MP)
+    real = stats["n_tokens"]
+    for dt, tol in ((jnp.float32, 5e-6), (jnp.bfloat16, 2e-2)):
+        q = jnp.array(rng.randn(T_MAX, H, D), dt)
+        kp = jnp.array(rng.randn(P, H, PS, D), dt)
+        vp = jnp.array(rng.randn(P, H, PS, D), dt)
+        plan = tuple(jnp.array(plan_np[k]) for k in ra.RAGGED_PLAN_FIELDS)
+        ref = np.asarray(ra._xla_ragged_reference(
+            q, kp, vp, jnp.array(tables), jnp.array(lengths), 0.125),
+            np.float32)
+        got = np.asarray(ra.ragged_paged_attention(
+            q, kp, vp, jnp.array(tables), jnp.array(lengths), plan,
+            sm_scale=0.125, interpret=True), np.float32)
+        np.testing.assert_allclose(got[:real], ref[:real], rtol=tol,
+                                   atol=tol)
+
+
+def test_ragged_reference_zero_length_and_decode_equivalence():
+    """The oracle's semantics: a zero-length token emits zeros, and a
+    one-token-per-slot plan is bitwise the paged decode reference (the
+    old per-slot decode step is a strict special case)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas_kernels import paged_attention as pa
+    from paddle_tpu.ops.pallas_kernels import ragged_paged_attention as ra
+
+    rng = np.random.RandomState(1)
+    P, H, PS, D = 7, 2, 128, 64
+    q = jnp.array(rng.randn(3, H, D), jnp.float32)
+    kp = jnp.array(rng.randn(P, H, PS, D), jnp.float32)
+    vp = jnp.array(rng.randn(P, H, PS, D), jnp.float32)
+    tbl = jnp.array([[1, 2], [3, 4], [5, 6]], jnp.int32)
+    lens = jnp.array([0, 130, 256], jnp.int32)
+    got = np.asarray(ra._xla_ragged_reference(q, kp, vp, tbl, lens, 0.125))
+    want = np.asarray(pa._xla_paged_reference(q, kp, vp, tbl, lens, 0.125))
+    np.testing.assert_array_equal(got, want)
+    assert not got[0].any(), "length-0 token must emit zeros"
+
+
+def test_ragged_plan_builder_shapes_and_guards():
+    from paddle_tpu.ops.pallas_kernels import ragged_paged_attention as ra
+
+    tbl = np.array([2, 3], np.int32)
+    plan, stats = ra.build_ragged_plan(
+        [(0, 10, tbl), (130, 1, tbl)], token_block=8, page_size=128,
+        t_max=16, nb_max=4, wl_max=8)
+    # 10 prefill tokens -> blocks of 8+2; decode at 130 -> pages 0..1
+    assert stats["n_tokens"] == 11 and stats["n_blocks"] == 3
+    # items: block0 (rows 0-7, 1 page) + block1 (rows 8-9, 1 page)
+    #        + block2 (decode pos 130 -> 2 pages)
+    assert stats["n_items"] == 4
+    assert stats["run_starts"] == [0, 10]
+    assert plan["blk_rows"].tolist()[:3] == [8, 2, 1]
+    assert plan["blk_base"].tolist()[:3] == [0, 8, 130]
+    # work-list tail repeats the last real entry (clamped -> elided)
+    assert plan["wl_blk"][stats["n_items"]:].tolist() == [2] * 4
+    assert plan["wl_page"][3] == 3        # decode's second page-slot
+    # overflow guards: the engine sizes the maxima so these never fire
+    with pytest.raises(ValueError, match="overflow"):
+        ra.build_ragged_plan([(0, 20, tbl)], token_block=8, page_size=128,
+                             t_max=16, nb_max=4, wl_max=8)
+    with pytest.raises(ValueError, match="overflow"):
+        ra.build_ragged_plan([(0, 10, tbl)], token_block=8, page_size=128,
+                             t_max=16, nb_max=1, wl_max=8)
+    with pytest.raises(ValueError, match="at least one token"):
+        ra.build_ragged_plan([(0, 0, tbl)], token_block=8, page_size=128,
+                             t_max=16, nb_max=4, wl_max=8)
+    with pytest.raises(ValueError, match="empty plan"):
+        ra.build_ragged_plan([], token_block=8, page_size=128,
+                             t_max=16, nb_max=4, wl_max=8)
+
+
+def test_ragged_shape_eligibility_gate():
+    from paddle_tpu.ops.pallas_kernels.ragged_paged_attention import (
+        ragged_shape_supported,
+        ragged_shape_unsupported_reason,
+    )
+
+    assert ragged_shape_supported(128, 64)
+    assert ragged_shape_supported(256, 128, token_block=16)
+    assert not ragged_shape_supported(64, 64)     # page under one KV block
+    assert not ragged_shape_supported(128, 80)    # head dim not 64-multiple
+    assert not ragged_shape_supported(128, 64, token_block=12)  # sublane
+    r = ragged_shape_unsupported_reason(16, 48, token_block=4)
+    assert r is not None and r.code == "GL002"
+    assert "ragged_paged_attention" in str(r)
+    assert "token_block" in str(r)
+    assert ragged_shape_unsupported_reason(128, 64) is None
+
+
+# ---------------------------------------------------------------------------
 # block-pool accounting (property-style)
 # ---------------------------------------------------------------------------
 
@@ -185,6 +320,34 @@ def test_block_accounting_random_churn():
     for pages in live:
         a.free(pages)
     assert a.free_pages == a.capacity
+
+
+def test_plan_step_budget_oldest_admission_first():
+    """The prefill budget drains by ADMISSION order, not slot index:
+    admission reuses a freed low index immediately, so index order would
+    let a slot that churns through budget-sized prompts starve an older
+    mid-prefill slot forever (its request would never see a token of
+    budget while holding its reserved pages)."""
+    from paddle_tpu.serving.scheduler import Scheduler
+
+    a = BlockAllocator(17)
+    sched = Scheduler(num_slots=2, max_pages_per_slot=4, page_size=16,
+                      allocator=a)
+    assert sched.try_admit(object(), 32) == 0       # seq 0 -> slot 0
+    assert sched.try_admit(object(), 32) == 1       # seq 1 -> slot 1
+    sched.slots[1].pending = np.arange(8, dtype=np.int64)
+    sched.retire(0)
+    assert sched.try_admit(object(), 32) == 0       # seq 2 reuses slot 0
+    sched.slots[0].pending = np.arange(8, dtype=np.int64)
+    # budget covers ONE run: the older admission (slot 1) must get it
+    work = sched.plan_step(8)
+    assert [w.slot for w in work] == [1]
+    assert work[0].kind == "prefill" and work[0].count == 8
+    # with budget for both, the older admission still plans first
+    sched.slots[1].pending = np.arange(8, dtype=np.int64)
+    work = sched.plan_step(16)
+    assert [w.slot for w in work] == [1, 0]
+    assert all(w.kind == "prefill" and w.count == 8 for w in work)
 
 
 # ---------------------------------------------------------------------------
@@ -262,7 +425,7 @@ def test_continuous_batching_churn_matches_generate():
 
     serving.reset_serve_trace_counts()
     eng = ServingEngine(m, num_slots=4, page_size=16, max_context=64,
-                        cache_dtype="float32", prefill_chunk=8)
+                        cache_dtype="float32", prefill_token_budget=8)
     reqs, it, submitted = [], iter(zip(prompts, new_toks)), 0
     while submitted < len(prompts) or eng.queue.depth \
             or eng.scheduler.active_slots:
@@ -278,10 +441,10 @@ def test_continuous_batching_churn_matches_generate():
 
     tc = serving.serve_trace_counts()
     # step bodies run ONLY while tracing (scout + jit trace = 2 per
-    # compiled program): <= 2 means the decode step compiled at most once
-    assert tc["decode"] <= 2, tc
-    assert tc["prefill"] <= 2, tc
-    assert eng.compiled_programs == 2
+    # compiled program): <= 2 means the fused step compiled at most once —
+    # mixed prefill/decode traffic shares ONE program for the whole run
+    assert tc["fused"] <= 2, tc
+    assert eng.compiled_programs == 1
 
     for r, ref in zip(reqs, refs):
         assert r.finished
@@ -297,23 +460,44 @@ def test_continuous_batching_churn_matches_generate():
     assert mets["tokens"] == sum(new_toks)
 
 
-def test_continuous_batching_stacked_decoder():
-    """The stacked [L, P, H, ps, D] pool path: same greedy parity."""
+@pytest.mark.parametrize("model_cls", [GPTForPretraining,
+                                       GPTStackedForPretraining])
+@pytest.mark.parametrize("cache_dtype", ["float32", "bfloat16"])
+def test_fused_mixed_step_parity(model_cls, cache_dtype):
+    """The fused mixed prefill/decode step across interleaved arrivals:
+    greedy output token-for-token equal to single-shot generate() on
+    fp32 AND bf16 pools, layered AND stacked layouts.  The tiny budget
+    forces multi-step prefills to overlap other slots' decode — every
+    step really mixes phases."""
     pt.seed(3)
     cfg = _tiny_cfg()
-    m = GPTStackedForPretraining(cfg)
+    m = model_cls(cfg)
     m.eval()
     rng = np.random.RandomState(2)
-    prompts = [rng.randint(0, cfg.vocab_size, (s,)) for s in (4, 11, 7, 16)]
+    prompts = [rng.randint(0, cfg.vocab_size, (s,))
+               for s in (4, 17, 7, 21, 11, 5)]
     refs = [np.asarray(m.generate(pt.to_tensor(p[None, :], dtype="int64"),
                                   max_new_tokens=4, max_seq_len=64,
-                                  cache_dtype="float32").numpy())[0]
+                                  cache_dtype=cache_dtype).numpy())[0]
             for p in prompts]
     eng = ServingEngine(m, num_slots=2, page_size=16, max_context=64,
-                        cache_dtype="float32")
-    outs = eng.generate_batch(prompts, max_new_tokens=4)
-    for got, ref in zip(outs, refs):
-        assert np.array_equal(got, ref)
+                        cache_dtype=cache_dtype, prefill_token_budget=6)
+    reqs, it = [], iter(prompts)
+    while len(reqs) < len(prompts) or eng.queue.depth \
+            or eng.scheduler.active_slots:
+        try:
+            reqs.append(eng.submit(next(it), 4))
+        except StopIteration:
+            pass
+        met = eng.step()
+        assert met["pages_used"] <= eng.allocator.capacity
+    for r, ref in zip(reqs, refs):
+        assert r.finished
+        assert np.array_equal(r.output_ids(), ref), (
+            model_cls.__name__, cache_dtype, r.id)
+    assert eng.compiled_programs == 1
+    assert eng.allocator.used_pages == 0
+    eng.close()
 
 
 def test_out_of_pages_admission_backpressures():
@@ -356,10 +540,10 @@ def test_out_of_pages_admission_backpressures():
 
 
 def test_invocation_counters_exact():
-    """``prefill_chunks`` counts prefill_step executions (a multi-chunk
-    prompt counts each chunk) and ``decode_steps`` counts only ticks that
-    actually dispatched the decode program — bench.py's serving roofline
-    denominators."""
+    """``fused_steps`` counts only ticks that actually dispatched the
+    fused program (bench.py's serving roofline denominator),
+    ``prefill_tokens`` counts the prompt tokens that piggybacked on those
+    steps, and the ragged grid-occupancy means are populated."""
     pt.seed(0)
     cfg = _tiny_cfg()
     m = GPTForPretraining(cfg)
@@ -369,21 +553,27 @@ def test_invocation_counters_exact():
                         cache_dtype="float32")
     try:
         m0 = eng.metrics()
-        assert m0["prefill_chunks"] == 0 and m0["decode_steps"] == 0
-        eng.step()  # idle tick: no active slots, no decode program ran
-        assert eng.metrics()["decode_steps"] == 0
+        assert m0["fused_steps"] == 0 and m0["prefill_tokens"] == 0
+        eng.step()  # idle tick: no seated work, no program ran
+        assert eng.metrics()["fused_steps"] == 0
         assert eng.metrics()["steps"] == 1
-        # chunk = min(page_size, max_context) = 16: 20 tokens -> 2 chunks,
-        # 8 tokens -> 1 chunk
         reqs = [eng.submit(rng.randint(0, cfg.vocab_size, (plen,)), 3)
                 for plen in (20, 8)]
         eng.run_until_idle()
         mets = eng.metrics()
         assert all(len(r.tokens) == 3 for r in reqs)
-        assert mets["prefill_chunks"] == 3
-        # every decode dispatch is a tick, but not every tick dispatched
+        # every prompt token rode a fused step exactly once
+        assert mets["prefill_tokens"] == 28
+        # every fused dispatch is a tick, but not every tick dispatched
         # (the idle tick above never ran the program)
-        assert 0 < mets["decode_steps"] < mets["steps"]
+        assert 0 < mets["fused_steps"] < mets["steps"]
+        assert 0.0 < mets["mean_grid_occupancy"] <= 1.0
+        assert 0.0 < mets["mean_q_row_occupancy"] <= 1.0
+        # host-packing padding cost (cost_model.ragged_padding_waste):
+        # a decode token fills 1 of token_block rows, so a decode-heavy
+        # run must report padded rows and the matching padded-away flops
+        assert mets["padded_rows"] > 0
+        assert mets["padded_flops"] > 0
     finally:
         eng.close()
 

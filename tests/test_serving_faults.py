@@ -206,7 +206,7 @@ def test_transient_step_crash_retries_and_nothing_fails(served):
         assert np.array_equal(r.output_ids(), ref)
     assert eng.allocator.used_pages == 0
     tc = serving.serve_trace_counts()
-    assert tc["decode"] <= 2, f"transient retry must not retrace: {tc}"
+    assert tc["fused"] <= 2, f"transient retry must not retrace: {tc}"
 
 
 def test_persistent_step_crash_fails_only_seated_requests(served):

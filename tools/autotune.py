@@ -53,6 +53,11 @@ BENCH_KEYS = [
     # paged serving: page_size 128 pools
     ("paged_attention", {"page_size": 128, "head_dim": 128}, "bfloat16"),
     ("paged_attention", {"page_size": 128, "head_dim": 64}, "bfloat16"),
+    # ragged fused mixed prefill/decode step: same pool specializations
+    ("ragged_paged_attention", {"page_size": 128, "head_dim": 128},
+     "bfloat16"),
+    ("ragged_paged_attention", {"page_size": 128, "head_dim": 64},
+     "bfloat16"),
 ]
 
 
@@ -135,6 +140,47 @@ def _timing_fn(kernel, shape, dtype_name):
                 return _time_once(  # fresh jit per candidate (see above)
                     jax.jit(lambda *xs: pa.paged_attention(*xs)),
                     q, kp, vp, tbl, lens)
+
+        return run
+    if kernel == "ragged_paged_attention":
+        from paddle_tpu.ops.pallas_kernels import (
+            ragged_paged_attention as ra,
+        )
+
+        ps = shape["page_size"]
+        pages, mp, h = 33, 4, 8
+        kp = jnp.array(rng.randn(pages, h, ps, d), dt)
+        vp = jnp.array(rng.randn(pages, h, ps, d), dt)
+        # a representative fused mixed step: 4 decode slots deep into
+        # their context + one 64-token prefill run (skewed lengths)
+        tbls = [np.sort(rng.permutation(pages - 1)[:mp] + 1).astype(np.int32)
+                for _ in range(5)]
+        runs = [(ps * mp - 1, 1, tbls[0]), (ps - 1, 1, tbls[1]),
+                (2 * ps, 1, tbls[2]), (7, 1, tbls[3]), (ps // 2, 64, tbls[4])]
+        t_max = 80
+
+        def run(params):
+            with autotune.force(kernel, params):
+                # plan geometry depends on the candidate's token_block —
+                # rebuild it per candidate exactly like the engine would
+                tb = ra.ragged_token_block(ps, d, dt)
+                plan_np, stats = ra.build_ragged_plan(
+                    runs, token_block=tb, page_size=ps, t_max=t_max,
+                    nb_max=16, wl_max=16 * mp)
+                q = jnp.array(rng.randn(t_max, h, d), dt)
+                tables = np.zeros((t_max, mp), np.int32)
+                lens = np.zeros((t_max,), np.int32)
+                for (base, count, tr), start in zip(runs,
+                                                    stats["run_starts"]):
+                    tables[start:start + count] = tr
+                    lens[start:start + count] = base + np.arange(count) + 1
+                plan = tuple(jnp.array(plan_np[k])
+                             for k in ra.RAGGED_PLAN_FIELDS)
+                return _time_once(  # fresh jit per candidate (see above)
+                    jax.jit(lambda qq, kk, vv, tt, ll:
+                            ra.ragged_paged_attention(qq, kk, vv, tt, ll,
+                                                      plan)),
+                    q, kp, vp, jnp.array(tables), jnp.array(lens))
 
         return run
     raise ValueError(kernel)

@@ -6,19 +6,26 @@ Sweep mode (default): drives the ServingEngine at increasing offered load
 
   {"metric": "serving_sweep", "offered_load": ..., "tokens_per_sec": ...,
    "mean_occupancy": ..., "mean_queue_depth": ..., "completed": ...,
-   "steps": ...}
+   "grid_occupancy": ..., "q_row_occupancy": ..., "steps": ...}
 
 tokens/sec should rise with load until the slots saturate, then flatten
 while queue depth grows — the continuous-batching signature.  Runs on the
 TPU ladder model when a TPU is present, and on a CPU-sized gpt_tiny
 otherwise (the numbers are then about the SCHEDULER, not the chip).
 
+``--lengths zipf`` draws prompt lengths from a bounded Zipf long-tail
+instead of the fixed cycle — the skewed regime production traffic shows
+and exactly where the ragged fused step beats the retired two-phase
+design; ``grid_occupancy`` / ``q_row_occupancy`` (work items per fixed
+launch, real query rows per packed block row) make that win measurable
+rather than anecdotal.
+
 Gate mode (--gate, wired into run_tests.sh; PADDLE_TPU_SKIP_SERVING_GATE=1
 skips): a fast correctness gate in the crash/lint-gate mold —
 
   - >= 12 varying-length greedy requests through a 3-slot engine with an
     undersized page pool must match single-shot generate() token-for-token;
-  - the decode step must compile at most once (trace counters <= 2);
+  - the fused step must compile at most once (trace counter <= 2);
   - block accounting must close: peak pages <= capacity, 0 in use at the
     end, backpressure observed (the pool is sized to force it).
 
@@ -77,7 +84,24 @@ def _build(on_tpu: bool):
     return model, cfg, serving_kw, prompt_lens, max_new
 
 
-def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24) -> int:
+def _prompt_lengths(dist: str, n: int, fixed_cycle, max_prompt: int,
+                    rng) -> list:
+    """Per-request prompt lengths: the historical fixed cycle, or a
+    bounded Zipf long-tail (``--lengths zipf``) — many short prompts, a
+    few near-max ones, the skewed regime the ragged step targets."""
+    if dist == "fixed":
+        return [int(fixed_cycle[i % len(fixed_cycle)]) for i in range(n)]
+    if dist == "zipf":
+        raw = rng.zipf(1.6, size=n).astype(np.float64)
+        # map the unbounded Zipf tail onto [1, max_prompt] keeping rank
+        # order: heavy mass at short lengths, a thin tail near the cap
+        scaled = np.minimum(raw, 64.0) / 64.0
+        return [max(1, int(round(s * max_prompt))) for s in scaled]
+    raise ValueError(f"unknown --lengths {dist!r} (fixed|zipf)")
+
+
+def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24,
+          lengths: str = "fixed") -> int:
     import jax
 
     from paddle_tpu.serving import ServingEngine
@@ -85,14 +109,17 @@ def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24) -> int:
     on_tpu = jax.devices()[0].platform != "cpu"
     model, cfg, kw, prompt_lens, max_new = _build(on_tpu)
     rng = np.random.RandomState(0)
-    prompts = [rng.randint(0, cfg.vocab_size,
-                           (prompt_lens[i % len(prompt_lens)],))
+    max_prompt = kw["max_context"] - max_new
+    plens = _prompt_lengths(lengths, n_requests, prompt_lens, max_prompt,
+                            rng)
+    prompts = [rng.randint(0, cfg.vocab_size, (plens[i],))
                for i in range(n_requests)]
     for load in loads:
         eng = ServingEngine(model, **kw)
-        # warmup: compile prefill + decode outside the timed region
+        # warmup: compile the fused step outside the timed region
         eng.submit(prompts[0], 2)
         eng.run_until_idle()
+        base = eng.metrics()
         occ, qd, steps, injected = [], [], 0, 0.0
         t0 = time.perf_counter()
         reqs = []
@@ -111,12 +138,22 @@ def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24) -> int:
                 break
         dt = time.perf_counter() - t0
         done_tokens = sum(len(r.tokens) for r in reqs)
+        mets = eng.metrics()
+        # ragged-launch occupancy over the measured window only (the
+        # totals are cumulative; subtract the warmup's contribution)
+        d_items = mets["work_items"] - base["work_items"]
+        d_wcap = mets["work_capacity"] - base["work_capacity"]
+        d_rows = mets["block_rows"] - base["block_rows"]
+        d_rcap = mets["block_row_capacity"] - base["block_row_capacity"]
         print(json.dumps({
             "metric": "serving_sweep",
             "offered_load": load,
+            "lengths": lengths,
             "tokens_per_sec": round(done_tokens / dt, 1),
             "mean_occupancy": round(float(np.mean(occ)), 4),
             "mean_queue_depth": round(float(np.mean(qd)), 2),
+            "grid_occupancy": round(d_items / d_wcap, 4) if d_wcap else 0.0,
+            "q_row_occupancy": round(d_rows / d_rcap, 4) if d_rcap else 0.0,
             "completed": sum(r.finished for r in reqs),
             "steps": steps,
             "platform": "tpu" if on_tpu else "cpu",
@@ -180,7 +217,7 @@ def gate() -> int:
             return 1
 
     tc = serving.serve_trace_counts()
-    if tc["decode"] > 2 or tc["prefill"] > 2:
+    if tc["fused"] > 2:
         print(f"serving_gate: FAIL retraced under churn: {tc}")
         return 1
     bad = 0
@@ -316,6 +353,10 @@ def main() -> int:
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--loads", type=str, default="0.5,1,2,4",
                     help="comma-separated offered loads (requests/step)")
+    ap.add_argument("--lengths", choices=("fixed", "zipf"), default="fixed",
+                    help="prompt-length distribution: the historical fixed "
+                         "cycle, or a bounded Zipf long-tail (the skewed "
+                         "regime the ragged fused step targets)")
     args = ap.parse_args()
     if args.gate:
         return gate()
@@ -323,7 +364,7 @@ def main() -> int:
         return chaos(max(args.requests, 36) if args.requests != 24
                      else 36)
     return sweep(tuple(float(x) for x in args.loads.split(",")),
-                 args.requests)
+                 args.requests, lengths=args.lengths)
 
 
 if __name__ == "__main__":

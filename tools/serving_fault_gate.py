@@ -134,7 +134,7 @@ def gate() -> int:
             and all(r.state == RequestState.DONE for r in reqs)
             and all(np.array_equal(r.output_ids(), ref)
                     for r, ref in zip(reqs, refs))
-            and tc["decode"] <= 2):
+            and tc["fused"] <= 2):
         print(f"serving_fault_gate: FAIL [transient] {mt} traces={tc} "
               f"states={[r.state for r in reqs]}")
         ok = False

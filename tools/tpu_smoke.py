@@ -112,6 +112,51 @@ def main() -> int:
         r = pa.paged_shape_unsupported_reason(100, 48)
         assert r is not None and r.code == "GL002"
 
+    # -- ragged paged attention (fused mixed prefill/decode step) vs the
+    # per-token gather oracle: mixed decode + page-straddling prefill
+    # runs, shuffled out-of-order pool pages, boundary positions incl.
+    # position 0 and an exact page edge ----------------------------------
+    def ragged_attention():
+        from paddle_tpu.ops.pallas_kernels import ragged_paged_attention as ra
+        P, H, PS, D = 11, 4, 128, 64
+        MP = 4
+        assert ra.ragged_shape_supported(PS, D)
+        runs = [
+            (200, 1, np.array([4, 2, 9, 1], np.int32)),   # decode, 2 pages
+            (0, 1, np.array([3, 0, 0, 0], np.int32)),     # decode at pos 0
+            (120, 16, np.array([7, 5, 8, 6], np.int32)),  # straddles a page
+            (127, 1, np.array([10, 6, 0, 0], np.int32)),  # exact page edge
+            (17, 5, np.array([10, 0, 0, 0], np.int32)),   # short prefill
+        ]
+        T_MAX, NB_MAX, WL_MAX = 32, 8, 32
+        plan_np, stats = ra.build_ragged_plan(
+            runs, token_block=8, page_size=PS,
+            t_max=T_MAX, nb_max=NB_MAX, wl_max=WL_MAX)
+        tables = np.zeros((T_MAX, MP), np.int32)
+        lengths = np.zeros((T_MAX,), np.int32)   # padding tokens: length 0
+        for (base, count, tbl), start in zip(runs, stats["run_starts"]):
+            for i in range(count):
+                tables[start + i] = tbl
+                lengths[start + i] = base + i + 1
+        real = stats["n_tokens"]
+        q = jnp.array(rng.randn(T_MAX, H, D), jnp.bfloat16)
+        kp = jnp.array(rng.randn(P, H, PS, D), jnp.bfloat16)
+        vp = jnp.array(rng.randn(P, H, PS, D), jnp.bfloat16)
+        plan = tuple(jnp.array(plan_np[k]) for k in ra.RAGGED_PLAN_FIELDS)
+        got = np.asarray(ra.ragged_paged_attention(
+            q, kp, vp, jnp.array(tables), jnp.array(lengths), plan,
+            sm_scale=0.125), np.float32)
+        want = np.asarray(ra._xla_ragged_reference(
+            q, kp, vp, jnp.array(tables), jnp.array(lengths), 0.125),
+            np.float32)
+        err = float(np.abs(got[:real] - want[:real]).max())
+        assert err < 0.05, f"ragged parity err={err}"
+        # length-0 tokens (inactive rows) emit zeros through the oracle
+        assert float(np.abs(want[real:]).max()) == 0.0
+        # the eligibility gate reports GL002-coded reasons on this host
+        r = ra.ragged_shape_unsupported_reason(128, 64, token_block=12)
+        assert r is not None and r.code == "GL002"
+
     # -- fused AdamW slab kernel vs composed update ----------------------
     def fused_adamw():
         from paddle_tpu.ops.pallas_kernels.fused_adamw import fused_adamw_update
@@ -333,6 +378,7 @@ def main() -> int:
     check("flash_attention", flash)
     check("decode_attention", decode_attention)
     check("paged_attention", paged_attention)
+    check("ragged_attention", ragged_attention)
     check("fused_adamw", fused_adamw)
     check("rms_norm", rms_norm)
     check("graph_lint", graph_lint)
