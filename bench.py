@@ -628,6 +628,27 @@ def main():
             f"traces={tc} on {'tpu' if on_tpu else 'cpu'})",
             0.0,
         )
+        # per-request SLO percentiles from the engine's telemetry
+        # histograms (TTFT = submission -> first token, queue included;
+        # ITL = gap between consecutive tokens of one request) — the
+        # latency companions to the throughput line above
+        slo = mets.get("slo", {})
+
+        def _ms(h, q):
+            return round(h.get(q, 0.0) * 1000.0, 3)
+
+        tt, it = slo.get("ttft", {}), slo.get("itl", {})
+        print(json.dumps({
+            "metric": f"gpt_{name}_serving_slo_ms",
+            "ttft_p50": _ms(tt, "p50"), "ttft_p95": _ms(tt, "p95"),
+            "ttft_p99": _ms(tt, "p99"), "ttft_count": int(tt.get("count", 0)),
+            "itl_p50": _ms(it, "p50"), "itl_p95": _ms(it, "p95"),
+            "itl_p99": _ms(it, "p99"),
+            "queue_wait_p50": _ms(slo.get("queue_wait", {}), "p50"),
+            "unit": "ms (per-request serving SLOs; includes the warmup "
+                    "request's compile-dominated TTFT sample)",
+        }))
+        sys.stdout.flush()
         srv_costs = {c.program: c for c in analysis.cost_reports()}
         # exact invocation counts from the engine's own counter:
         # fused_steps counts actual fused dispatches (idle/recovery ticks

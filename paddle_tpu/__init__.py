@@ -157,6 +157,7 @@ from .hapi import Model  # noqa: E402,F401
 from .hapi.model import summary, flops  # noqa: E402,F401
 from .nn.param_attr import ParamAttr  # noqa: E402,F401
 from . import profiler  # noqa: E402,F401
+from . import telemetry  # noqa: E402,F401
 from . import incubate  # noqa: E402,F401
 from . import static  # noqa: E402,F401
 from . import sparse  # noqa: E402,F401

@@ -30,6 +30,8 @@ import threading
 import uuid
 from typing import Any, Callable, Dict, List, Optional
 
+from ..telemetry import trace as _ttrace
+
 __all__ = ["CheckpointManager", "CheckpointError", "CheckpointInfo"]
 
 MANIFEST_NAME = "manifest.json"
@@ -153,7 +155,12 @@ class CheckpointManager:
             self._dir,
             f"{_TMP_PREFIX}{_CKPT_PREFIX}{step:08d}-{os.getpid()}-{uuid.uuid4().hex[:8]}")
         try:
-            self._write_staged(tree, step, epoch, meta, tmp)
+            # the span records on the CALLING thread — for async saves
+            # that is the ckpt-writer thread, so the exported trace shows
+            # the serialize/fsync/commit pipeline on its own row,
+            # interleaved with (not blocking) the train-step spans
+            with _ttrace.span("ckpt.write", step=step):
+                self._write_staged(tree, step, epoch, meta, tmp)
         except BaseException:
             # a FAILED (not crashed) write must not leak its staging dir —
             # transient ENOSPC/EIO on a long-lived trainer would otherwise
@@ -166,14 +173,16 @@ class CheckpointManager:
         final = os.path.join(self._dir, f"{_CKPT_PREFIX}{step:08d}")
         os.makedirs(tmp)
         self._hook("after_tmpdir")
-        payload = pickle.dumps(tree, protocol=4)
+        with _ttrace.span("ckpt.serialize"):
+            payload = pickle.dumps(tree, protocol=4)
         ppath = os.path.join(tmp, PAYLOAD_NAME)
-        with open(ppath, "wb") as f:
-            for off in range(0, len(payload), _WRITE_CHUNK):
-                f.write(payload[off:off + _WRITE_CHUNK])
-                self._hook("mid_payload")
-            f.flush()
-            os.fsync(f.fileno())
+        with _ttrace.span("ckpt.payload", bytes=len(payload)):
+            with open(ppath, "wb") as f:
+                for off in range(0, len(payload), _WRITE_CHUNK):
+                    f.write(payload[off:off + _WRITE_CHUNK])
+                    self._hook("mid_payload")
+                f.flush()
+                os.fsync(f.fileno())
         self._hook("after_payload")
         manifest = {
             "format_version": FORMAT_VERSION,
@@ -192,18 +201,20 @@ class CheckpointManager:
             os.fsync(f.fileno())
         _fsync_dir(tmp)
         self._hook("before_commit")
-        if os.path.exists(final):
-            # re-save of the same step: displace the old dir, commit, then
-            # drop the old content.  The brief both-absent window is covered
-            # by the previous checkpoint (latest() falls back).
-            stale = final + f".gc-{uuid.uuid4().hex[:8]}"
-            os.rename(final, stale)
-            os.rename(tmp, final)
-            shutil.rmtree(stale, ignore_errors=True)
-        else:
-            os.rename(tmp, final)
-        _fsync_dir(self._dir)
-        self._gc()
+        with _ttrace.span("ckpt.commit"):
+            if os.path.exists(final):
+                # re-save of the same step: displace the old dir, commit,
+                # then drop the old content.  The brief both-absent window
+                # is covered by the previous checkpoint (latest() falls
+                # back).
+                stale = final + f".gc-{uuid.uuid4().hex[:8]}"
+                os.rename(final, stale)
+                os.rename(tmp, final)
+                shutil.rmtree(stale, ignore_errors=True)
+            else:
+                os.rename(tmp, final)
+            _fsync_dir(self._dir)
+            self._gc()
 
     # -- discovery / validation -----------------------------------------
     @staticmethod

@@ -1,7 +1,11 @@
 """TCPStore python surface over the native C++ store (reference:
 paddle/phi/core/distributed/store/tcp_store.h:120). Falls back to an
 in-process dict store when the native library is unavailable (keeps
-single-host tests hermetic)."""
+single-host tests hermetic).
+
+All retry/wait deadlines use ``time.monotonic()`` — an NTP step or
+wall-clock jump must neither hang a bounded wait nor expire it
+instantly (same discipline as serving/engine.py's deadlines)."""
 from __future__ import annotations
 
 import ctypes
@@ -71,12 +75,12 @@ class TCPStore:
                 raise RuntimeError(f"TCPStore: cannot bind port {port}")
             # port=0 binds an ephemeral port; surface the real one
             self.port = port = int(self._lib.tcp_store_server_port(self._server))
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         while True:
             self._fd = self._lib.tcp_store_connect(host.encode(), ctypes.c_uint16(port))
             if self._fd >= 0:
                 break
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise TimeoutError(f"TCPStore: cannot connect {host}:{port}")
             time.sleep(0.05)
 
@@ -94,12 +98,12 @@ class TCPStore:
 
     def get(self, key: str) -> bytes:
         if self._local is not None:
-            deadline = time.time() + 60
+            deadline = time.monotonic() + 60
             while True:
                 with self._lock:
                     if key in self._local:
                         return self._local[key]
-                if time.time() > deadline:
+                if time.monotonic() > deadline:
                     raise TimeoutError(f"key {key} never set")
                 time.sleep(0.01)
         out = ctypes.POINTER(ctypes.c_uint8)()
@@ -152,12 +156,12 @@ class TCPStore:
         """Block until ``key`` exists (up to ``timeout`` seconds), then return
         its value. Raises TimeoutError if the key never arrives."""
         if self._local is not None:
-            deadline = time.time() + timeout
+            deadline = time.monotonic() + timeout
             while True:
                 with self._lock:
                     if key in self._local:
                         return self._local[key]
-                if time.time() > deadline:
+                if time.monotonic() > deadline:
                     raise TimeoutError(f"TCPStore.wait: key {key!r} not set "
                                        f"within {timeout}s")
                 time.sleep(0.01)
@@ -166,9 +170,9 @@ class TCPStore:
         # thread on this store — e.g. the elastic heartbeat, whose missed
         # beats would look like a dead node.  Poll with SHORT server-side
         # waits instead, releasing the lock between polls.
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         while True:
-            slice_ms = int(min(0.2, max(0.0, deadline - time.time())) * 1000)
+            slice_ms = int(min(0.2, max(0.0, deadline - time.monotonic())) * 1000)
             out = ctypes.POINTER(ctypes.c_uint8)()
             olen = ctypes.c_uint32()
             with self._io_lock:
@@ -182,18 +186,18 @@ class TCPStore:
                 if olen.value:
                     self._lib.tcp_store_free(out)
                 return data
-            if time.time() >= deadline:
+            if time.monotonic() >= deadline:
                 raise TimeoutError(f"TCPStore.wait: key {key!r} not set within "
                                    f"{timeout}s")
 
     def barrier(self, name: str, world_size: int, timeout: float = 60.0):
         """Counter barrier: every rank adds 1 then waits for world_size."""
         n = self.add(f"__barrier__/{name}", 1)
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout
         while n < world_size:
             time.sleep(0.02)
             n = self.add(f"__barrier__/{name}", 0)
-            if time.time() > deadline:
+            if time.monotonic() > deadline:
                 raise TimeoutError(f"barrier {name}: {n}/{world_size}")
 
     def __del__(self):

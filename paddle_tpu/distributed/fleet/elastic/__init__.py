@@ -202,9 +202,10 @@ class ElasticManager:
         return ElasticStatus.HOLD  # wait for nodes to come back
 
     def wait(self, timeout: float = 300.0) -> bool:
-        """Block until at least min nodes are alive (rescaled bring-up)."""
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        """Block until at least min nodes are alive (rescaled bring-up).
+        Monotonic deadline: a wall-clock jump must not expire it."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             if len(self.alive_nodes()) >= self._min:
                 return True
             time.sleep(self._interval)

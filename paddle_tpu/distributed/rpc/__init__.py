@@ -81,9 +81,10 @@ def init_rpc(name: str, rank: int = -1, world_size: Optional[int] = None,
     _state.stop.clear()
     _state.serving = threading.Thread(target=_serve_loop, daemon=True)
     _state.serving.start()
-    # wait until every worker registered (reference barriers at init)
-    deadline = time.time() + 60
-    while time.time() < deadline:
+    # wait until every worker registered (reference barriers at init);
+    # monotonic deadline — NTP jumps must not hang or instantly expire it
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
         if all(store.check(f"rpc/worker/{r}")
                for r in range(_state.world_size)):
             return

@@ -13,6 +13,7 @@ import numpy as np
 from .. import ops as _ops
 from ..jit.api import to_static
 from ..nn.layer import Layer
+from ..telemetry import trace as _ttrace
 from ..tensor import Tensor, to_tensor
 from .callbacks import Callback, ProgBarLogger
 
@@ -135,8 +136,12 @@ class Model:
                     continue  # replay past the resumed mid-epoch cursor
                 for c in cbs:
                     c.on_train_batch_begin(step)
-                loss = self._train_step(*_to_tensors(batch))
-                lv = float(loss)
+                # telemetry span over the whole host-visible step (the
+                # float() sync included); the compiled program's own
+                # jit.train_step span nests inside with its CostReport
+                with _ttrace.span("train.step", epoch=epoch, step=step):
+                    loss = self._train_step(*_to_tensors(batch))
+                    lv = float(loss)
                 history.append(lv)
                 for c in cbs:
                     c.on_train_batch_end(step, {"loss": lv})

@@ -37,6 +37,7 @@ import numpy as np
 
 from ..tensor import Tensor
 from ..ops import dispatch
+from ..telemetry import trace as _ttrace
 
 
 class AbstractScoutUnsupported(RuntimeError):
@@ -112,6 +113,7 @@ class _CompiledEntry:
         "_scout_result",
         "lint_report",
         "cost_report",
+        "span_args",
     )
 
     def __init__(self):
@@ -139,11 +141,58 @@ class _CompiledEntry:
         self.lint_report = None
         # CostReport from the FLAGS_graph_cost compile hook (same contract)
         self.cost_report = None
+        # cached telemetry span metadata (the CostReport digest attached
+        # to this program's dispatch spans; built lazily on first traced
+        # dispatch — see _span_args)
+        self.span_args = None
 
 
 # every StaticFunction ever built (weak): the GL007 retrace-churn pass
 # reads each fn's code-cache size to spot shape-churning to_static calls
 _STATIC_REGISTRY: "weakref.WeakSet[StaticFunction]" = weakref.WeakSet()
+
+# HardwareSpec for the roofline estimate attached to dispatch spans
+# (resolved once per process; False = resolution failed, stop trying)
+_SPAN_SPEC: List[Any] = []
+
+
+def _span_spec():
+    if not _SPAN_SPEC:
+        try:
+            from ..analysis import chip_spec
+
+            kind = getattr(jax.devices()[0], "device_kind", "")
+            _SPAN_SPEC.append(chip_spec(
+                os.environ.get("PALLAS_AXON_TPU_GEN", "") or "", kind or ""))
+        except Exception:  # noqa: BLE001 — span metadata is best-effort
+            _SPAN_SPEC.append(None)
+    return _SPAN_SPEC[0]
+
+
+def _span_args(entry) -> dict:
+    """Telemetry metadata for one compiled program's dispatch span: the
+    static CostReport digest + the roofline-estimated step time, so a
+    span's measured duration can be read against the model's bound
+    directly in the trace viewer.  Empty when FLAGS_graph_cost was off
+    at compile time.  Cached on the entry."""
+    a = entry.span_args
+    if a is None:
+        a = {}
+        c = entry.cost_report
+        if c is not None:
+            a = {"program": c.program,
+                 "gflop": round(c.flops / 1e9, 3),
+                 "hbm_mib_upper": round(c.bytes_upper / 2 ** 20, 2),
+                 "intensity": round(c.intensity, 2)}
+            spec = _span_spec()
+            if spec is not None:
+                try:
+                    a["roofline_est_ms"] = round(c.est_seconds(spec) * 1e3, 4)
+                    a["chip"] = spec.name
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass
+        entry.span_args = a
+    return a
 
 
 class StaticFunction:
@@ -227,7 +276,17 @@ class StaticFunction:
             entry = self._scout_and_compile(key, args, kwargs, arg_tensors)
             # scout call already produced results eagerly
             return entry._scout_result
+        if _ttrace._tracer is not None:
+            # telemetry span per compiled dispatch, carrying the program's
+            # static CostReport digest (when FLAGS_graph_cost was on at
+            # compile) so the exported trace shows measured-vs-roofline
+            # per fused step.  Disabled path: ONE module-global read.
+            with _ttrace.span(self._span_name(), **_span_args(entry)):
+                return self._run_compiled(entry, arg_tensors)
         return self._run_compiled(entry, arg_tensors)
+
+    def _span_name(self) -> str:
+        return f"jit.{getattr(self._fn, '__name__', 'program')}"
 
     @staticmethod
     def _run_compiled(entry, arg_tensors):

@@ -1,8 +1,23 @@
 """Profiler (reference: python/paddle/profiler/profiler.py:340 over the C++
-host/CUPTI tracers, N36). TPU-native: delegates to the XLA/TPU profiler
-(jax.profiler) which captures host + device (TensorCore) timelines into
-TensorBoard/trace-viewer format — the direct analog of the reference's
-chrome-trace export."""
+host/CUPTI tracers, N36).  TPU-native: the facade over BOTH timelines —
+
+- **device**: delegates to the XLA/TPU profiler (``jax.profiler``) which
+  captures host + TensorCore activity into TensorBoard/trace-viewer
+  format (the direct analog of the reference's CUPTI tracer);
+- **host**: drives :mod:`paddle_tpu.telemetry.trace` — the ring-buffered
+  span tracer every instrumented subsystem (serving step phases,
+  ``jit`` compiled dispatch, the checkpoint writer) records into.  Each
+  host span nests a ``jax.profiler.TraceAnnotation``, so while a device
+  capture is running the same named ranges appear on the device
+  timeline, aligning the two.
+
+``Profiler.export(path)`` writes the host spans as Chrome-trace JSON
+(chrome://tracing / https://ui.perfetto.dev), ``summary()`` aggregates
+them per span name (count / total / p50 / p99 ms), and the
+``export_chrome_tracing`` handler makes ``stop()`` export automatically
+— the reference's ``on_trace_ready`` contract.  See
+docs/observability.md.
+"""
 from __future__ import annotations
 
 import os
@@ -11,6 +26,8 @@ from contextlib import contextmanager
 from enum import Enum
 
 import jax
+
+from ..telemetry import trace as _ttrace
 
 
 class ProfilerState(Enum):
@@ -47,8 +64,14 @@ def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready handler: ``stop()`` writes the host-span
+    Chrome-trace JSON into ``dir_name`` (reference:
+    paddle.profiler.export_chrome_tracing)."""
+
     def handler(prof):
         prof._log_dir = dir_name
+        prof._export_on_stop = True
+        prof._worker_name = worker_name
 
     return handler
 
@@ -56,7 +79,7 @@ def export_chrome_tracing(dir_name, worker_name=None):
 class Profiler:
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
                  timer_only=False, record_shapes=False, profile_memory=False,
-                 with_flops=False):
+                 with_flops=False, span_capacity=65536):
         self._log_dir = "./profiler_log"
         self._timer_only = timer_only
         self._scheduler = scheduler
@@ -65,6 +88,13 @@ class Profiler:
         self._step = 0
         self._step_times = []
         self._t0 = None
+        # host span tracing (telemetry.trace)
+        self._span_capacity = int(span_capacity)
+        self._tracer = None
+        self._owns_tracer = False
+        self._export_on_stop = False
+        self._worker_name = None
+        self._last_ns = None
 
     def start(self):
         if self._on_trace_ready:
@@ -76,17 +106,40 @@ class Profiler:
                 self._running = True
             except Exception:
                 self._running = False
+        # enable host span tracing; compose with an already-enabled
+        # tracer (we only disable at stop() what we enabled here)
+        self._tracer = _ttrace.active()
+        if self._tracer is None:
+            self._tracer = _ttrace.enable(capacity=self._span_capacity)
+            self._owns_tracer = True
         self._t0 = time.perf_counter()
+        self._last_ns = time.perf_counter_ns()
 
     def stop(self):
         if self._running:
             jax.profiler.stop_trace()
             self._running = False
+        if self._owns_tracer:
+            _ttrace.disable()
+            self._owns_tracer = False
+        if self._export_on_stop and self._tracer is not None:
+            os.makedirs(self._log_dir, exist_ok=True)
+            name = f"{self._worker_name or 'host'}.chrome_trace.json"
+            self.export(os.path.join(self._log_dir, name))
 
     def step(self, num_samples=None):
         now = time.perf_counter()
+        now_ns = time.perf_counter_ns()
         if self._t0 is not None:
             self._step_times.append(now - self._t0)
+        # the inter-step interval as a span: gives summary()/export()
+        # content even when nothing else is instrumented
+        if self._tracer is not None and self._last_ns is not None:
+            tid, tname = _ttrace._thread_info()
+            self._tracer.record(_ttrace.Span(
+                "profiler.step", self._last_ns, now_ns - self._last_ns,
+                tid, tname, {"step": self._step}))
+        self._last_ns = now_ns
         self._t0 = now
         self._step += 1
 
@@ -98,11 +151,40 @@ class Profiler:
         arr = np.asarray(self._step_times[-10:])
         return f"avg step {arr.mean()*1000:.2f} ms (last {len(arr)})"
 
-    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
-        print(self.step_info())
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        """Aggregate the recorded host spans per name (count / total /
+        mean / p50 / p99 ms), print the table, and return the stats
+        dict.  Falls back to the step-timer line when no spans were
+        recorded (timer_only mode)."""
+        # only THIS profiler's tracer: summarize(tracer=None) would fall
+        # back to the process-global one and misattribute every span in
+        # the process to a profiler that never ran
+        stats = (_ttrace.summarize(tracer=self._tracer)
+                 if self._tracer is not None else {})
+        if not stats:
+            print(self.step_info())
+            return {}
+        print(_ttrace.format_summary(stats))
+        return stats
 
     def export(self, path, format="json"):  # noqa: A002
-        pass
+        """Write the recorded host spans as Chrome-trace JSON (opens in
+        chrome://tracing and Perfetto).  ``format`` accepts only
+        ``"json"`` — the reference's protobuf exporter has no TPU
+        analog."""
+        if format != "json":
+            raise ValueError(
+                f"unsupported export format {format!r} (only 'json' "
+                "Chrome-trace is supported)")
+        if self._tracer is None:
+            # never started: export an empty document rather than falling
+            # back to the process-global tracer's unrelated spans
+            _ttrace.export_chrome_trace(path, tracer=_ttrace.Tracer(
+                capacity=1, annotate=False))
+            return path
+        _ttrace.export_chrome_trace(path, tracer=self._tracer)
+        return path
 
     def __enter__(self):
         self.start()
@@ -115,14 +197,19 @@ class Profiler:
 
 class RecordEvent:
     """Annotated range (reference: paddle.profiler.RecordEvent over
-    platform/profiler RecordEvent) — maps to jax.profiler.TraceAnnotation."""
+    platform/profiler RecordEvent) — records a host telemetry span when
+    tracing is enabled (which itself nests the device-side
+    ``jax.profiler.TraceAnnotation``), else a bare TraceAnnotation."""
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._ctx = None
 
     def begin(self):
-        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        ctx = _ttrace.span(self.name)
+        if ctx is _ttrace._NOOP:
+            ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx = ctx
         self._ctx.__enter__()
 
     def end(self):

@@ -87,6 +87,8 @@ import numpy as np
 from .. import ops
 from ..analysis.cost_model import ragged_padding_waste
 from ..ops import dispatch
+from ..telemetry import metrics as _tmetrics
+from ..telemetry import trace as _ttrace
 from ..ops.pallas_kernels.ragged_paged_attention import (
     RAGGED_PLAN_FIELDS, build_ragged_plan, ragged_token_block,
 )
@@ -188,6 +190,18 @@ class Request:
         self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.deadline: Optional[float] = None   # absolute monotonic; at submit
         self.submit_t: Optional[float] = None   # monotonic queue-entry time
+        # SLO timestamps (time.monotonic; docs/observability.md): every
+        # terminal request carries a complete, monotonically ordered set
+        # of the stages it actually reached — t_submitted <= t_admitted
+        # <= t_first_token <= t_terminal, with the middle two None for
+        # requests that never seated / never produced a token (TTFT
+        # histograms therefore exclude never-prefilled requests by
+        # construction)
+        self.t_submitted: Optional[float] = None
+        self.t_admitted: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self.t_terminal: Optional[float] = None
+        self._t_last_token: Optional[float] = None   # ITL bookkeeping
         self.error: Optional[BaseException] = None
         self.callback_error: Optional[BaseException] = None
         self._cancelled = False
@@ -235,6 +249,13 @@ class Request:
         """prompt + generated ids (the ``generate()`` convention)."""
         return np.concatenate([self.prompt,
                                np.asarray(self.tokens, np.int64)])
+
+    def timestamps(self) -> dict:
+        """The per-request SLO timestamps (monotonic seconds; None means
+        the request never reached that stage)."""
+        return {"submitted": self.t_submitted, "admitted": self.t_admitted,
+                "first_token": self.t_first_token,
+                "terminal": self.t_terminal}
 
 
 class RequestQueue:
@@ -294,6 +315,10 @@ class RequestQueue:
 # of request churn == the retrace-freedom proof.  One key since the fused
 # step collapsed the prefill/decode phase pair.
 _SERVE_TRACE_COUNTS = {"fused": 0}
+
+# registry label for each engine's counters/histograms (one process may
+# host many engines; tests create dozens — the label keeps them distinct)
+_ENGINE_SEQ = itertools.count()
 
 
 def serve_trace_counts() -> dict:
@@ -589,7 +614,14 @@ class ServingEngine:
         # their device copies and re-upload only when a mirror mutates
         self._sampling_cache = None
 
-        self._totals = {"steps": 0, "tokens": 0, "admitted": 0,
+        # cumulative totals — migrated onto the process-wide telemetry
+        # registry (docs/observability.md): each key is the
+        # ``serving_<key>`` counter labeled with this engine's id, and
+        # the CounterSet facade keeps the historical ``+=``/``dict()``
+        # idiom bit-compatible (metrics() reads the same ints as ever)
+        self._engine_label = {"engine": str(next(_ENGINE_SEQ))}
+        self._totals = _tmetrics.CounterSet(
+            "serving", {"steps": 0, "tokens": 0, "admitted": 0,
                         "completed": 0,
                         # fused-step accounting: exact dispatch count (the
                         # bench roofline denominator), prefill tokens that
@@ -606,7 +638,36 @@ class ServingEngine:
                         # fault-containment counters (admission path SLOs)
                         "failed": 0, "cancelled": 0, "timed_out": 0,
                         "shed": 0, "quarantined": 0, "step_retries": 0,
-                        "recoveries": 0, "rebuilds": 0}
+                        "recoveries": 0, "rebuilds": 0},
+            labels=self._engine_label)
+        # per-request SLO histograms (seconds, log-bucketed): TTFT and
+        # e2e are measured FROM SUBMISSION (queue time included — the
+        # client-visible latency), queue_wait is submission->seating,
+        # ITL is the gap between consecutive emitted tokens of one
+        # request.  Surfaced as p50/p95/p99 in metrics()["slo"],
+        # serving_bench sweep lines, and bench.py's *_ttft_ms/_itl_ms
+        # JSON keys.
+        reg = _tmetrics.registry()
+        self._slo = {
+            "ttft": reg.histogram(
+                "serving_ttft_seconds",
+                "submission -> first generated token (queue included)"),
+            "itl": reg.histogram(
+                "serving_itl_seconds",
+                "inter-token latency between consecutive emitted tokens"),
+            "queue_wait": reg.histogram(
+                "serving_queue_wait_seconds",
+                "submission -> seated in a decode slot"),
+            "e2e": reg.histogram(
+                "serving_e2e_seconds",
+                "submission -> terminal state (all terminals)"),
+        }
+        self._slo = {k: h.labels(**self._engine_label)
+                     for k, h in self._slo.items()}
+        self._gauges = {
+            name: reg.gauge(f"serving_{name}").labels(**self._engine_label)
+            for name in ("queue_depth", "active_slots", "pages_used",
+                         "pool_occupancy")}
         self._step_emitted = 0           # tokens emitted in the current step
         self._last_metrics: dict = {}
         self._last_occupancy = (0.0, 0.0)   # (grid, q-row) of the last step
@@ -694,12 +755,15 @@ class ServingEngine:
                       deadline_s=deadline_s)
         now = time.monotonic()
         req.submit_t = now
+        req.t_submitted = now
         if req.deadline_s is not None:
             req.deadline = now + req.deadline_s
         try:
             return self.queue.submit(req)
         except Overloaded:
-            self._totals["shed"] += 1
+            # submit() runs on any client thread, outside the step lock:
+            # the atomic inc, not the racy `+=` read-modify-write
+            self._totals.inc("shed")
             raise
 
     # -- the serving loop --------------------------------------------------
@@ -711,25 +775,31 @@ class ServingEngine:
         finished requests (their pages free immediately).  A crashed or
         stalled step never escapes: the implicated requests end FAILED and
         the engine recovers.  Returns this step's metrics."""
-        with self._lock, self._eval_mode():
+        with self._lock, self._eval_mode(), _ttrace.span("serve.step"):
             # under the lock: close() also serializes on it, so a racing
             # close cannot delete the pool between this check and the
             # fused dispatch
             self._check_open()
             t0 = time.perf_counter()
             self._step_emitted = 0
-            now = time.monotonic()
-            self._reap(now)
-            self._admit(now)
-            sched = self.scheduler
-            work = sched.plan_step(self.prefill_token_budget)
+            with _ttrace.span("serve.plan"):
+                now = time.monotonic()
+                self._reap(now)
+                self._admit(now)
+                sched = self.scheduler
+                work = sched.plan_step(self.prefill_token_budget)
             if work:
                 # the step's flat inputs are a pure function of the host
                 # mirrors, which only advance on success — a retry after a
                 # transient failure rebuilds the SAME idempotent scatter
-                inputs, stats = self._build_step_inputs(work)
+                with _ttrace.span("serve.pack"):
+                    inputs, stats = self._build_step_inputs(work)
                 try:
-                    out = self._run_fused(inputs)
+                    # the nested jit.fused_step span carries the program's
+                    # CostReport digest (per compiled entry, so greedy and
+                    # sampling variants each report their own cost)
+                    with _ttrace.span("serve.dispatch"):
+                        out = self._run_fused(inputs)
                 except StepStalledError as e:
                     self._recover(e, rebuild=True, stalled=True)
                     out = None
@@ -741,35 +811,48 @@ class ServingEngine:
                     # serving roofline denominator (ticks with no seated
                     # work / failed dispatches don't run one)
                     self._totals["fused_steps"] += 1
-                    self._harvest_fused(work, stats, *out)
+                    with _ttrace.span("serve.harvest"):
+                        self._harvest_fused(work, stats, *out)
                     self._backoff_s = self.readmission_backoff_s
-            dt = time.perf_counter() - t0
-            emitted = self._step_emitted
-            self._totals["steps"] += 1
-            self._totals["tokens"] += emitted
-            grid_occ, row_occ = self._last_occupancy
-            self._last_metrics = {
-                "active_slots": sched.active_slots,
-                "queue_depth": self.queue.depth,
-                "pages_used": self.allocator.used_pages,
-                "pages_capacity": self.allocator.capacity,
-                "occupancy": sched.occupancy,
-                "tokens_this_step": emitted,
-                "tokens_per_sec": emitted / dt if dt > 0 else 0.0,
-                "step_seconds": dt,
-                # ragged-launch occupancy of the last dispatched step:
-                # real work items / fixed work-list length, and real query
-                # rows / packed block rows (the MXU-side figure)
-                "grid_occupancy": grid_occ,
-                "q_row_occupancy": row_occ,
-                # fault counters ride every step's metrics (admission SLOs)
-                "failed": self._totals["failed"],
-                "cancelled": self._totals["cancelled"],
-                "timed_out": self._totals["timed_out"],
-                "shed": self._totals["shed"],
-                "recoveries": self._totals["recoveries"],
-            }
-            return dict(self._last_metrics)
+            with _ttrace.span("serve.commit"):
+                return self._commit_step_metrics(t0)
+
+    def _commit_step_metrics(self, t0: float) -> dict:
+        """Fold the step's tallies into totals + gauges and build the
+        per-step metrics dict (the ``serve.commit`` phase)."""
+        dt = time.perf_counter() - t0
+        emitted = self._step_emitted
+        self._totals["steps"] += 1
+        self._totals["tokens"] += emitted
+        grid_occ, row_occ = self._last_occupancy
+        sched = self.scheduler
+        self._last_metrics = {
+            "active_slots": sched.active_slots,
+            "queue_depth": self.queue.depth,
+            "pages_used": self.allocator.used_pages,
+            "pages_capacity": self.allocator.capacity,
+            "occupancy": sched.occupancy,
+            "tokens_this_step": emitted,
+            "tokens_per_sec": emitted / dt if dt > 0 else 0.0,
+            "step_seconds": dt,
+            # ragged-launch occupancy of the last dispatched step:
+            # real work items / fixed work-list length, and real query
+            # rows / packed block rows (the MXU-side figure)
+            "grid_occupancy": grid_occ,
+            "q_row_occupancy": row_occ,
+            # fault counters ride every step's metrics (admission SLOs)
+            "failed": self._totals["failed"],
+            "cancelled": self._totals["cancelled"],
+            "timed_out": self._totals["timed_out"],
+            "shed": self._totals["shed"],
+            "recoveries": self._totals["recoveries"],
+        }
+        g = self._gauges
+        g["queue_depth"].set(self._last_metrics["queue_depth"])
+        g["active_slots"].set(self._last_metrics["active_slots"])
+        g["pages_used"].set(self._last_metrics["pages_used"])
+        g["pool_occupancy"].set(self._last_metrics["occupancy"])
+        return dict(self._last_metrics)
 
     def _run_fused(self, inputs) -> Tuple[np.ndarray, np.ndarray]:
         """Dispatch the fused step under the watchdog; one immediate retry
@@ -850,6 +933,14 @@ class ServingEngine:
         return (ids[:, None], packed), stats
 
     def _fused_thunk(self, fused, inputs, cancelled):
+        # the span records on the CALLING thread — under a watchdog this
+        # is the supervised _StepWorker, so the exported trace shows the
+        # device-dispatch range on the worker's row, interleaved with the
+        # dispatcher's serve.dispatch wait on its own row
+        with _ttrace.span("serve.device_step"):
+            return self._fused_thunk_body(fused, inputs, cancelled)
+
+    def _fused_thunk_body(self, fused, inputs, cancelled):
         self._hook("before_decode")
         if cancelled():          # abandoned while the fault hook stalled:
             return None          # the result is discarded; skip dispatch
@@ -1021,7 +1112,11 @@ class ServingEngine:
                                       f"request {r.id}: deadline_s="
                                       f"{r.deadline_s} passed while queued"))
             else:
-                self._totals["shed"] += 1
+                # atomic inc: "shed" is also incremented by submit()
+                # OUTSIDE the step lock, so the `+=` read-modify-write
+                # here could interleave with it and lose counts / trip
+                # the monotonicity check
+                self._totals.inc("shed")
                 self._terminalize(r, RequestState.TIMED_OUT, Overloaded(
                     f"request {r.id}: queued longer than "
                     f"max_queue_wait_s={max_wait}"))
@@ -1060,6 +1155,9 @@ class ServingEngine:
                 self.queue.push_front(req)
                 return
             self._totals["admitted"] += 1
+            req.t_admitted = now
+            if req.t_submitted is not None:
+                self._slo["queue_wait"].observe(now - req.t_submitted)
             sp = req.sampling
             self._temp[idx] = np.float32(sp.temperature)
             self._top_p[idx] = np.float32(sp.top_p)
@@ -1078,14 +1176,16 @@ class ServingEngine:
         survive untouched.  With ``rebuild`` the device pool and compiled
         steps are reconstructed from the scheduler's host mirrors.
         Re-admission backs off exponentially (reset by a clean step)."""
-        self._totals["recoveries"] += 1
-        for i, _slot in self.scheduler.seated():
-            self._fail_slot(i, error)
-        if rebuild:
-            self._rebuild(release_old=not stalled)
-        now = time.monotonic()
-        self._admit_after = now + self._backoff_s
-        self._backoff_s = min(self._backoff_s * 2.0, self.backoff_max_s)
+        with _ttrace.span("serve.recover", error=type(error).__name__,
+                          rebuild=rebuild):
+            self._totals["recoveries"] += 1
+            for i, _slot in self.scheduler.seated():
+                self._fail_slot(i, error)
+            if rebuild:
+                self._rebuild(release_old=not stalled)
+            now = time.monotonic()
+            self._admit_after = now + self._backoff_s
+            self._backoff_s = min(self._backoff_s * 2.0, self.backoff_max_s)
 
     def _rebuild(self, release_old: bool = True):
         """Reconstruct the engine's DEVICE state after a catastrophic step
@@ -1099,14 +1199,15 @@ class ServingEngine:
             "rebuild with seated requests would strand their K/V"
         assert self.allocator.used_pages == 0, \
             f"rebuild leaked {self.allocator.used_pages} pages"
-        old = self.cache
-        self.cache = self.model.new_paged_kv_cache(
-            self.num_pages, self.page_size, dtype=self.cache_dtype)
-        self.scheduler.reset_mirrors()
-        self._build_steps()
-        if release_old:
-            old.release()
-        self._totals["rebuilds"] += 1
+        with _ttrace.span("serve.rebuild"):
+            old = self.cache
+            self.cache = self.model.new_paged_kv_cache(
+                self.num_pages, self.page_size, dtype=self.cache_dtype)
+            self.scheduler.reset_mirrors()
+            self._build_steps()
+            if release_old:
+                old.release()
+            self._totals["rebuilds"] += 1
 
     # -- terminal transitions ----------------------------------------------
     def _clear_slot_mirrors(self, idx: int):
@@ -1122,6 +1223,7 @@ class ServingEngine:
         """Finish a NEVER-SEATED request in a non-DONE terminal state."""
         req.error = error
         req.state = state
+        self._observe_terminal(req)
         if state == RequestState.CANCELLED:
             self._totals["cancelled"] += 1
         elif state == RequestState.TIMED_OUT:
@@ -1129,6 +1231,15 @@ class ServingEngine:
         elif state == RequestState.FAILED:
             self._totals["failed"] += 1
         req._done.set()
+
+    def _observe_terminal(self, req: Request):
+        """Stamp ``t_terminal`` and feed the e2e histogram — called on
+        EVERY terminal transition (DONE and otherwise), exactly once per
+        request (terminal states never transition again)."""
+        now = time.monotonic()
+        req.t_terminal = now
+        if req.t_submitted is not None:
+            self._slo["e2e"].observe(now - req.t_submitted)
 
     def _retire_slot(self, idx: int, state: str,
                      error: Optional[BaseException]):
@@ -1145,6 +1256,14 @@ class ServingEngine:
     def _emit(self, req: Request, tok: int):
         req.tokens.append(tok)
         self._step_emitted += 1
+        now = time.monotonic()
+        if req.t_first_token is None:
+            req.t_first_token = now
+            if req.t_submitted is not None:
+                self._slo["ttft"].observe(now - req.t_submitted)
+        elif req._t_last_token is not None:
+            self._slo["itl"].observe(now - req._t_last_token)
+        req._t_last_token = now
         if req.on_token is not None:
             try:
                 self._hook("callback")
@@ -1177,6 +1296,7 @@ class ServingEngine:
         self._clear_slot_mirrors(idx)
         self._totals["completed"] += 1
         req.state = RequestState.DONE
+        self._observe_terminal(req)
         req._done.set()
 
     def _check_open(self):
@@ -1204,6 +1324,10 @@ class ServingEngine:
                                       if wc else 0.0)
         out["mean_q_row_occupancy"] = (self._totals["block_rows"] / rc
                                        if rc else 0.0)
+        # per-request SLO digests (seconds): count/sum/mean/min/max +
+        # p50/p95/p99 per histogram — TTFT, inter-token latency, queue
+        # wait, end-to-end (docs/observability.md "SLO definitions")
+        out["slo"] = {k: h.summary() for k, h in self._slo.items()}
         return out
 
     @property
@@ -1232,6 +1356,13 @@ class ServingEngine:
                 self.cache.release()
                 if self._worker is not None:
                     self._worker.shutdown()
+                # drop this engine's children from the process registry:
+                # a host recycling engines (or the test suite's dozens)
+                # must not grow the Prometheus exposition forever.  The
+                # CounterSet/histogram handles keep working — metrics()
+                # stays readable after close — they just stop being
+                # exported.
+                _tmetrics.registry().drop_labels(**self._engine_label)
 
 
 def _state_intact(e: BaseException) -> bool:

@@ -27,6 +27,11 @@
 # every committed entry must be legal under the CURRENT static tile/VMEM
 # gates; pure static analysis, never times; see docs/graph_lint.md
 # "v2: autotuner").  PADDLE_TPU_SKIP_AUTOTUNE_GATE=1 skips it.
+#
+# A telemetry gate runs sixth (tools/obs_gate.py — disabled-path span
+# overhead <3% of a compiled dispatch, Chrome-trace export valid with
+# nested serving-phase spans, Prometheus exposition parses; see
+# docs/observability.md).  PADDLE_TPU_SKIP_OBS_GATE=1 skips it.
 export JAX_PLATFORMS=cpu
 export PYTHONPATH=$(python - << 'PY'
 import os
@@ -77,6 +82,15 @@ if [ -z "$PADDLE_TPU_SKIP_AUTOTUNE_GATE" ]; then
     python "$(dirname "$0")/tools/autotune.py" --validate || {
         rc=$?
         echo "run_tests: autotune replay gate FAILED (rc=$rc)"
+        exit $rc
+    }
+fi
+
+if [ -z "$PADDLE_TPU_SKIP_OBS_GATE" ]; then
+    echo "run_tests: telemetry gate (tools/obs_gate.py)"
+    python "$(dirname "$0")/tools/obs_gate.py" || {
+        rc=$?
+        echo "run_tests: telemetry gate FAILED (rc=$rc)"
         exit $rc
     }
 fi

@@ -1,0 +1,613 @@
+"""Unified telemetry (docs/observability.md): metrics registry, host span
+tracer, profiler facade, and the serving engine's per-request SLO
+instrumentation — including its behavior under injected faults:
+
+- Counters/Gauges/Histograms: labeled children, log-bucketed quantiles,
+  JSON snapshot, Prometheus text exposition (parse + histogram
+  invariants), the CounterSet dict-compat migration shim;
+- span tracer: disabled no-op path, ring-buffer overflow accounting,
+  thread-aware Chrome-trace export with interval nesting, the decorator;
+- profiler facade: ``export()`` writes real Chrome-trace JSON,
+  ``summary()`` aggregates per span name, ``export_chrome_tracing``'s
+  handler exports at ``stop()``;
+- SLO timestamps: every terminal request (DONE, FAILED, TIMED_OUT,
+  CANCELLED) carries a complete, monotonically ordered set of the stages
+  it reached; TTFT histograms exclude never-prefilled requests by
+  construction; counters stay exact across a watchdog rebuild and
+  randomized fault schedules.
+"""
+import json
+import os
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import profiler
+from paddle_tpu.models import GPTForPretraining, gpt_tiny
+from paddle_tpu.serving import (
+    FaultInjector, RequestState, ServingEngine, random_schedule,
+)
+from paddle_tpu.telemetry import metrics as tm
+from paddle_tpu.telemetry import trace as tt
+
+N_NEW = 4
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+def test_log_buckets():
+    b = tm.log_buckets(1e-3, 1e3, per_decade=2)
+    assert list(b) == sorted(b)
+    assert b[0] == pytest.approx(1e-3)
+    assert b[-1] >= 1e3
+    # 6 decades x 2 per decade + the closing edge
+    assert len(b) == 13
+    with pytest.raises(ValueError):
+        tm.log_buckets(0.0, 1.0)
+    with pytest.raises(ValueError):
+        tm.log_buckets(1.0, 0.5)
+
+
+def test_counter_inc_and_monotonicity():
+    reg = tm.Registry()
+    c = reg.counter("c_total", help="h")
+    c.inc()
+    c.inc(2.5)
+    assert c.value() == pytest.approx(3.5)
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # same name resolves to the SAME family; kind conflicts raise
+    assert reg.counter("c_total") is c
+    with pytest.raises(ValueError):
+        reg.gauge("c_total")
+
+
+def test_gauge_set_inc_dec():
+    reg = tm.Registry()
+    g = reg.gauge("g")
+    g.set(5.0)
+    g.labels().inc(2.0)
+    g.labels().dec(3.0)
+    assert g.value() == pytest.approx(4.0)
+
+
+def test_labeled_children_distinct_and_cached():
+    reg = tm.Registry()
+    c = reg.counter("x_total")
+    a = c.labels(engine="0")
+    b = c.labels(engine="1")
+    assert a is not b
+    a.inc(3)
+    assert c.value(engine="0") == 3
+    assert c.value(engine="1") == 0
+    # label resolution is cached: identical label sets hit one child
+    assert c.labels(engine="0") is a
+    assert len(c.children()) == 2
+
+
+def test_histogram_quantiles_and_summary():
+    reg = tm.Registry()
+    h = reg.histogram("lat_seconds")
+    child = h.labels()
+    rng = np.random.RandomState(0)
+    vals = 10 ** rng.uniform(-4, -1, size=2000)       # decades of spread
+    for v in vals:
+        child.observe(float(v))
+    s = child.summary()
+    assert s["count"] == 2000
+    assert s["sum"] == pytest.approx(vals.sum(), rel=1e-9)
+    assert s["min"] == pytest.approx(vals.min())
+    assert s["max"] == pytest.approx(vals.max())
+    # bucketed quantiles: within a bucket width of the exact ones, and
+    # ordered
+    for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+        exact = float(np.quantile(vals, q))
+        ratio = s[key] / exact
+        assert 1 / 1.6 < ratio < 1.6, (key, s[key], exact)
+    assert s["p50"] <= s["p95"] <= s["p99"] <= s["max"]
+    assert s["min"] <= s["p50"]
+
+
+def test_histogram_empty_and_overflow():
+    reg = tm.Registry()
+    h = reg.histogram("h", buckets=(1.0, 10.0))
+    assert h.summary() == {"count": 0, "sum": 0.0, "mean": 0.0, "min": 0.0,
+                           "max": 0.0, "p50": 0.0, "p95": 0.0, "p99": 0.0}
+    ch = h.labels()
+    ch.observe(100.0)                                  # overflow bucket
+    s = ch.summary()
+    assert s["count"] == 1 and s["p99"] == pytest.approx(100.0)
+    with pytest.raises(ValueError):
+        reg.histogram("bad", buckets=(10.0, 1.0))
+    with pytest.raises(ValueError):
+        ch.quantile(1.5)
+
+
+def test_snapshot_shape():
+    reg = tm.Registry()
+    reg.counter("a_total", help="ha").inc(2, engine="7")
+    reg.histogram("b_seconds").observe(0.5)
+    snap = reg.snapshot()
+    assert snap["a_total"]["kind"] == "counter"
+    assert snap["a_total"]["series"][0] == {
+        "labels": {"engine": "7"}, "value": 2.0}
+    hs = snap["b_seconds"]["series"][0]
+    assert hs["count"] == 1 and hs["p50"] > 0
+    json.dumps(snap)                                   # JSON-safe
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (-?(?:[0-9.]+(?:e[+-]?[0-9]+)?))$",
+    re.IGNORECASE)
+
+
+def test_prometheus_text_parses_and_histogram_invariants():
+    reg = tm.Registry()
+    reg.counter("req_total", help="requests").inc(3, engine="0")
+    reg.gauge("depth").set(2.0)
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v, engine="0")
+    text = reg.prometheus_text()
+    buckets, count = [], None
+    for ln in text.splitlines():
+        if ln.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"unparseable line: {ln!r}"
+        if m.group(1) == "lat_seconds_bucket":
+            le = re.search(r'le="([^"]*)"', m.group(2)).group(1)
+            buckets.append((le, float(m.group(3))))
+        elif m.group(1) == "lat_seconds_count":
+            count = float(m.group(3))
+    assert [v for _, v in buckets] == [1.0, 2.0, 3.0]
+    assert buckets[-1][0] == "+Inf" and buckets[-1][1] == count
+    assert "# TYPE lat_seconds histogram" in text
+    assert 'req_total{engine="0"} 3' in text
+
+
+def test_prometheus_label_escaping():
+    reg = tm.Registry()
+    reg.counter("e_total").inc(1, path='a"b\\c')
+    text = reg.prometheus_text()
+    assert r'path="a\"b\\c"' in text
+
+
+def test_counter_set_atomic_inc():
+    """The `cs[k] += n` idiom is a read-modify-write and only safe under
+    the caller's lock; inc() goes straight to the child's atomic inc —
+    interleaved with a stale dict-idiom write it must not raise."""
+    reg = tm.Registry()
+    cs = tm.CounterSet("p", {"k": 0}, reg=reg)
+    cs.inc("k")
+    cs.inc("k", 2.0)
+    assert cs["k"] == 3
+    with pytest.raises(ValueError):
+        cs.inc("k", -1)                                # still monotonic
+
+
+def test_registry_drop_labels():
+    reg = tm.Registry()
+    c = reg.counter("d_total")
+    c.inc(1, engine="0")
+    c.inc(2, engine="1")
+    h = reg.histogram("d_seconds")
+    held = h.labels(engine="0")
+    held.observe(0.5)
+    reg.drop_labels(engine="0")
+    text = reg.prometheus_text()
+    assert 'engine="0"' not in text
+    assert 'd_total{engine="1"} 2' in text
+    # the dropped handle keeps working — it just stops being exported
+    held.observe(0.7)
+    assert held.summary()["count"] == 2
+    with pytest.raises(ValueError):
+        reg.drop_labels()                              # empty filter
+
+
+def test_counter_set_dict_compat():
+    reg = tm.Registry()
+    cs = tm.CounterSet("srv", {"steps": 0, "tokens": 3},
+                       labels={"engine": "9"}, reg=reg)
+    cs["steps"] += 1
+    cs["tokens"] += 2
+    assert cs["steps"] == 1 and isinstance(cs["steps"], int)
+    assert dict(cs) == {"steps": 1, "tokens": 5}
+    assert cs.as_dict() == {"steps": 1, "tokens": 5}
+    assert "steps" in cs and "nope" not in cs
+    assert cs.get("nope", -1) == -1
+    assert sorted(cs.keys()) == ["steps", "tokens"]
+    # values ARE the registry counters (the migration's whole point)
+    assert reg.counter("srv_tokens").value(engine="9") == 5
+    with pytest.raises(ValueError):
+        cs["steps"] = 0                                # net decrease
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def tracer():
+    """A fresh process-wide tracer, always detached at teardown."""
+    tt.disable()
+    tr = tt.enable(capacity=1024, annotate=False)
+    yield tr
+    tt.disable()
+
+
+def test_span_disabled_is_noop():
+    assert tt.active() is None
+    ctx = tt.span("x", a=1)
+    assert ctx is tt._NOOP
+    with ctx:
+        pass                                           # records nothing
+
+
+def test_span_records(tracer):
+    with tt.span("outer", k="v"):
+        with tt.span("inner"):
+            pass
+    spans = tracer.spans()
+    assert [s.name for s in spans] == ["inner", "outer"]   # exit order
+    outer = spans[1]
+    assert outer.args == {"k": "v"} and outer.dur_ns > 0
+    assert outer.tid == threading.get_ident()
+    # inner nests inside outer on the perf_counter_ns timeline
+    inner = spans[0]
+    assert outer.t0_ns <= inner.t0_ns
+    assert inner.t0_ns + inner.dur_ns <= outer.t0_ns + outer.dur_ns
+
+
+def test_enable_idempotent_disable_detaches(tracer):
+    assert tt.enable() is tracer                       # composes, not resets
+    with tt.span("a"):
+        pass
+    detached = tt.disable()
+    assert detached is tracer and tt.active() is None
+    # buffered spans stay readable after detach
+    assert [s.name for s in detached.spans()] == ["a"]
+    assert tt.disable() is None                        # idempotent
+
+
+def test_ring_buffer_overflow():
+    tr = tt.Tracer(capacity=4, annotate=False)
+    for i in range(6):
+        tr.record(tt.Span(f"s{i}", i, 1, 0, "t", None))
+    assert len(tr) == 4 and tr.dropped == 2
+    assert [s.name for s in tr.spans()] == ["s2", "s3", "s4", "s5"]
+    tr.clear()
+    assert len(tr) == 0 and tr.dropped == 0
+    with pytest.raises(ValueError):
+        tt.Tracer(capacity=0)
+
+
+def test_traced_decorator(tracer):
+    @tt.traced()
+    def work(x):
+        """doc"""
+        return x + 1
+
+    assert work(1) == 2
+    assert work.__name__ == "work" and work.__doc__ == "doc"
+    assert [s.name for s in tracer.spans()] == ["test_traced_decorator.<locals>.work"]
+    tt.disable()
+    assert work(2) == 3                                # passthrough
+    assert len(tracer.spans()) == 1
+
+
+def test_chrome_trace_export_threads_and_nesting(tracer, tmp_path):
+    def worker():
+        with tt.span("w.outer"):
+            with tt.span("w.inner"):
+                pass
+
+    with tt.span("main.span", meta=1):
+        pass
+    th = threading.Thread(target=worker, name="worker-0")
+    th.start()
+    th.join()
+
+    path = str(tmp_path / "trace.json")
+    doc = tt.export_chrome_trace(path)
+    with open(path) as f:
+        assert json.load(f) == doc
+    events = doc["traceEvents"]
+    metas = [e for e in events if e["ph"] == "M"]
+    comp = [e for e in events if e["ph"] == "X"]
+    tids = {e["tid"] for e in comp}
+    assert len(tids) == 2                              # main + worker rows
+    assert {m["args"]["name"] for m in metas} >= {"worker-0"}
+    by_name = {e["name"]: e for e in comp}
+    assert by_name["main.span"]["args"] == {"meta": 1}
+    inner, outer = by_name["w.inner"], by_name["w.outer"]
+    assert inner["tid"] == outer["tid"]
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 0.5
+    assert doc["otherData"]["dropped_spans"] == 0
+
+
+def test_summarize_and_format(tracer):
+    for _ in range(3):
+        with tt.span("a"):
+            pass
+    with tt.span("b"):
+        pass
+    stats = tt.summarize()
+    assert stats["a"]["count"] == 3 and stats["b"]["count"] == 1
+    assert stats["a"]["p50_ms"] <= stats["a"]["p99_ms"] <= stats["a"]["max_ms"]
+    table = tt.format_summary(stats)
+    assert "a" in table and "count" in table
+    assert tt.format_summary({}) == "no spans recorded"
+
+
+# ---------------------------------------------------------------------------
+# profiler facade
+# ---------------------------------------------------------------------------
+
+def test_profiler_export_and_summary(tmp_path, capsys):
+    tt.disable()
+    prof = profiler.Profiler(timer_only=True)
+    prof.start()
+    assert tt.active() is not None                     # facade enabled it
+    with tt.span("user.range"):
+        pass
+    prof.step()
+    prof.stop()
+    assert tt.active() is None                         # and detached it
+    stats = prof.summary()
+    assert stats["user.range"]["count"] == 1
+    assert stats["profiler.step"]["count"] == 1
+    assert "user.range" in capsys.readouterr().out
+    path = str(tmp_path / "prof.json")
+    assert prof.export(path) == path
+    with open(path) as f:
+        names = {e["name"] for e in json.load(f)["traceEvents"]
+                 if e.get("ph") == "X"}
+    assert {"user.range", "profiler.step"} <= names
+    with pytest.raises(ValueError):
+        prof.export(str(tmp_path / "x.pb"), format="proto")
+
+
+def test_profiler_export_chrome_tracing_handler(tmp_path):
+    tt.disable()
+    logdir = str(tmp_path / "logs")
+    handler = profiler.export_chrome_tracing(logdir, worker_name="w7")
+    with profiler.Profiler(timer_only=True, on_trace_ready=handler) as prof:
+        with tt.span("in.profile"):
+            pass
+        prof.step()
+    out = os.path.join(logdir, "w7.chrome_trace.json")
+    assert os.path.exists(out)                         # stop() exported
+    with open(out) as f:
+        doc = json.load(f)
+    assert any(e.get("name") == "in.profile" for e in doc["traceEvents"])
+
+
+def test_record_event_records_span():
+    tt.disable()
+    tr = tt.enable(annotate=False)
+    try:
+        ev = profiler.RecordEvent("my.range")
+        ev.begin()
+        ev.end()
+        assert [s.name for s in tr.spans()] == ["my.range"]
+    finally:
+        tt.disable()
+
+
+# ---------------------------------------------------------------------------
+# serving SLO instrumentation
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def served():
+    pt.seed(0)
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, cfg.vocab_size, (s,))
+               for s in (5, 9, 7, 12, 17, 4, 11, 6)]
+    return m, cfg, prompts
+
+
+def _engine(m, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("max_context", 64)
+    kw.setdefault("cache_dtype", "float32")
+    return ServingEngine(m, **kw)
+
+
+def _assert_ordered_timestamps(req):
+    """Every stage the request reached is stamped, in monotonic order,
+    and no LATER stage is stamped without the earlier ones."""
+    ts = req.timestamps()
+    assert ts["submitted"] is not None, req.id
+    assert ts["terminal"] is not None, (req.id, req.state)
+    if ts["first_token"] is not None:
+        assert ts["admitted"] is not None, req.id      # token => was seated
+    chain = [ts["submitted"]]
+    for key in ("admitted", "first_token", "terminal"):
+        if ts[key] is not None:
+            chain.append(ts[key])
+    assert chain == sorted(chain), (req.id, ts)
+
+
+def test_slo_happy_path(served):
+    m, cfg, prompts = served
+    eng = _engine(m)
+    try:
+        reqs = [eng.submit(p, N_NEW) for p in prompts[:4]]
+        eng.run_until_idle(max_steps=500)
+        assert all(r.state == RequestState.DONE for r in reqs)
+        for r in reqs:
+            _assert_ordered_timestamps(r)
+            assert r.t_admitted is not None and r.t_first_token is not None
+        mets = eng.metrics()
+        slo = mets["slo"]
+        assert slo["ttft"]["count"] == 4
+        assert slo["e2e"]["count"] == 4
+        assert slo["queue_wait"]["count"] == 4
+        # N_NEW tokens each -> N_NEW-1 inter-token gaps each
+        assert slo["itl"]["count"] == 4 * (N_NEW - 1)
+        for h in slo.values():
+            assert h["p50"] <= h["p95"] <= h["p99"]
+        # TTFT >= queue wait for the same request population
+        assert slo["ttft"]["min"] >= slo["queue_wait"]["min"]
+        # the registry sees the SAME totals the metrics dict reports
+        lab = eng._engine_label
+        assert tm.registry().counter("serving_completed").value(**lab) == 4
+        assert mets["completed"] == 4 and isinstance(mets["completed"], int)
+    finally:
+        eng.close()
+
+
+def test_ttft_excludes_never_prefilled(served):
+    """TIMED_OUT-in-queue and CANCELLED-in-queue requests terminate with
+    submitted/terminal stamps only — the TTFT and queue-wait histograms
+    never see them, the e2e histogram does."""
+    m, cfg, prompts = served
+    eng = _engine(m)
+    try:
+        base = eng.metrics()["slo"]
+        dead = eng.submit(prompts[0], N_NEW, deadline_s=1e-4)
+        gone = eng.submit(prompts[1], N_NEW)
+        gone.cancel()
+        time.sleep(0.01)                               # expire the deadline
+        eng.step()                                     # boundary reap
+        assert dead.state == RequestState.TIMED_OUT
+        assert gone.state == RequestState.CANCELLED
+        for r in (dead, gone):
+            _assert_ordered_timestamps(r)
+            assert r.t_admitted is None and r.t_first_token is None
+        slo = eng.metrics()["slo"]
+        assert slo["ttft"]["count"] == base["ttft"]["count"]
+        assert slo["queue_wait"]["count"] == base["queue_wait"]["count"]
+        assert slo["e2e"]["count"] == base["e2e"]["count"] + 2
+    finally:
+        eng.close()
+
+
+def test_slo_counters_exact_across_rebuild(served):
+    """A persistent step crash forces recovery + rebuild mid-flight: the
+    implicated requests FAIL with ordered timestamps, survivors complete,
+    and the registry counters agree exactly with request states."""
+    m, cfg, prompts = served
+    eng = _engine(m)
+    try:
+        FaultInjector().inject("before_decode", at=1, times=2,
+                               kind="step_exception",
+                               state_intact=False).install(eng)
+        reqs = [eng.submit(p, N_NEW) for p in prompts[:4]]
+        eng.run_until_idle(max_steps=500)
+        mets = eng.metrics()
+        assert mets["recoveries"] == 1 and mets["rebuilds"] == 1
+        done = [r for r in reqs if r.state == RequestState.DONE]
+        failed = [r for r in reqs if r.state == RequestState.FAILED]
+        assert len(done) + len(failed) == 4 and failed
+        for r in reqs:
+            _assert_ordered_timestamps(r)
+        slo = mets["slo"]
+        assert slo["e2e"]["count"] == 4                # every terminal
+        # TTFT saw exactly the requests that produced a first token
+        assert slo["ttft"]["count"] == sum(
+            r.t_first_token is not None for r in reqs)
+        lab = eng._engine_label
+        reg = tm.registry()
+        assert reg.counter("serving_failed").value(**lab) == len(failed)
+        assert reg.counter("serving_completed").value(**lab) == len(done)
+        assert reg.counter("serving_rebuilds").value(**lab) == 1
+    finally:
+        eng.close()
+
+
+def test_slo_timestamps_under_random_fault_schedule(served):
+    """Property over a randomized fault schedule: EVERY request reaches a
+    typed terminal state with a complete, ordered timestamp set, and the
+    e2e histogram counts them all."""
+    m, cfg, prompts = served
+    rng = np.random.RandomState(7)
+    eng = _engine(m, num_slots=2)
+    try:
+        random_schedule(rng, horizon=20, n_faults=4,
+                        num_slots=2).install(eng)
+        reqs = [eng.submit(prompts[i % len(prompts)], N_NEW)
+                for i in range(6)]
+        eng.run_until_idle(max_steps=2000)
+        assert all(r.terminal for r in reqs)
+        for r in reqs:
+            _assert_ordered_timestamps(r)
+        slo = eng.metrics()["slo"]
+        assert slo["e2e"]["count"] == len(reqs)
+        assert slo["ttft"]["count"] == sum(
+            r.t_first_token is not None for r in reqs)
+        assert eng.allocator.used_pages == 0
+    finally:
+        eng.close()
+
+
+def test_step_phases_spanned(served):
+    """One engine step under an active tracer records the full phase
+    tree (plan/pack/dispatch/harvest/commit inside serve.step) plus the
+    compiled program's jit span."""
+    m, cfg, prompts = served
+    tt.disable()
+    tr = tt.enable(annotate=False)
+    try:
+        eng = _engine(m)
+        eng.submit(prompts[0], 2)
+        eng.run_until_idle(max_steps=200)
+        eng.close()
+        names = {s.name for s in tr.spans()}
+        assert {"serve.step", "serve.plan", "serve.pack", "serve.dispatch",
+                "serve.harvest", "serve.commit", "serve.device_step",
+                "jit.fused_step"} <= names
+    finally:
+        tt.disable()
+
+
+def test_engine_close_drops_registry_series(served):
+    """close() removes this engine's labeled series from the process
+    registry (engine churn must not grow the exposition forever), while
+    metrics() stays readable through the retained handles."""
+    m, cfg, prompts = served
+    eng = _engine(m)
+    eng.submit(prompts[0], 2)
+    eng.run_until_idle(max_steps=200)
+    lab = f'engine="{eng._engine_label["engine"]}"'
+    assert lab in tm.registry().prometheus_text()
+    mets_before = eng.metrics()
+    eng.close()
+    assert lab not in tm.registry().prometheus_text()
+    mets = eng.metrics()                               # handles still live
+    assert mets["completed"] == mets_before["completed"] == 1
+    assert mets["slo"]["ttft"]["count"] == 1
+
+
+def test_engine_metrics_dict_bit_compat(served):
+    """The metrics() surface keeps the plain-int dict contract from the
+    pre-registry era (BASELINE consumers read these keys raw)."""
+    m, cfg, prompts = served
+    eng = _engine(m)
+    try:
+        eng.submit(prompts[0], 2)
+        eng.run_until_idle(max_steps=200)
+        met = eng.step()                               # idle step
+        for key in ("failed", "cancelled", "timed_out", "shed",
+                    "recoveries", "active_slots", "queue_depth",
+                    "pages_used"):
+            assert isinstance(met[key], int), (key, type(met[key]))
+        mets = eng.metrics()
+        for key in ("steps", "tokens", "admitted", "completed",
+                    "fused_steps"):
+            assert isinstance(mets[key], int), (key, type(mets[key]))
+    finally:
+        eng.close()

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""Telemetry CI gate (run_tests.sh gate #6; PADDLE_TPU_SKIP_OBS_GATE=1
+skips).  Three checks, all CPU-fast:
+
+1. **Disabled-path overhead** — telemetry off must be near-free.  The
+   instrumented hot paths guard on ONE module-global read
+   (``trace._tracer is None``), so the gate measures (a) the cost of a
+   disabled ``span()`` call and (b) the cost of one compiled
+   ``to_static`` dispatch on the dispatch-micro-bench shapes, then
+   asserts a full serving step's worth of disabled call-sites costs
+   <3% of one dispatch.  An enabled-vs-disabled A/B of the same
+   dispatch loop is printed for reference (the <5% serving tokens/sec
+   bound is benched separately via serving_bench --chaos / ISSUE 9).
+
+2. **Trace validity** — a tiny serving run with tracing enabled must
+   export Chrome-trace JSON that (a) parses, (b) contains the serving
+   phase spans, and (c) nests plan/pack/dispatch/harvest/commit inside
+   their ``serve.step`` on the same thread row — the structure
+   chrome://tracing / Perfetto renders.
+
+3. **Prometheus exposition** — ``registry().prometheus_text()`` must
+   parse line-by-line (HELP/TYPE comments + ``name{labels} value``
+   samples), histogram bucket counts must be monotone in ``le`` with
+   the ``+Inf`` bucket equal to ``_count``, and the serving SLO
+   histograms must be present after the serving run.
+
+Exit codes: 0 ok, 1 any check failed.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import sys
+import tempfile
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import numpy as np  # noqa: E402
+
+#: span call-sites one serving step passes through (engine.step():
+#: serve.step/plan/pack/dispatch/device_step/harvest/commit) — reported
+#: so the step-level cost is visible next to the per-site gate
+_STEP_SPAN_SITES = 7
+
+#: the budget from docs/observability.md: ONE disabled telemetry
+#: call-site must cost under 3% of one compiled dispatch (the finest
+#: instrumented unit; a serving step is ~30x a dispatch and carries
+#: only _STEP_SPAN_SITES sites)
+_DISABLED_BUDGET = 0.03
+
+
+def check_overhead() -> dict:
+    import paddle_tpu as pt
+    from paddle_tpu.jit.api import to_static
+    from paddle_tpu.telemetry import trace
+
+    # the gate measures both arms itself — detach a PADDLE_TPU_TRACE=1
+    # import-time tracer rather than failing the developer's environment
+    if trace.active() is not None:
+        print("obs_gate: note: detaching the ambient tracer "
+              "(PADDLE_TPU_TRACE=1?) for the overhead A/B")
+        trace.disable()
+
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(64, 64).astype(np.float32))
+    w = pt.to_tensor(rng.randn(64, 64).astype(np.float32))
+    b = pt.to_tensor(rng.randn(64).astype(np.float32))
+
+    fn = to_static(lambda x, w, b: pt.add(pt.matmul(x, w), b))
+
+    def dispatch_loop(iters):
+        out = None
+        for _ in range(iters):
+            out = fn(x, w, b)
+        out._value.block_until_ready()
+
+    # -- per-call cost of the disabled span() no-op -----------------------
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with trace.span("obs_gate.noop"):
+            pass
+    span_ns = (time.perf_counter() - t0) / n * 1e9
+
+    # -- per-call cost of one compiled dispatch, telemetry OFF vs ON.
+    # Interleaved rounds + min-of-rounds per arm: this host's load is
+    # spiky enough that two sequential 2000-iter loops can differ 2x on
+    # noise alone; alternating short rounds and taking each arm's best
+    # round measures the machinery, not the neighbors. -------------------
+    dispatch_loop(200)                       # warmup: compile + caches
+    rounds, iters = 5, 500
+    off_best = on_best = math.inf
+    tr = None
+    try:
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            dispatch_loop(iters)
+            off_best = min(off_best, (time.perf_counter() - t0) / iters)
+            tr = trace.enable(capacity=4 * iters)
+            t0 = time.perf_counter()
+            dispatch_loop(iters)
+            on_best = min(on_best, (time.perf_counter() - t0) / iters)
+            trace.disable()
+        assert tr is not None and len(tr) > 0, \
+            "enabled tracer recorded no dispatch spans"
+    finally:
+        trace.disable()
+    off_us, on_us = off_best * 1e6, on_best * 1e6
+
+    frac = span_ns / 1e3 / off_us
+    res = {
+        "span_disabled_ns": round(span_ns, 1),
+        "dispatch_off_us": round(off_us, 2),
+        "dispatch_on_us": round(on_us, 2),
+        "enabled_overhead_pct": round((on_us / off_us - 1.0) * 100.0, 2),
+        "disabled_site_cost_pct": round(frac * 100.0, 3),
+        "disabled_step_cost_us": round(_STEP_SPAN_SITES * span_ns / 1e3, 2),
+    }
+    assert frac < _DISABLED_BUDGET, (
+        f"disabled telemetry too expensive: one span site costs "
+        f"{span_ns:.0f}ns = {frac * 100:.2f}% of one {off_us:.1f}us "
+        f"dispatch (budget {_DISABLED_BUDGET * 100:.0f}%)")
+    return res
+
+
+def _run_traced_engine():
+    """One tiny serving run with tracing enabled; returns (tracer,
+    engine metrics, prometheus exposition) — the exposition is captured
+    BEFORE close(), which drops the engine's series from the registry."""
+    import paddle_tpu as pt
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.telemetry import metrics, trace
+
+    pt.seed(0)
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    m = GPTForPretraining(cfg)
+    m.eval()
+    rng = np.random.RandomState(3)
+    tr = trace.enable()
+    try:
+        eng = ServingEngine(m, num_slots=2, page_size=16, max_context=64,
+                            cache_dtype="float32")
+        for s in (5, 11, 8):
+            eng.submit(rng.randint(0, cfg.vocab_size, (s,)), 4)
+        eng.run_until_idle(max_steps=500)
+        mets = eng.metrics()
+        text = metrics.registry().prometheus_text()
+        eng.close()
+    finally:
+        trace.disable()
+    return tr, mets, text
+
+
+_PHASES = ("serve.plan", "serve.pack", "serve.dispatch", "serve.harvest",
+           "serve.commit")
+
+
+def check_trace(tr) -> dict:
+    from paddle_tpu.telemetry import trace
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "trace.json")
+        trace.export_chrome_trace(path, tracer=tr)
+        with open(path) as f:
+            doc = json.load(f)
+
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events, "empty traceEvents"
+    comp = [e for e in events if e.get("ph") == "X"]
+    meta = [e for e in events if e.get("ph") == "M"]
+    for e in comp:
+        for k in ("name", "pid", "tid", "ts", "dur"):
+            assert k in e, f"complete event missing {k!r}: {e}"
+    assert meta, "no thread_name metadata events"
+
+    names = {e["name"] for e in comp}
+    missing = {"serve.step", *_PHASES} - names
+    assert not missing, f"serving-phase spans missing from trace: {missing}"
+
+    # nesting: every phase span must sit inside a serve.step interval on
+    # the SAME thread row (0.5us slack for ns->us float rounding)
+    steps = [e for e in comp if e["name"] == "serve.step"]
+    eps = 0.5
+    for e in (e for e in comp if e["name"] in _PHASES):
+        ok = any(s["tid"] == e["tid"]
+                 and s["ts"] - eps <= e["ts"]
+                 and e["ts"] + e["dur"] <= s["ts"] + s["dur"] + eps
+                 for s in steps)
+        assert ok, f"{e['name']} span not nested in any serve.step: {e}"
+    return {"events": len(events), "complete": len(comp),
+            "span_names": len(names), "steps": len(steps)}
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})? (-?(?:[0-9.]+(?:e[+-]?[0-9]+)?|inf|nan))$",
+    re.IGNORECASE)
+
+
+def check_prometheus(text: str) -> dict:
+    lines = [ln for ln in text.splitlines() if ln]
+    samples = 0
+    hist_series: dict = {}
+    counts: dict = {}
+    for ln in lines:
+        if ln.startswith("# HELP ") or ln.startswith("# TYPE "):
+            continue
+        m = _SAMPLE_RE.match(ln)
+        assert m, f"unparseable exposition line: {ln!r}"
+        samples += 1
+        name, labels, value = m.group(1), m.group(2) or "", m.group(3)
+        if name.endswith("_bucket"):
+            le = re.search(r'le="([^"]*)"', labels)
+            assert le, f"bucket line without le: {ln!r}"
+            rest = re.sub(r',?le="[^"]*"', "", labels)
+            if rest == "{}":
+                rest = ""
+            key = (name[:-len("_bucket")], rest)
+            bound = float("inf") if le.group(1) == "+Inf" else float(le.group(1))
+            hist_series.setdefault(key, []).append((bound, float(value)))
+        elif name.endswith("_count"):
+            counts[(name[:-len("_count")], labels)] = float(value)
+
+    assert samples, "empty Prometheus exposition"
+    assert hist_series, "no histogram series in exposition"
+    for (hname, labels), series in hist_series.items():
+        series.sort(key=lambda bv: bv[0])
+        cum = [v for _, v in series]
+        assert cum == sorted(cum), \
+            f"{hname}{labels}: bucket counts not monotone in le: {cum}"
+        assert series[-1][0] == float("inf"), f"{hname}{labels}: no +Inf bucket"
+        total = counts.get((hname, labels))
+        assert total == series[-1][1], (
+            f"{hname}{labels}: +Inf bucket {series[-1][1]} != _count {total}")
+
+    hist_names = {h for h, _ in hist_series}
+    for required in ("serving_ttft_seconds", "serving_e2e_seconds"):
+        assert required in hist_names, \
+            f"serving SLO histogram {required} missing from exposition"
+    return {"lines": len(lines), "samples": samples,
+            "histogram_series": len(hist_series)}
+
+
+def main() -> int:
+    checks = []
+
+    def run(name, fn, *a):
+        try:
+            res = fn(*a)
+            print(f"obs_gate: {name}: OK {json.dumps(res)}")
+            return res
+        except AssertionError as e:
+            print(f"obs_gate: {name}: FAIL {e}")
+            checks.append(name)
+            return None
+
+    run("overhead", check_overhead)
+    out = text = None
+    try:
+        tr, mets, text = _run_traced_engine()
+        slo = mets.get("slo", {})
+        if not slo.get("ttft", {}).get("count"):
+            print("obs_gate: engine: FAIL TTFT histogram empty after run")
+            checks.append("engine")
+        out = tr
+    except Exception as e:  # noqa: BLE001 — report and continue
+        print(f"obs_gate: engine: FAIL {type(e).__name__}: {e}")
+        checks.append("engine")
+    if out is not None:
+        run("chrome_trace", check_trace, out)
+    if text is not None:
+        run("prometheus", check_prometheus, text)
+
+    if checks:
+        print(f"obs_gate: FAILED: {checks}")
+        return 1
+    print("obs_gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,17 @@ Sweep mode (default): drives the ServingEngine at increasing offered load
 
   {"metric": "serving_sweep", "offered_load": ..., "tokens_per_sec": ...,
    "mean_occupancy": ..., "mean_queue_depth": ..., "completed": ...,
-   "grid_occupancy": ..., "q_row_occupancy": ..., "steps": ...}
+   "grid_occupancy": ..., "q_row_occupancy": ..., "steps": ...,
+   "ttft_ms_p50/p95/p99": ..., "itl_ms_p50/p95/p99": ...,
+   "queue_wait_ms_p50": ...}
+
+The SLO keys come from the engine's per-request telemetry histograms
+(TTFT = submission -> first token, queue included; ITL = gap between
+consecutive tokens of one request; docs/observability.md) — each load
+level runs a FRESH engine so the percentiles are per-level, not
+cumulative.  The warmup request's single compile-dominated TTFT sample
+is included; at >= 8 requests per level it sits above p95 only for the
+lowest loads.
 
 tokens/sec should rise with load until the slots saturate, then flatten
 while queue depth grows — the continuous-batching signature.  Runs on the
@@ -84,6 +94,25 @@ def _build(on_tpu: bool):
     return model, cfg, serving_kw, prompt_lens, max_new
 
 
+def _slo_keys(mets: dict) -> dict:
+    """Flatten an engine's metrics()["slo"] histograms into the sweep
+    line's millisecond keys (TTFT/ITL p50/p95/p99 + queue-wait p50)."""
+    slo = mets.get("slo", {})
+
+    def ms(h, q):
+        return round(h.get(q, 0.0) * 1000.0, 2)
+
+    tt, it = slo.get("ttft", {}), slo.get("itl", {})
+    qw = slo.get("queue_wait", {})
+    return {
+        "ttft_ms_p50": ms(tt, "p50"), "ttft_ms_p95": ms(tt, "p95"),
+        "ttft_ms_p99": ms(tt, "p99"), "ttft_count": int(tt.get("count", 0)),
+        "itl_ms_p50": ms(it, "p50"), "itl_ms_p95": ms(it, "p95"),
+        "itl_ms_p99": ms(it, "p99"),
+        "queue_wait_ms_p50": ms(qw, "p50"),
+    }
+
+
 def _prompt_lengths(dist: str, n: int, fixed_cycle, max_prompt: int,
                     rng) -> list:
     """Per-request prompt lengths: the historical fixed cycle, or a
@@ -157,6 +186,7 @@ def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24,
             "completed": sum(r.finished for r in reqs),
             "steps": steps,
             "platform": "tpu" if on_tpu else "cpu",
+            **_slo_keys(mets),
         }))
         sys.stdout.flush()
         eng.close()
@@ -240,10 +270,13 @@ def gate() -> int:
     return 0
 
 
-def chaos(n_requests: int = 36) -> int:
+def chaos(n_requests: int = 36, lengths: str = "fixed") -> int:
     """Three offered-load phases through ONE engine — healthy, fault
     storm, recovered — asserting throughput degrades gracefully under the
-    storm and RECOVERS after it, with exact page accounting throughout."""
+    storm and RECOVERS after it, with exact page accounting throughout.
+    ``--lengths zipf`` draws each phase's prompt lengths from the bounded
+    Zipf long-tail, the regime where the SLO histograms must stay
+    populated THROUGH the storm (ISSUE-9 acceptance)."""
     import time as _time
 
     import jax
@@ -255,13 +288,15 @@ def chaos(n_requests: int = 36) -> int:
     kw = dict(kw, stall_budget_s=2.0 if not on_tpu else 10.0)
     rng = np.random.RandomState(0)
     per_phase = max(n_requests // 3, 8)
+    max_prompt = kw["max_context"] - max_new
     eng = ServingEngine(model, **kw)
     eng.submit(rng.randint(0, cfg.vocab_size, (prompt_lens[0],)), 2)
     eng.run_until_idle()                         # warmup compiles
 
     def run_phase(label):
-        prompts = [rng.randint(0, cfg.vocab_size,
-                               (prompt_lens[i % len(prompt_lens)],))
+        plens = _prompt_lengths(lengths, per_phase, prompt_lens,
+                                max_prompt, rng)
+        prompts = [rng.randint(0, cfg.vocab_size, (plens[i],))
                    for i in range(per_phase)]
         reqs, it, steps = [], iter(prompts), 0
         t0 = _time.perf_counter()
@@ -285,13 +320,17 @@ def chaos(n_requests: int = 36) -> int:
         mets = eng.metrics()
         rate = toks / dt if dt > 0 else 0.0
         print(json.dumps({
-            "metric": "serving_chaos", "window": label,
+            "metric": "serving_chaos", "window": label, "lengths": lengths,
             "tokens_per_sec": round(rate, 1), "seconds": round(dt, 3),
             "completed": sum(r.state == RequestState.DONE for r in reqs),
             "requests": len(reqs),
             "recoveries": mets["recoveries"], "failed": mets["failed"],
             "quarantined": mets["quarantined"],
             "platform": "tpu" if on_tpu else "cpu",
+            # SLO percentiles are CUMULATIVE across the three windows
+            # (one engine, one histogram set) — the storm's tail shows
+            # up as the before->during p99 jump
+            **_slo_keys(mets),
         }))
         sys.stdout.flush()
         if not all(r.terminal for r in reqs):
@@ -362,7 +401,7 @@ def main() -> int:
         return gate()
     if args.chaos:
         return chaos(max(args.requests, 36) if args.requests != 24
-                     else 36)
+                     else 36, lengths=args.lengths)
     return sweep(tuple(float(x) for x in args.loads.split(",")),
                  args.requests, lengths=args.lengths)
 

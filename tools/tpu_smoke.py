@@ -375,6 +375,60 @@ def main() -> int:
             loaded = autotune.load_table(path, strict=True)
             assert loaded.get(kernel, shape, "bfloat16") == winner
 
+    # -- telemetry: ONE on-chip fused serving step captured with host
+    # spans nesting jax.profiler TraceAnnotations while a REAL device
+    # trace is recording — the host/device alignment path that CPU runs
+    # can only no-op through -------------------------------------------------
+    def telemetry():
+        import json as _json
+        import os as _os
+        import tempfile
+
+        import paddle_tpu as pt
+        from paddle_tpu.models import GPTForPretraining, gpt_tiny
+        from paddle_tpu.serving import ServingEngine
+        from paddle_tpu.telemetry import trace
+
+        pt.seed(0)
+        cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+        m = GPTForPretraining(cfg)
+        m.eval()
+        trng = np.random.RandomState(9)
+        eng = ServingEngine(m, num_slots=2, page_size=128, max_context=128,
+                            cache_dtype="bfloat16")
+        # warmup OUTSIDE the capture: compile is not the measurement
+        eng.submit(trng.randint(0, cfg.vocab_size, (6,)), 2)
+        eng.run_until_idle(max_steps=200)
+        tr = trace.enable()
+        try:
+            assert tr.annotate and tr._ann_cls is not None, \
+                "TraceAnnotation unavailable: host/device alignment dead"
+            with tempfile.TemporaryDirectory() as td:
+                jax.profiler.start_trace(td)
+                try:
+                    req = eng.submit(
+                        trng.randint(0, cfg.vocab_size, (9,)), 3)
+                    eng.run_until_idle(max_steps=200)
+                finally:
+                    jax.profiler.stop_trace()
+                assert req.finished, req.state
+                # the device capture actually wrote an xplane artifact
+                arts = [f for root, _, fs in _os.walk(td)
+                        for f in fs if f.endswith(".xplane.pb")]
+                assert arts, "device trace capture produced no xplane"
+                path = _os.path.join(td, "host.json")
+                trace.export_chrome_trace(path, tracer=tr)
+                with open(path) as f:
+                    doc = _json.load(f)
+            names = {e["name"] for e in doc["traceEvents"]
+                     if e.get("ph") == "X"}
+            need = {"serve.step", "serve.dispatch", "serve.device_step",
+                    "jit.fused_step"}
+            assert need <= names, f"missing host spans: {need - names}"
+        finally:
+            trace.disable()
+        eng.close()
+
     check("flash_attention", flash)
     check("decode_attention", decode_attention)
     check("paged_attention", paged_attention)
@@ -385,6 +439,7 @@ def main() -> int:
     check("checkpoint", checkpoint)
     check("serving_faults", serving_faults)
     check("autotune_sweep", autotune_sweep)
+    check("telemetry", telemetry)
 
     if failures:
         print(f"tpu_smoke: FAILED: {failures}")
