@@ -327,6 +327,21 @@ class CheckpointManager:
         return tree, info.manifest
 
     # -- retention -------------------------------------------------------
+    def prune_newer_than(self, step: int):
+        """Drop every committed checkpoint with step > ``step``.
+
+        Elastic rollback support (docs/distributed_faults.md): after the
+        members agree to resume from ``step``, any newer checkpoint on
+        disk belongs to the ABANDONED timeline — leaving it would make a
+        later ``latest()`` (or a later recovery's resume exchange) offer
+        state the new timeline never produced."""
+        self.wait()
+        for name in self._committed_dirs():
+            if self._step_of(name) > int(step):
+                shutil.rmtree(os.path.join(self._dir, name),
+                              ignore_errors=True)
+                self._valid_cache.pop(name, None)
+
     def _gc(self):
         """Keep the newest ``keep_last_k`` VALID checkpoints; drop older
         valid ones and any invalid committed garbage.  keep>=1 means the

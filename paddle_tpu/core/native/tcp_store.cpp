@@ -11,6 +11,7 @@
 //   response: u32 vlen | value bytes   (vlen == 0xFFFFFFFF => not found)
 // Ops: 0=SET 1=GET(blocking-wait) 2=ADD(returns new i64) 3=CHECK 4=DELETE
 //      5=WAIT(value = i64 timeout_ms; returns u8 1=found 0=timeout)
+//      6=LIST(key = prefix; resp = u32 count | (u32 klen | key bytes)*)
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
@@ -139,6 +140,20 @@ void serve_client(Store* st, int fd) {
         const auto& v = st->data[key];
         resp.insert(resp.end(), v.begin(), v.end());
       }
+    } else if (op == 6) {  // LIST keys with prefix (generation sweeps +
+      // the fault gate's key accounting; non-blocking)
+      std::lock_guard<std::mutex> lk(st->mu);
+      uint32_t count = 0;
+      resp.resize(4);
+      for (const auto& kv : st->data) {
+        if (kv.first.compare(0, key.size(), key) != 0) continue;
+        ++count;
+        uint32_t klen2 = static_cast<uint32_t>(kv.first.size());
+        const uint8_t* p = reinterpret_cast<const uint8_t*>(&klen2);
+        resp.insert(resp.end(), p, p + 4);
+        resp.insert(resp.end(), kv.first.begin(), kv.first.end());
+      }
+      std::memcpy(resp.data(), &count, 4);
     } else {
       break;
     }
@@ -336,6 +351,14 @@ int tcp_store_wait(int fd, const char* key, int64_t timeout_ms, uint8_t** out,
   }
   ::free(resp);
   return found;
+}
+
+// Non-blocking key listing: *out is the raw framed response
+// (u32 count | (u32 klen | key bytes)*), parsed by the python surface.
+int tcp_store_list(int fd, const char* prefix, uint8_t** out,
+                   uint32_t* out_len) {
+  return request(fd, 6, prefix, static_cast<uint32_t>(strlen(prefix)), nullptr,
+                 0, out, out_len);
 }
 
 int tcp_store_check(int fd, const char* key) {

@@ -5,18 +5,63 @@ single-host tests hermetic).
 
 All retry/wait deadlines use ``time.monotonic()`` — an NTP step or
 wall-clock jump must neither hang a bounded wait nor expire it
-instantly (same discipline as serving/engine.py's deadlines)."""
+instantly (same discipline as serving/engine.py's deadlines).
+
+Fault model (docs/distributed_faults.md): every op retries transient
+transport failures with bounded jittered backoff (reconnecting between
+attempts) and raises the *typed* :class:`StoreUnavailableError` once
+the budget is spent — never a bare ``RuntimeError``.  Timeouts raise
+``TimeoutError`` with the same message on the local and remote paths.
+An installed fault hook (``paddle_tpu.faults.FaultInjector.install``)
+fires at the ``store_op`` point before every attempt, so injected
+transient faults exercise the same retry path real outages hit.
+"""
 from __future__ import annotations
 
 import ctypes
-import socket
+import os
+import random
 import threading
 import time
-from typing import Optional
+from typing import Callable, List, Optional
 
 from .build import load_native
 
-__all__ = ["TCPStore"]
+__all__ = ["TCPStore", "StoreUnavailableError"]
+
+
+class StoreUnavailableError(RuntimeError):
+    """A store op kept failing after the bounded retry budget.
+
+    Defined here (not in distributed/errors.py) because the store layer
+    owns transport failures; the distributed taxonomy re-exports it."""
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _note_retry():
+    """Count a transient-failure retry on the telemetry registry (best
+    effort — the core layer must not hard-depend on telemetry)."""
+    try:
+        from ...telemetry.metrics import registry
+
+        registry().counter(
+            "dist_store_retry_total",
+            help="transient TCPStore op failures absorbed by retry").inc()
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _lib():
@@ -42,6 +87,10 @@ def _lib():
     lib.tcp_store_wait.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int64,
                                    ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
                                    ctypes.POINTER(ctypes.c_uint32)]
+    lib.tcp_store_list.restype = ctypes.c_int
+    lib.tcp_store_list.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                   ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                                   ctypes.POINTER(ctypes.c_uint32)]
     lib.tcp_store_server_port.restype = ctypes.c_uint16
     lib.tcp_store_server_port.argtypes = [ctypes.c_void_p]
     lib.tcp_store_check.argtypes = [ctypes.c_int, ctypes.c_char_p]
@@ -63,6 +112,9 @@ class TCPStore:
         # concurrent callers (elastic heartbeat + watcher threads) must
         # serialize or responses interleave and both block
         self._io_lock = threading.Lock()
+        # test-only fault injection at the 'store_op' point (see
+        # paddle_tpu/faults.py; same discipline as serving/engine.py)
+        self._fault_hook: Optional[Callable] = None
         self.host, self.port = host, port
         if self._lib is None:
             # pure-python single-process fallback
@@ -84,121 +136,228 @@ class TCPStore:
                 raise TimeoutError(f"TCPStore: cannot connect {host}:{port}")
             time.sleep(0.05)
 
+    # -- transient-failure machinery ---------------------------------------
+    def _reconnect(self):
+        """Drop the (presumed dead) connection and dial again once; a
+        failed dial leaves fd=-1 so the next attempt fails fast and the
+        retry loop keeps backing off."""
+        if self._lib is None:
+            return
+        with self._io_lock:
+            try:
+                if self._fd is not None and self._fd >= 0:
+                    self._lib.tcp_store_close(self._fd)
+            except Exception:  # noqa: BLE001
+                pass
+            self._fd = self._lib.tcp_store_connect(
+                self.host.encode(), ctypes.c_uint16(self.port))
+
+    def _retrying(self, opname: str, key: str, attempt: Callable):
+        """Run ``attempt`` with bounded jittered-backoff retry of
+        transient failures.  Timeouts and already-typed store errors pass
+        through; anything else (transport error, injected fault) burns a
+        retry, reconnects, and ultimately escalates to the typed
+        StoreUnavailableError."""
+        retries = _env_int("PADDLE_STORE_RETRIES", 3)
+        backoff = _env_float("PADDLE_STORE_BACKOFF", 0.05)
+        last: Optional[BaseException] = None
+        for i in range(retries + 1):
+            try:
+                if self._fault_hook is not None:
+                    self._fault_hook("store_op", {"op": opname, "key": key})
+                return attempt()
+            except TimeoutError:
+                raise
+            except StoreUnavailableError:
+                raise
+            except Exception as e:  # noqa: BLE001 — transport or injected
+                last = e
+                if i >= retries:
+                    break
+                _note_retry()
+                time.sleep(backoff * (2 ** i) * (0.5 + random.random()))
+                if self._local is None:
+                    self._reconnect()
+        raise StoreUnavailableError(
+            f"TCPStore.{opname} failed for key {key!r} after "
+            f"{retries + 1} attempts: {last!r}") from last
+
     # -- KV ----------------------------------------------------------------
     def set(self, key: str, value: bytes):
-        if self._local is not None:
-            with self._lock:
-                self._local[key] = bytes(value)
-            return
-        buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value) if value else None
-        with self._io_lock:
-            rc = self._lib.tcp_store_set(self._fd, key.encode(), buf, len(value))
-        if rc != 0:
-            raise RuntimeError("TCPStore.set failed")
-
-    def get(self, key: str) -> bytes:
-        if self._local is not None:
-            deadline = time.monotonic() + 60
-            while True:
+        def attempt():
+            if self._local is not None:
                 with self._lock:
-                    if key in self._local:
-                        return self._local[key]
-                if time.monotonic() > deadline:
-                    raise TimeoutError(f"key {key} never set")
-                time.sleep(0.01)
-        out = ctypes.POINTER(ctypes.c_uint8)()
-        olen = ctypes.c_uint32()
-        with self._io_lock:
-            rc = self._lib.tcp_store_get(self._fd, key.encode(),
-                                         ctypes.byref(out), ctypes.byref(olen))
-        if rc != 0:
-            raise RuntimeError("TCPStore.get failed")
-        data = ctypes.string_at(out, olen.value) if olen.value else b""
-        if olen.value:
-            self._lib.tcp_store_free(out)
-        return data
+                    self._local[key] = bytes(value)
+                return
+            buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value) if value else None
+            with self._io_lock:
+                rc = self._lib.tcp_store_set(self._fd, key.encode(), buf, len(value))
+            if rc != 0:
+                raise RuntimeError("tcp_store_set transport failure")
+        return self._retrying("set", key, attempt)
+
+    def get(self, key: str, timeout: float = 60.0) -> bytes:
+        """Block until ``key`` exists (up to ``timeout`` seconds) and
+        return its value — one consistent timeout knob and TimeoutError
+        message on BOTH the local and remote paths (both ride wait())."""
+        return self.wait(key, timeout=timeout)
 
     def add(self, key: str, delta: int = 1) -> int:
-        if self._local is not None:
-            with self._lock:
-                cur = int.from_bytes(self._local.get(key, b"\0" * 8), "little", signed=True)
-                cur += delta
-                self._local[key] = cur.to_bytes(8, "little", signed=True)
-                return cur
-        result = ctypes.c_int64()
-        with self._io_lock:
-            rc = self._lib.tcp_store_add(self._fd, key.encode(), delta,
-                                         ctypes.byref(result))
-        if rc != 0:
-            raise RuntimeError("TCPStore.add failed")
-        return int(result.value)
+        def attempt():
+            if self._local is not None:
+                with self._lock:
+                    cur = int.from_bytes(self._local.get(key, b"\0" * 8), "little", signed=True)
+                    cur += delta
+                    self._local[key] = cur.to_bytes(8, "little", signed=True)
+                    return cur
+            result = ctypes.c_int64()
+            with self._io_lock:
+                rc = self._lib.tcp_store_add(self._fd, key.encode(), delta,
+                                             ctypes.byref(result))
+            if rc != 0:
+                raise RuntimeError("tcp_store_add transport failure")
+            return int(result.value)
+        return self._retrying("add", key, attempt)
 
     def delete(self, key: str):
         """Remove a key (server op 4) — used by consumers (e.g. cross-host
         recv) so long-running jobs don't grow the master store unboundedly."""
-        if self._local is not None:
-            with self._lock:
-                self._local.pop(key, None)
-            return
-        with self._io_lock:
-            rc = self._lib.tcp_store_delete(self._fd, key.encode())
-        if rc != 0:
-            raise RuntimeError("TCPStore.delete failed")
+        def attempt():
+            if self._local is not None:
+                with self._lock:
+                    self._local.pop(key, None)
+                return
+            with self._io_lock:
+                rc = self._lib.tcp_store_delete(self._fd, key.encode())
+            if rc != 0:
+                raise RuntimeError("tcp_store_delete transport failure")
+        return self._retrying("delete", key, attempt)
 
     def check(self, key: str) -> bool:
-        if self._local is not None:
-            with self._lock:
-                return key in self._local
-        with self._io_lock:
-            return self._lib.tcp_store_check(self._fd, key.encode()) == 1
+        def attempt():
+            if self._local is not None:
+                with self._lock:
+                    return key in self._local
+            with self._io_lock:
+                rc = self._lib.tcp_store_check(self._fd, key.encode())
+            if rc < 0:
+                raise RuntimeError("tcp_store_check transport failure")
+            return rc == 1
+        return self._retrying("check", key, attempt)
+
+    def keys(self, prefix: str = "") -> List[str]:
+        """All live keys starting with ``prefix`` (server op 6) — the
+        generation sweep and the fault gate's exact key accounting."""
+        def attempt():
+            if self._local is not None:
+                with self._lock:
+                    return sorted(k for k in self._local if k.startswith(prefix))
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            olen = ctypes.c_uint32()
+            with self._io_lock:
+                rc = self._lib.tcp_store_list(self._fd, prefix.encode(),
+                                              ctypes.byref(out), ctypes.byref(olen))
+            if rc != 0 or olen.value < 4:
+                raise RuntimeError("tcp_store_list transport failure")
+            raw = ctypes.string_at(out, olen.value)
+            self._lib.tcp_store_free(out)
+            count = int.from_bytes(raw[:4], "little")
+            names, off = [], 4
+            for _ in range(count):
+                klen = int.from_bytes(raw[off:off + 4], "little")
+                off += 4
+                names.append(raw[off:off + klen].decode())
+                off += klen
+            return names
+        return self._retrying("keys", prefix, attempt)
+
+    def num_keys(self, prefix: str = "") -> int:
+        return len(self.keys(prefix))
 
     def wait(self, key: str, timeout: float = 60.0) -> bytes:
         """Block until ``key`` exists (up to ``timeout`` seconds), then return
         its value. Raises TimeoutError if the key never arrives."""
-        if self._local is not None:
-            deadline = time.monotonic() + timeout
-            while True:
-                with self._lock:
-                    if key in self._local:
-                        return self._local[key]
-                if time.monotonic() > deadline:
-                    raise TimeoutError(f"TCPStore.wait: key {key!r} not set "
-                                       f"within {timeout}s")
-                time.sleep(0.01)
-        # A single long server-side wait would hold _io_lock for the whole
-        # blocking period (up to an hour for p2p), starving every other
-        # thread on this store — e.g. the elastic heartbeat, whose missed
-        # beats would look like a dead node.  Poll with SHORT server-side
-        # waits instead, releasing the lock between polls.
         deadline = time.monotonic() + timeout
-        while True:
-            slice_ms = int(min(0.2, max(0.0, deadline - time.monotonic())) * 1000)
-            out = ctypes.POINTER(ctypes.c_uint8)()
-            olen = ctypes.c_uint32()
-            with self._io_lock:
-                rc = self._lib.tcp_store_wait(self._fd, key.encode(),
-                                              ctypes.c_int64(slice_ms),
-                                              ctypes.byref(out), ctypes.byref(olen))
-            if rc < 0:
-                raise RuntimeError("TCPStore.wait failed")
-            if rc > 0:
-                data = ctypes.string_at(out, olen.value) if olen.value else b""
-                if olen.value:
-                    self._lib.tcp_store_free(out)
-                return data
-            if time.monotonic() >= deadline:
-                raise TimeoutError(f"TCPStore.wait: key {key!r} not set within "
-                                   f"{timeout}s")
 
-    def barrier(self, name: str, world_size: int, timeout: float = 60.0):
-        """Counter barrier: every rank adds 1 then waits for world_size."""
-        n = self.add(f"__barrier__/{name}", 1)
-        deadline = time.monotonic() + timeout
-        while n < world_size:
-            time.sleep(0.02)
-            n = self.add(f"__barrier__/{name}", 0)
-            if time.monotonic() > deadline:
-                raise TimeoutError(f"barrier {name}: {n}/{world_size}")
+        def timed_out():
+            raise TimeoutError(f"TCPStore.wait: key {key!r} not set within "
+                               f"{timeout}s")
+
+        def attempt():
+            if self._local is not None:
+                while True:
+                    with self._lock:
+                        if key in self._local:
+                            return self._local[key]
+                    if time.monotonic() > deadline:
+                        timed_out()
+                    time.sleep(0.01)
+            # A single long server-side wait would hold _io_lock for the whole
+            # blocking period (up to an hour for p2p), starving every other
+            # thread on this store — e.g. the elastic heartbeat, whose missed
+            # beats would look like a dead node.  Poll with SHORT server-side
+            # waits instead, releasing the lock between polls.
+            while True:
+                slice_ms = int(min(0.2, max(0.0, deadline - time.monotonic())) * 1000)
+                out = ctypes.POINTER(ctypes.c_uint8)()
+                olen = ctypes.c_uint32()
+                with self._io_lock:
+                    rc = self._lib.tcp_store_wait(self._fd, key.encode(),
+                                                  ctypes.c_int64(slice_ms),
+                                                  ctypes.byref(out), ctypes.byref(olen))
+                if rc < 0:
+                    raise RuntimeError("tcp_store_wait transport failure")
+                if rc > 0:
+                    data = ctypes.string_at(out, olen.value) if olen.value else b""
+                    if olen.value:
+                        self._lib.tcp_store_free(out)
+                    return data
+                if time.monotonic() >= deadline:
+                    timed_out()
+        return self._retrying("wait", key, attempt)
+
+    def barrier(self, name: str, world_size: int, timeout: float = 60.0,
+                *, sweep: bool = True, wait_fn: Optional[Callable] = None):
+        """Two-phase counter barrier that CLEANS UP after itself: every
+        rank bumps an arrival counter; the last arrival publishes a
+        ``done`` sentinel everyone else waits on (no re-add spinning);
+        departures are counted too, and the last rank to leave deletes
+        all three keys — a satisfied barrier leaves zero store keys.
+
+        ``sweep=False`` keeps the keys: a later arrival under the same
+        name (e.g. an elastic-restarted rank re-running the bring-up
+        barrier) then passes instantly instead of hanging on a fresh
+        counter.  Names must be round-unique when sweep=True.
+
+        ``wait_fn(key, timeout)`` overrides the done-wait so callers can
+        interleave failure-detector checks.
+
+        Caveat: the arrival counter rides ``add``, which is NOT
+        idempotent under a lost-response retry — a reconnect-retried
+        arrival can double-count and release the barrier one rank
+        early.  Fine for the best-effort bring-up barriers this serves;
+        the collectives use ``fault_tolerance.ft_barrier`` (per-rank
+        SET keys, fully retry-safe) instead.
+        """
+        base = f"__barrier__/{name}"
+        n = self.add(f"{base}/cnt", 1)
+        if n >= world_size:
+            self.set(f"{base}/done", b"1")
+        else:
+            try:
+                (wait_fn or self.wait)(f"{base}/done", timeout)
+            except TimeoutError as e:
+                cur = self.add(f"{base}/cnt", 0)
+                # preserve the waiter's exception TYPE: a detector-aware
+                # wait_fn raises the richer CollectiveTimeoutError and a
+                # caller catching that must still see it
+                raise type(e)(
+                    f"barrier {name}: {cur}/{world_size} after "
+                    f"{timeout}s") from e
+        if sweep:
+            if self.add(f"{base}/left", 1) >= world_size:
+                for sfx in ("cnt", "done", "left"):
+                    self.delete(f"{base}/{sfx}")
 
     def __del__(self):
         try:

@@ -33,3 +33,12 @@ from .collective import (  # noqa: F401
     destroy_process_group, gather, scatter_object_list, wait,
 )
 from .auto_parallel.api import reshard  # noqa: F401
+from . import fault_tolerance  # noqa: F401
+from .errors import (  # noqa: F401
+    CollectiveTimeoutError,
+    DistributedError,
+    PeerLostError,
+    RendezvousInvalidated,
+    StoreUnavailableError,
+)
+from .fleet.elastic import ElasticRunResult, run_elastic  # noqa: F401

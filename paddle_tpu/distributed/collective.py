@@ -10,6 +10,7 @@ collectives are identities, matching the single-controller SPMD model.
 """
 from __future__ import annotations
 
+import time
 from typing import List, Optional
 
 import jax
@@ -254,11 +255,13 @@ def send(tensor, dst=0, group=None, sync_op=True):
     ax = _axis(group)
     if not _in_mapped_context(ax):
         if _cross_host():
+            from . import fault_tolerance as _ft
             from .env import get_rank, get_store
 
             seq = _P2P_SEQ.setdefault(("s", get_rank(), dst), [0])
-            get_store().set(f"p2p/{get_rank()}->{dst}/{seq[0]}",
-                            _p2p_pack(tensor._value))
+            get_store().set(
+                f"{_ft.key_prefix()}/p2p/{get_rank()}->{dst}/{seq[0]}",
+                _p2p_pack(tensor._value))
             seq[0] += 1
             return None
         _P2P_STAGE.append(tensor)
@@ -273,18 +276,19 @@ def recv(tensor, src=0, group=None, sync_op=True):
     ax = _axis(group)
     if not _in_mapped_context(ax):
         if _cross_host():
+            from . import fault_tolerance as _ft
             from .env import get_rank, get_store
 
             if src is None:
                 raise ValueError("multi-host recv requires an explicit src")
             seq = _P2P_SEQ.setdefault(("r", src, get_rank()), [0])
-            key = f"p2p/{src}->{get_rank()}/{seq[0]}"
+            key = f"{_ft.key_prefix()}/p2p/{src}->{get_rank()}/{seq[0]}"
             # the matching send may be far behind (XLA compiles routinely
-            # exceed a minute) — block like the reference's recv does
-            import os as _os
-
-            timeout = float(_os.environ.get("PADDLE_P2P_TIMEOUT", "3600"))
-            blob = get_store().wait(key, timeout=timeout)
+            # exceed a minute) — block like the reference's recv does, BUT
+            # interleave failure detection: a dead sender is a typed
+            # PeerLostError within ~2x TTL, not a 3600 s hang
+            blob = _ft.wait_for_key(get_store(), key, _obj_timeout(),
+                                    pending=(src,), what=f"recv(src={src})")
             get_store().delete(key)  # bound the master store's memory
             seq[0] += 1
             import jax.numpy as _jnp
@@ -318,10 +322,17 @@ def barrier(group=None):
     ax = _axis(group)
     if not _in_mapped_context(ax):
         if _cross_host():
-            from .env import get_store, get_world_size as _ws
+            from . import fault_tolerance as _ft
+            from .env import get_rank, get_store, get_world_size as _ws
 
             _BARRIER_SEQ[0] += 1
-            get_store().barrier(f"coll_barrier/{_BARRIER_SEQ[0]}", _ws())
+            mem = _ft.members(_ws())
+            t0 = time.perf_counter()
+            _ft.ft_barrier(
+                get_store(),
+                f"{_ft.key_prefix()}/coll_barrier/{_BARRIER_SEQ[0]}",
+                mem, get_rank(), _obj_timeout())
+            _ft.observe_latency("barrier", time.perf_counter() - t0)
             return
         jax.block_until_ready(jnp.zeros(()))
         return
@@ -372,27 +383,30 @@ def _require_store(ws):
 
 
 def _store_exchange(obj, tag: str):
-    """Every rank posts its object; returns the list by rank.  Keys are
-    deleted after a completion barrier so the rank-0 store's memory
-    stays bounded over long jobs (same discipline as recv())."""
+    """Every rank posts its object; returns the list by member rank.
+    Keys are generation-namespaced (``g<gen>/obj/<tag>/<seq>/<rank>``) —
+    a restarted rank's reset sequence counter lands in a NEW generation's
+    namespace, so it can never read another generation's payloads.  The
+    waits are failure-detector-aware (typed PeerLostError inside the
+    detector TTL) and the payload + completion-barrier keys are all
+    deleted after the exchange, so the rank-0 store's key count stays
+    exactly bounded over long jobs."""
+    from . import fault_tolerance as _ft
     from .env import get_rank, get_world_size
 
     ws = get_world_size()
     if ws <= 1:
         return [obj]
     store = _require_store(ws)
+    rank = get_rank()
+    mem = _ft.members(ws)
     _OBJ_SEQ[0] += 1
-    base = f"obj/{tag}/{_OBJ_SEQ[0]}"
-    store.set(f"{base}/{get_rank()}", _obj_pack(obj))
-    out = []
-    for r in range(ws):
-        out.append(_obj_unpack(store.wait(f"{base}/{r}",
-                                          timeout=_obj_timeout())))
-    store.barrier(f"{base}/done", ws, timeout=_obj_timeout())
-    if get_rank() == 0:
-        for r in range(ws):
-            store.delete(f"{base}/{r}")
-    return out
+    base = f"{_ft.key_prefix()}/obj/{tag}/{_OBJ_SEQ[0]}"
+    t0 = time.perf_counter()
+    blobs = _ft.exchange(store, base, rank, mem, _obj_pack(obj),
+                         _obj_timeout(), what=f"all_gather_object[{tag}]")
+    _ft.observe_latency(tag, time.perf_counter() - t0)
+    return [_obj_unpack(b) for b in blobs]
 
 
 def all_gather_object(object_list, obj, group=None):
@@ -404,25 +418,44 @@ def broadcast_object_list(object_list, src=0, group=None):
     """Single-key form: only src serializes/uploads; everyone else
     downloads that one key (O(payload), and non-src placeholder lists
     are never pickled)."""
+    from . import fault_tolerance as _ft
     from .env import get_rank, get_world_size
 
     ws = get_world_size()
     if ws <= 1:
         return object_list
     store = _require_store(ws)
+    rank = get_rank()
+    mem = _ft.members(ws)
+    if src not in mem:
+        from .errors import PeerLostError
+
+        raise PeerLostError([src], what="broadcast_object_list(src)")
     _OBJ_SEQ[0] += 1
-    base = f"obj/bc/{_OBJ_SEQ[0]}"
-    if get_rank() == src:
+    base = f"{_ft.key_prefix()}/obj/bc/{_OBJ_SEQ[0]}"
+    t0 = time.perf_counter()
+    _ft.hook("exchange", {"base": base, "rank": rank, "what": "broadcast"})
+    if rank == src:
         store.set(base, _obj_pack(list(object_list)))
-    object_list[:] = _obj_unpack(store.wait(base, timeout=_obj_timeout()))
-    store.barrier(f"{base}/done", ws, timeout=_obj_timeout())
-    if get_rank() == src:
+    object_list[:] = _obj_unpack(_ft.wait_for_key(
+        store, base, _obj_timeout(), pending=(src,),
+        what="broadcast_object_list"))
+    _ft.ft_barrier(store, f"{base}/done", mem, rank, _obj_timeout())
+    if rank == src:
         store.delete(base)
+    _ft.observe_latency("bc", time.perf_counter() - t0)
     return object_list
 
 
 def scatter_object_list(out_object_list, in_object_list=None, src=0,
                         group=None):
+    """Each member receives its element of ``in_object_list``, which is
+    indexed by MEMBER position: entry i goes to ``members[i]``.  With the
+    full membership that is the familiar one-entry-per-rank contract;
+    after a rendezvous narrowed the member set, src must pass exactly one
+    entry per SURVIVING member (validated below — silently handing rank
+    ``r`` a dead rank's element would corrupt the scatter)."""
+    from . import fault_tolerance as _ft
     from .env import get_rank, get_world_size
 
     ws = get_world_size()
@@ -431,15 +464,31 @@ def scatter_object_list(out_object_list, in_object_list=None, src=0,
         out_object_list.append((in_object_list or [None])[0])
         return out_object_list
     store = _require_store(ws)
+    rank = get_rank()
+    mem = _ft.members(ws)
+    if src not in mem:
+        from .errors import PeerLostError
+
+        raise PeerLostError([src], what="scatter_object_list(src)")
+    if rank == src and len(in_object_list or []) != len(mem):
+        raise ValueError(
+            f"scatter_object_list: {len(in_object_list or [])} objects for "
+            f"{len(mem)} members {mem} — pass exactly one entry per member "
+            "of the current generation")
     _OBJ_SEQ[0] += 1
-    base = f"obj/sc/{_OBJ_SEQ[0]}"
-    if get_rank() == src:
+    base = f"{_ft.key_prefix()}/obj/sc/{_OBJ_SEQ[0]}"
+    t0 = time.perf_counter()
+    _ft.hook("exchange", {"base": base, "rank": rank, "what": "scatter"})
+    if rank == src:
         store.set(base, _obj_pack(list(in_object_list)))
-    scattered = _obj_unpack(store.wait(base, timeout=_obj_timeout()))
-    out_object_list.append(scattered[get_rank()])
-    store.barrier(f"{base}/done", ws, timeout=_obj_timeout())
-    if get_rank() == src:
+    scattered = _obj_unpack(_ft.wait_for_key(
+        store, base, _obj_timeout(), pending=(src,),
+        what="scatter_object_list"))
+    out_object_list.append(scattered[mem.index(rank)])
+    _ft.ft_barrier(store, f"{base}/done", mem, rank, _obj_timeout())
+    if rank == src:
         store.delete(base)
+    _ft.observe_latency("sc", time.perf_counter() - t0)
     return out_object_list
 
 
@@ -478,10 +527,13 @@ def destroy_process_group(group=None):
     from . import env as _env
 
     if group is None:
+        from . import fault_tolerance as _ft
+
         _P2P_SEQ.clear()
         _P2P_STAGE.clear()
         _OBJ_SEQ[0] = 0
         _BARRIER_SEQ[0] = 0
+        _ft.reset()
         _env._store = None
         _env._initialized = False
         _env._parallel_env = None

@@ -103,7 +103,12 @@ def init_parallel_env():
                              is_master=(env.rank == 0), world_size=env.world_size)
             if store._local is None:  # real socket store only — the
                 # in-process fallback cannot synchronize separate ranks
-                store.barrier("init_parallel_env", env.world_size)
+                # (sweep=False: the satisfied-barrier sentinel must stay so
+                # an elastic-RESTARTED rank re-running bring-up passes
+                # instantly instead of re-arming a fresh counter and
+                # hanging — docs/distributed_faults.md)
+                store.barrier("init_parallel_env", env.world_size,
+                              sweep=False)
                 _store = store
         except Exception:
             pass  # rendezvous is best-effort; jax.distributed retries anyway

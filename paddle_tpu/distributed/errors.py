@@ -1,0 +1,69 @@
+"""Typed distributed-failure taxonomy (docs/distributed_faults.md).
+
+Reference: paddle/fluid/distributed turns peer and store failures into
+gRPC status codes; here every way a multi-host job can lose a peer or
+its rendezvous store surfaces as ONE of these types, so callers
+(run_elastic, the serving control plane, user training loops) can write
+`except PeerLostError` instead of parsing RuntimeError strings.
+
+Layering: :class:`StoreUnavailableError` is *defined* in
+``core/native/tcp_store.py`` (the layer that owns store transport) and
+re-exported here so the whole taxonomy is importable from one place.
+
+- :class:`PeerLostError` — the failure detector (ElasticManager)
+  declared one or more peer ranks dead while we were waiting on them.
+  Carries ``.ranks``; raised within ~2x the detector TTL instead of
+  blocking for the full collective timeout.
+- :class:`CollectiveTimeoutError` — a collective/barrier/p2p wait ran
+  out its deadline with every pending peer still *alive* (subclass of
+  ``TimeoutError`` for back-compat with callers catching that).
+- :class:`RendezvousInvalidated` — another rank requested a new
+  generation (restart/join) while we were mid-collective; the current
+  generation's keys are stale and the caller must re-rendezvous.
+- :class:`StoreUnavailableError` — a store op kept failing after the
+  bounded jittered-backoff retry budget (transport down, master dead).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..core.native.tcp_store import StoreUnavailableError  # noqa: F401
+
+__all__ = [
+    "DistributedError",
+    "PeerLostError",
+    "CollectiveTimeoutError",
+    "RendezvousInvalidated",
+    "StoreUnavailableError",
+]
+
+
+class DistributedError(RuntimeError):
+    """Base of the distributed fault taxonomy."""
+
+
+class PeerLostError(DistributedError):
+    """Peer rank(s) stopped heartbeating while we were waiting on them.
+
+    ``ranks`` is the sorted list of lost ranks; ``what`` names the
+    operation that was pending on them."""
+
+    def __init__(self, ranks: Sequence[int], what: str = "collective"):
+        self.ranks = sorted(int(r) for r in ranks)
+        self.what = what
+        super().__init__(
+            f"peer rank(s) {self.ranks} lost during {what} "
+            "(missed heartbeats past the failure-detector TTL)")
+
+
+class CollectiveTimeoutError(DistributedError, TimeoutError):
+    """A collective wait expired with all pending peers still alive."""
+
+
+class RendezvousInvalidated(DistributedError):
+    """A new rendezvous was requested; the current generation is stale.
+
+    Raised from inside collective waits when the store's rendezvous
+    request counter moves past the one recorded at this process's last
+    rendezvous — e.g. a restarted rank announcing itself.  Recovery:
+    re-rendezvous at the new generation (run_elastic does this)."""

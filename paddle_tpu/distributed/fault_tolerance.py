@@ -1,0 +1,423 @@
+"""Distributed fault tolerance: generation-scoped rendezvous and
+failure-detector-aware waits (docs/distributed_faults.md).
+
+Reference: paddle/fluid/distributed + fleet/elastic make a peer failure
+a first-class event; here the same contract is built on the job's
+TCPStore:
+
+- **Generations.**  A store-side counter (``gen/current``) numbers the
+  job's membership epochs.  Every store-backed collective/barrier/p2p
+  key is namespaced ``g<gen>/...`` and process-local sequence counters
+  reset on each generation change, so a restarted rank (whose
+  ``_OBJ_SEQ`` restarts at 0) can NEVER consume another generation's
+  keys — the stale-key hazard becomes unrepresentable.  Old-generation
+  keys are swept by the rendezvous leader.
+- **Rendezvous.**  ``rendezvous(store, detector, rank)`` converges all
+  currently-alive ranks on a fresh generation: each entrant bumps a
+  *request* counter (``rdzv/request``) that invalidates in-flight
+  collectives of the old generation (typed
+  :class:`RendezvousInvalidated`), the lowest alive rank leads (bumps
+  ``gen/current``, publishes the member list), and an ack barrier
+  commits the epoch.
+- **Failure-detector-aware waits.**  :func:`wait_for_key` interleaves
+  short ``store.wait`` polls with liveness checks of the pending peers
+  on the registered :class:`ElasticManager`, so a dead rank surfaces as
+  a typed :class:`PeerLostError` naming the lost ranks within ~2x the
+  detector TTL — instead of blocking survivors for the full
+  ``PADDLE_P2P_TIMEOUT`` (3600 s).
+
+Telemetry (PR 9 registry): ``dist_collective_latency_seconds`` (labeled
+by collective), ``dist_peer_lost_total``, ``dist_rendezvous_total``,
+``dist_stale_keys_swept_total``, ``dist_generation`` (gauge); the store
+retry counter lives in core/native/tcp_store.py and the missed-beat
+counter in fleet/elastic.
+"""
+from __future__ import annotations
+
+import pickle
+import re
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..telemetry.metrics import registry
+from .errors import (
+    CollectiveTimeoutError,
+    PeerLostError,
+    RendezvousInvalidated,
+)
+
+__all__ = [
+    "generation", "members", "key_prefix", "set_generation", "reset",
+    "set_failure_detector", "get_failure_detector", "clear_failure_detector",
+    "set_fault_hook", "hook",
+    "wait_for_key", "ft_barrier", "exchange", "rendezvous", "sweep_stale",
+    "store_generation", "store_request", "invalidated", "observe_latency",
+]
+
+GEN_KEY = "gen/current"
+REQ_KEY = "rdzv/request"
+
+# process-local view of the committed epoch: generation number, member
+# list (None = implicit range(world_size)), and the rendezvous-request
+# count observed when the epoch was committed (requests past it
+# invalidate in-flight collectives)
+_state = {"gen": 0, "members": None, "request": 0}
+_state_lock = threading.Lock()
+_detector = None
+_fault_hook: Optional[Callable] = None
+
+_GEN_RE = re.compile(r"^(?:__barrier__/)?g(\d+)/")
+
+
+# ---------------------------------------------------------------------------
+# epoch state
+# ---------------------------------------------------------------------------
+
+def generation() -> int:
+    return _state["gen"]
+
+
+def members(world_size: int) -> List[int]:
+    """The current generation's member ranks (all of ``range(world_size)``
+    until a rendezvous narrows it)."""
+    m = _state["members"]
+    return list(m) if m is not None else list(range(world_size))
+
+
+def key_prefix() -> str:
+    return f"g{_state['gen']}"
+
+
+def set_generation(gen: int, member_list: Optional[Sequence[int]] = None,
+                   request: Optional[int] = None):
+    """Commit a new epoch locally: update the generation/member view and
+    reset the process-local collective sequence counters, so key streams
+    restart at 0 in the new namespace on every rank consistently."""
+    with _state_lock:
+        _state["gen"] = int(gen)
+        _state["members"] = (sorted(int(r) for r in member_list)
+                             if member_list is not None else None)
+        if request is not None:
+            _state["request"] = int(request)
+    from . import collective as _coll
+
+    _coll._OBJ_SEQ[0] = 0
+    _coll._BARRIER_SEQ[0] = 0
+    _coll._P2P_SEQ.clear()
+    registry().gauge("dist_generation",
+                     help="current rendezvous generation").set(float(gen))
+
+
+def reset():
+    """Back to the pristine single-epoch view (destroy_process_group)."""
+    set_generation(0, None, 0)
+
+
+# ---------------------------------------------------------------------------
+# failure detector + fault hook registries
+# ---------------------------------------------------------------------------
+
+def set_failure_detector(detector):
+    """Register the process's liveness source (an ElasticManager — done
+    automatically by its start()); collective waits consult it."""
+    global _detector
+    _detector = detector
+
+
+def get_failure_detector():
+    return _detector
+
+
+def clear_failure_detector(detector=None):
+    global _detector
+    if detector is None or _detector is detector:
+        _detector = None
+
+
+def set_fault_hook(h: Optional[Callable]):
+    """Install a fault hook for the module-level 'exchange' point (the
+    FaultInjector protocol; TCPStore/ElasticManager carry their own)."""
+    global _fault_hook
+    _fault_hook = h
+
+
+def hook(point: str, ctx: Optional[dict] = None):
+    if _fault_hook is not None:
+        _fault_hook(point, ctx)
+
+
+def _detector_ttl(det) -> float:
+    return float(getattr(det, "ttl", 10.0))
+
+
+# ---------------------------------------------------------------------------
+# store-side epoch counters
+# ---------------------------------------------------------------------------
+
+def store_generation(store) -> int:
+    return store.add(GEN_KEY, 0)
+
+
+def store_request(store) -> int:
+    return store.add(REQ_KEY, 0)
+
+
+def invalidated(store) -> bool:
+    """True when some rank requested a rendezvous after our epoch
+    committed — our generation's keys are about to go stale."""
+    return store_request(store) > _state["request"]
+
+
+# ---------------------------------------------------------------------------
+# detector-aware waiting
+# ---------------------------------------------------------------------------
+
+def wait_for_key(store, key: str, timeout: float, *,
+                 pending: Sequence[int] = (), what: str = "collective",
+                 check_invalidation: bool = True) -> bytes:
+    """``store.wait`` interleaved with failure detection: short wait
+    slices, and between slices (a) the rendezvous-request counter is
+    checked (typed :class:`RendezvousInvalidated`) and (b) the pending
+    peer ranks are checked against the registered detector's membership
+    (typed :class:`PeerLostError` naming the lost ranks).  Only when the
+    full ``timeout`` elapses with every pending peer still alive does it
+    raise :class:`CollectiveTimeoutError`."""
+    det = get_failure_detector()
+    poll = max(0.05, min(1.0, _detector_ttl(det) / 2.0)) if det is not None \
+        else 0.5
+    deadline = time.monotonic() + timeout
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise CollectiveTimeoutError(
+                f"{what}: key {key!r} not ready within {timeout}s "
+                f"(pending ranks {sorted(pending)} all still alive)")
+        try:
+            return store.wait(key, timeout=min(poll, remaining))
+        except TimeoutError:
+            pass
+        if check_invalidation and invalidated(store):
+            raise RendezvousInvalidated(
+                f"{what}: a new rendezvous was requested while waiting for "
+                f"{key!r} (generation {_state['gen']} is stale)")
+        if det is not None and pending:
+            try:
+                alive = set(det.alive_nodes())
+            except Exception:  # noqa: BLE001 — detector outage: keep waiting
+                alive = None
+            if alive is not None:
+                # a rank with NO heartbeat history is still booting (slow
+                # import / late start), not dead — only a rank that beat
+                # before and went stale is provably lost.  Detectors
+                # without the registration concept condemn as before.
+                seen = getattr(det, "has_registered", lambda _r: True)
+                lost = [r for r in pending
+                        if r not in alive and seen(r)]
+                if lost:
+                    registry().counter(
+                        "dist_peer_lost_total",
+                        help="peers declared dead inside a collective wait",
+                    ).inc(len(lost))
+                    raise PeerLostError(lost, what=what)
+
+
+def ft_barrier(store, name: str, member_list: Sequence[int], rank: int,
+               timeout: float):
+    """Idempotent membership-keyed barrier, detector-aware and
+    self-cleaning.
+
+    Every phase is a per-rank ``set`` (safe to retry blindly — a
+    counter ``add`` whose response is lost on the wire would be
+    re-applied on reconnect and could release a counting barrier one
+    arrival EARLY, letting the payload sweep race a still-reading
+    straggler).  Each member posts an arrival key, waits for every
+    other member's arrival (a dead peer surfaces as PeerLostError, not
+    a hang), posts a departure key, and the lowest member — after
+    seeing every departure, i.e. after every member has provably passed
+    — deletes all keys, so a satisfied barrier leaves zero store keys."""
+    base = f"__barrier__/{name}"
+    others = [r for r in member_list if r != rank]
+    store.set(f"{base}/a/{rank}", b"1")
+    for r in others:
+        wait_for_key(store, f"{base}/a/{r}", timeout, pending=(r,),
+                     what=f"barrier[{name}]")
+    store.set(f"{base}/d/{rank}", b"1")
+    if rank == min(member_list):
+        for r in others:
+            wait_for_key(store, f"{base}/d/{r}", timeout, pending=(r,),
+                         what=f"barrier[{name}]")
+        for r in member_list:
+            store.delete(f"{base}/a/{r}")
+            store.delete(f"{base}/d/{r}")
+
+
+def exchange(store, base: str, rank: int, member_list: Sequence[int],
+             payload: bytes, timeout: float, what: str = "exchange"
+             ) -> List[bytes]:
+    """All-to-all object transport primitive: every member posts its
+    payload under ``<base>/<rank>``, collects every member's (detector-
+    aware), passes the completion barrier, and the lowest member sweeps
+    the payload keys.  Returns payloads in member order."""
+    hook("exchange", {"base": base, "rank": rank, "what": what})
+    store.set(f"{base}/{rank}", payload)
+    out = {}
+    for r in member_list:
+        if r == rank:
+            out[r] = payload
+            continue
+        out[r] = wait_for_key(store, f"{base}/{r}", timeout,
+                              pending=(r,), what=what)
+    ft_barrier(store, f"{base}/done", member_list, rank, timeout)
+    if rank == min(member_list):
+        for r in member_list:
+            store.delete(f"{base}/{r}")
+    return [out[r] for r in member_list]
+
+
+def observe_latency(collective: str, seconds: float):
+    registry().histogram(
+        "dist_collective_latency_seconds",
+        help="store-backed collective wall time", unit="seconds",
+    ).observe(seconds, collective=collective)
+
+
+# ---------------------------------------------------------------------------
+# rendezvous
+# ---------------------------------------------------------------------------
+
+def sweep_stale(store, current_gen: int) -> int:
+    """Delete every generation-scoped key (``g<n>/...`` and
+    ``__barrier__/g<n>/...``) of generations older than ``current_gen``.
+    Called by the rendezvous leader once the new epoch commits."""
+    try:
+        ks = store.keys()
+    except Exception:  # noqa: BLE001 — sweep is best-effort
+        return 0
+    n = 0
+    for k in ks:
+        m = _GEN_RE.match(k)
+        if m and int(m.group(1)) < current_gen:
+            try:
+                store.delete(k)
+                n += 1
+            except Exception:  # noqa: BLE001
+                pass
+    if n:
+        registry().counter(
+            "dist_stale_keys_swept_total",
+            help="old-generation store keys deleted at rendezvous").inc(n)
+    return n
+
+
+def rendezvous(store, detector, rank: int, *, min_nodes: Optional[int] = None,
+               timeout: float = 120.0, sweep: bool = True
+               ) -> Tuple[int, List[int]]:
+    """Converge the currently-alive ranks on a fresh generation.
+
+    Protocol: every entrant bumps ``rdzv/request`` (in-flight old-
+    generation waits observe the bump and abort with
+    RendezvousInvalidated, funneling everyone here).  Each round, the
+    lowest alive rank leads: it bumps ``gen/current`` and publishes the
+    member list under ``g<gen>/members``; followers accept only a
+    generation STRICTLY newer than the one current at their entry and
+    ack via idempotent per-rank keys.  Commit is LEADER-AUTHORITATIVE:
+    only when the leader has seen every follower's ack within ~2x TTL
+    does it write ``g<gen>/commit`` — a follower can therefore never
+    "complete" a round the leader abandoned (the split-brain a
+    symmetric barrier allows when the leader's window expires just as
+    the last ack lands).  Failed rounds are retried with a fresh
+    membership view until ``timeout``; the committing leader then
+    sweeps all older generations' keys.  Returns ``(generation,
+    members)`` and commits them locally (:func:`set_generation` —
+    sequence counters reset)."""
+    store.add(REQ_KEY, 1)
+    # Followers only accept generations STRICTLY newer than this floor.
+    # A surviving rank floors at its last COMMITTED generation, so it can
+    # join the round a leader already opened before it got here; a fresh
+    # process (committed gen 0) floors at the store's current generation —
+    # it must never re-ack a possibly-completed prior epoch.
+    entry_floor = _state["gen"] if _state["gen"] > 0 \
+        else store_generation(store)
+    min_n = min_nodes if min_nodes is not None \
+        else int(getattr(detector, "min_nodes", 1))
+    ttl = _detector_ttl(detector)
+    ack_timeout = max(1.0, min(5.0, 2.0 * ttl))
+    deadline = time.monotonic() + timeout
+    acked: set = set()      # generations this call already acked (never twice)
+    rebumped: set = set()   # generations we re-requested past (once each)
+    last = "no round completed"
+
+    def _commit(g, mem, req):
+        # `req` is the leader's request-counter snapshot taken BEFORE it
+        # wrote the commit, published in the commit payload — every
+        # member records the SAME floor, so a bump racing the commit is
+        # past the floor for all of them and invalidated() re-fires
+        # (reading the counter per-member at commit time could absorb a
+        # concurrent entrant's bump and starve it)
+        set_generation(g, mem, request=req)
+        if sweep and rank == mem[0]:
+            sweep_stale(store, g)
+        registry().counter("dist_rendezvous_total",
+                           help="committed rendezvous rounds").inc()
+        return g, list(mem)
+
+    while time.monotonic() < deadline:
+        alive = sorted(set(detector.alive_nodes()) | {rank})
+        if len(alive) < min_n:
+            time.sleep(min(0.2, ttl / 4.0))
+            continue
+        if rank == alive[0]:  # leader: open the next epoch
+            g = store.add(GEN_KEY, 1)
+            store.set(f"g{g}/members", pickle.dumps(alive))
+            mem = alive
+            # acks are per-rank SET keys — idempotent under a lost-
+            # response retry (a counter add could double-apply and
+            # release this wait one follower early).  They persist with
+            # the generation (like members/commit) and are swept when it
+            # goes stale, so a retry landing late can't leak a key.
+            ack_deadline = time.monotonic() + ack_timeout
+            acked_all = False
+            while time.monotonic() <= ack_deadline:
+                if all(store.check(f"g{g}/rdzv/ack/{r}") for r in mem[1:]):
+                    acked_all = True
+                    break
+                time.sleep(0.02)
+            if acked_all:  # every follower acked: commit the epoch
+                req = store_request(store)
+                store.set(f"g{g}/commit", pickle.dumps((mem, req)))
+                return _commit(g, mem, req)
+            missing = [r for r in mem[1:]
+                       if not store.check(f"g{g}/rdzv/ack/{r}")]
+            last = f"round {g}: missing acks from {missing}"
+            continue
+        # follower: find a live round that includes us, ack it once, and
+        # wait for the leader's commit
+        g = store_generation(store)
+        if g <= entry_floor or not store.check(f"g{g}/members"):
+            last = f"waiting for a generation past {entry_floor}"
+            time.sleep(0.05)
+            continue
+        mem = pickle.loads(store.get(f"g{g}/members", timeout=2.0))
+        if rank not in mem:
+            # a round that excludes us may have absorbed our original
+            # request into its floor; re-request once per observed
+            # generation so its members re-rendezvous and admit us
+            if g not in rebumped:
+                rebumped.add(g)
+                store.add(REQ_KEY, 1)
+            last = f"generation {g} published without rank {rank}"
+            time.sleep(0.1)
+            continue
+        if g not in acked:
+            acked.add(g)
+            store.set(f"g{g}/rdzv/ack/{rank}", b"1")
+        try:
+            mem, req = pickle.loads(store.wait(f"g{g}/commit",
+                                               timeout=ack_timeout))
+        except TimeoutError:
+            last = f"round {g}: leader did not commit"
+            continue
+        return _commit(g, mem, req)
+    raise CollectiveTimeoutError(
+        f"rendezvous: no stable membership within {timeout}s (last: {last})")

@@ -22,7 +22,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["ElasticManager", "ElasticStatus"]
+__all__ = ["ElasticManager", "ElasticStatus", "ElasticRunResult",
+           "run_elastic"]
 
 
 class ElasticStatus:
@@ -67,6 +68,12 @@ class ElasticManager:
         # last-notified membership is left unchanged so the next detection
         # re-fires.
         self._seen: Dict[int, tuple] = {}
+        # ranks currently considered dead (for missed-beat telemetry:
+        # count alive->dead TRANSITIONS, not every stale poll)
+        self._dead: set = set()
+        # test-only fault injection at the 'heartbeat' point
+        # (paddle_tpu.faults.FaultInjector.install(manager))
+        self._fault_hook = None
         self._lock = threading.Lock()
         # RLock: an on_change callback may itself call alive_nodes()/
         # health() (re-entering _deliver on the same thread) without
@@ -78,11 +85,42 @@ class ElasticManager:
         self._threads: List[threading.Thread] = []
         self.enabled = True
 
+    # -- identity / tuning surface (the failure-detector contract the
+    # fault-tolerance layer consumes) ------------------------------------
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def ttl(self) -> float:
+        """Liveness window: a rank whose beat counter hasn't moved for
+        ttl seconds of THIS watcher's clock is dead."""
+        return self._ttl
+
+    @property
+    def min_nodes(self) -> int:
+        return self._min
+
+    def has_registered(self, rank: int) -> bool:
+        """True once ``rank`` has EVER heartbeated (its beat key exists).
+        Distinguishes a dead rank (key present, counter stale) from one
+        still booting (no key yet) — the fault-tolerance waits only
+        declare PeerLostError for the former."""
+        try:
+            return bool(self._store.check(f"elastic/beat/{int(rank)}"))
+        except Exception:  # noqa: BLE001 — store outage: don't condemn
+            return False
+
     # -- lifecycle -------------------------------------------------------
     def start(self):
         """Register + start the heartbeat and watch threads (reference
-        manager.py heartbeat thread :254)."""
+        manager.py heartbeat thread :254).  Also registers this manager
+        as the process's failure detector, making every store-backed
+        collective wait peer-loss-aware (docs/distributed_faults.md)."""
         self._beat()
+        from ... import fault_tolerance as _ft
+
+        _ft.set_failure_detector(self)
         t1 = threading.Thread(target=self._heartbeat_loop, daemon=True)
         t2 = threading.Thread(target=self._watch_loop, daemon=True)
         t1.start()
@@ -93,11 +131,19 @@ class ElasticManager:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=self._interval * 2)
+        from ... import fault_tolerance as _ft
+
+        _ft.clear_failure_detector(self)
 
     exit = stop
 
     # -- heartbeat -------------------------------------------------------
     def _beat(self):
+        ctx = {"rank": self._rank, "skip": False}
+        if self._fault_hook is not None:
+            self._fault_hook("heartbeat", ctx)
+        if ctx.get("skip"):
+            return  # injected missed beat (peers will see us as dying)
         self._store.add(f"elastic/beat/{self._rank}", 1)
 
     def _heartbeat_loop(self):
@@ -128,9 +174,22 @@ class ElasticManager:
                 last = self._seen.get(r)
                 if last is None or last[0] != ctr:
                     self._seen[r] = (ctr, now)
+                    self._dead.discard(r)
                     alive.append(r)
                 elif now - last[1] <= self._ttl:
                     alive.append(r)
+                elif r not in self._dead:
+                    # alive -> dead transition: missed-beat telemetry
+                    self._dead.add(r)
+                    try:
+                        from ....telemetry.metrics import registry
+
+                        registry().counter(
+                            "dist_missed_beat_total",
+                            help="ranks whose heartbeat went stale past TTL",
+                        ).inc(rank=str(r))
+                    except Exception:  # noqa: BLE001 — telemetry best-effort
+                        pass
             cur = frozenset(alive)
             self._seq += 1
             seq = self._seq
@@ -210,3 +269,6 @@ class ElasticManager:
                 return True
             time.sleep(self._interval)
         return False
+
+
+from .run import ElasticRunResult, run_elastic  # noqa: E402,F401

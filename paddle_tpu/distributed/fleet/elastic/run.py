@@ -1,0 +1,200 @@
+"""Coordinated elastic recovery loop: membership change -> save at the
+step boundary -> re-rendezvous at a new generation -> bitwise resume.
+
+This ties the PR-4 checkpoint invariants to the PR-11 distributed
+fault-tolerance layer (docs/distributed_faults.md): ``run_elastic``
+drives a per-step ``train_fn`` and turns every membership event into a
+*recoverable, typed* transition:
+
+- a membership change observed at a step boundary (the ElasticManager's
+  on_change flag, or the store's rendezvous-request counter moving)
+  saves the current state crash-consistently, re-rendezvouses with the
+  survivor set at a fresh generation, and resumes;
+- a :class:`PeerLostError` / :class:`RendezvousInvalidated` raised from
+  INSIDE ``train_fn`` (a peer died mid-collective) skips the save — the
+  step is torn — re-rendezvouses, and rolls back to the checkpointed
+  step every surviving member agrees on (the MINIMUM of their latest
+  checkpoint steps, exchanged under the new generation), restoring via
+  ``TrainState.restore`` so the rerun is bitwise-identical;
+- a restarted rank entering ``run_elastic`` rendezvouses exactly the
+  same way, so ``train(k) -> kill a rank -> elastic restart ->
+  train(N-k)`` equals ``train(N)`` bit for bit (the PR-4 resume
+  guarantee, extended across a rank loss — proven end-to-end by
+  tools/dist_fault_gate.py on gpt_tiny+AdamW).
+
+``train_fn(step)`` must be side-effect-free up to its first collective
+(so a torn step can be rolled back) and is expected to touch the model/
+optimizer bound to ``train_state``.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional
+
+from ... import fault_tolerance as _ft
+from ...errors import PeerLostError, RendezvousInvalidated
+
+__all__ = ["ElasticRunResult", "run_elastic"]
+
+_RECOVERABLE = (PeerLostError, RendezvousInvalidated)
+
+
+@dataclass
+class ElasticRunResult:
+    """What an elastic run did: per-step ``train_fn`` returns (index ==
+    step; steps executed by a PREVIOUS incarnation of this rank are
+    ``None``, and rolled-back steps hold the rerun's value — which
+    bitwise resume makes identical anyway), how many recovery
+    transitions were taken, and the final generation/member view."""
+
+    results: List[Any]
+    recoveries: int = 0
+    generation: int = 0
+    members: List[int] = field(default_factory=list)
+
+
+def run_elastic(train_fn: Callable[[int], Any], manager, ckpt_manager,
+                train_state, *, total_steps: int, store=None,
+                save_every: int = 1, max_recoveries: int = 10,
+                rendezvous_timeout: float = 120.0) -> ElasticRunResult:
+    """Run ``train_fn(step)`` for ``total_steps`` steps with coordinated
+    checkpoint-resume recovery across membership changes.
+
+    ``manager`` is a (started) ElasticManager; ``ckpt_manager`` a
+    CheckpointManager; ``train_state`` a checkpoint.TrainState bound to
+    the live model/optimizer.  ``save_every`` is the boundary-save
+    cadence in steps (every rank must use the same value — the agreed
+    resume step must exist in everyone's checkpoint directory; size
+    ``keep_last_k`` accordingly).  A fresh start persists the step-0
+    initial state so a later fresh-join recovery can rewind everyone to
+    it; keep ``keep_last_k`` large enough that this snapshot survives GC
+    if ranks may ever join with empty checkpoint directories (a missing
+    snapshot surfaces as a typed CheckpointError, never as silent
+    divergence).
+    """
+    store = store if store is not None else manager._store
+    if store is None:
+        raise ValueError("run_elastic needs the job's TCPStore")
+    rank = manager.rank
+    if not manager._threads:
+        manager.start()
+    _ft.set_failure_detector(manager)
+
+    flag = threading.Event()
+    manager.chain_on_change(lambda _alive: flag.set())
+
+    def _latest_step() -> int:
+        # -1 (not 0) when the directory holds NOTHING: "no state at all"
+        # and "state at step 0" are different resume situations — the
+        # step-0 snapshot below exists precisely so they stay distinct
+        infos = ckpt_manager.checkpoints()
+        return infos[0].step if infos else -1
+
+    def _restore_exact(target: int) -> int:
+        for info in ckpt_manager.checkpoints():
+            if info.step == target:
+                tree, _ = ckpt_manager.restore(info)
+                pos = train_state.restore(tree)
+                return int(pos.get("step", target))
+        from ....checkpoint import CheckpointError
+
+        raise CheckpointError(
+            f"elastic resume: no checkpoint at the agreed step {target} "
+            f"under {ckpt_manager.directory} — raise keep_last_k or align "
+            "save_every across ranks")
+
+    def _rendezvous_and_restore():
+        """Commit a fresh generation with the survivors and restore the
+        newest checkpoint step EVERY member holds."""
+        ckpt_manager.wait()  # an in-flight async save must commit first
+        manager.wait(timeout=rendezvous_timeout)
+        gen, mem = _ft.rendezvous(store, manager, rank,
+                                  timeout=rendezvous_timeout)
+        blobs = _ft.exchange(store, f"g{gen}/obj/elastic/resume", rank, mem,
+                             pickle.dumps(_latest_step()), rendezvous_timeout,
+                             what="elastic.resume")
+        resume = min(pickle.loads(b) for b in blobs)
+        if resume >= 0:
+            # every member holds a checkpoint at `resume` (0 included:
+            # that is the step-0 initial-state snapshot, NOT "nothing")
+            step = _restore_exact(resume)
+        else:
+            # some member has NO checkpoint at all (fresh join / wiped
+            # disk): the job restarts from step 0.  A member that HAS
+            # advanced state must rewind to the step-0 snapshot — NOT
+            # silently keep its trained parameters; if that snapshot was
+            # GC'd, _restore_exact raises the typed CheckpointError
+            # instead of letting the timelines diverge.  A truly fresh
+            # member persists its initial state as the step-0 snapshot
+            # so every later rewind restores THIS exact state.
+            step = 0
+            if _latest_step() < 0:
+                ckpt_manager.save(
+                    train_state.capture(position={"step": 0}), step=0,
+                    blocking=True)
+            else:
+                step = _restore_exact(0)
+        # checkpoints newer than the agreed resume belong to the
+        # ABANDONED timeline: drop them, or a later boundary-save guard /
+        # resume exchange would treat stale state as progress (and could
+        # name a step some members never re-reach)
+        ckpt_manager.prune_newer_than(step)
+        flag.clear()
+        return gen, mem, step
+
+    def _recover(reason: Optional[BaseException]):
+        last: BaseException = reason or RuntimeError("recover")
+        for _ in range(3):  # a peer may die again mid-recovery
+            try:
+                return _rendezvous_and_restore()
+            except _RECOVERABLE as e:  # noqa: PERF203
+                last = e
+        raise last
+
+    recoveries = 0
+    gen, mem, step = _rendezvous_and_restore()
+    # steps [0, step) ran in a previous incarnation of this rank
+    results: List[Any] = [None] * step
+    while step < total_steps:
+        if flag.is_set() or _ft.invalidated(store):
+            # membership changed while we sit at a CONSISTENT boundary:
+            # save first so this very step can be the agreed resume point
+            if step > 0 and _latest_step() < step:
+                ckpt_manager.save(
+                    train_state.capture(position={"step": step}),
+                    step=step, blocking=True)
+            recoveries += 1
+            if recoveries > max_recoveries:
+                raise RuntimeError(
+                    f"run_elastic: exceeded max_recoveries={max_recoveries}")
+            from ....telemetry.metrics import registry
+
+            registry().counter(
+                "dist_recovery_total",
+                help="elastic recovery transitions (rendezvous+restore)",
+            ).inc()
+            gen, mem, step = _recover(None)
+            del results[step:]
+            continue
+        try:
+            out = train_fn(step)
+        except _RECOVERABLE as e:
+            # torn step: do NOT save; roll back to the agreed checkpoint
+            recoveries += 1
+            if recoveries > max_recoveries:
+                raise
+            from ....telemetry.metrics import registry
+
+            registry().counter("dist_recovery_total").inc()
+            gen, mem, step = _recover(e)
+            del results[step:]
+            continue
+        results.append(out)
+        step += 1
+        if save_every and step % save_every == 0:
+            ckpt_manager.save(train_state.capture(position={"step": step}),
+                              step=step)
+    ckpt_manager.wait()
+    return ElasticRunResult(results, recoveries, gen, list(mem))

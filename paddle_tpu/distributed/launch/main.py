@@ -59,7 +59,9 @@ def _rendezvous_node_rank(master: str, nnodes: int) -> int:
         store = TCPStore(host=host, port=port + 2, is_master=False,
                          world_size=nnodes)
     rank = store.add("launch/node_join", 1) - 1
-    store.barrier("launch/all_nodes", nnodes, timeout=300.0)
+    # sweep=False: a node joining late (or re-rendezvousing after an
+    # elastic relaunch) must pass via the lingering done sentinel
+    store.barrier("launch/all_nodes", nnodes, timeout=300.0, sweep=False)
     # keep the hosting store alive for the job's lifetime
     global _RDZV_STORE
     _RDZV_STORE = store

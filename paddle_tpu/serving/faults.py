@@ -1,224 +1,24 @@
-"""Fault-injection harness for the serving engine.
+"""Serving fault injection — compatibility surface.
 
-Same discipline as ``checkpoint/manager.py``'s ``_fault_hook``: the engine
-(and the BlockAllocator) call a test-only hook at named points of the step
-pipeline; an installed :class:`FaultInjector` acts there — raising,
-stalling, or mutating the hook's ``ctx`` — to force, deterministically and
-at chosen occurrences, exactly the failures production would hit
-stochastically:
-
-======================  =====================  ==============================
-kind                    hook point             effect
-======================  =====================  ==============================
-``step_exception``      before_decode          raise :class:`InjectedFault`
-                                               (``state_intact=True`` — the
-                                               fault fires before dispatch)
-``step_stall``          before_decode          ``time.sleep(duration)`` so
-                                               the watchdog trips; the thunk
-                                               then honors ``cancelled()``
-``nan_logits``          after_decode           flip ``ctx["finite"]`` for
-                                               the chosen slots (simulating
-                                               NaN-poisoned logits)
-``alloc_exhausted``     alloc                  ``ctx["force_none"] = True``
-                                               (pool reports no free pages)
-``callback_error``      callback               raise inside the engine's
-                                               ``on_token`` invocation
-======================  =====================  ==============================
-
-(The PR-5 two-phase engine also exposed ``before_prefill``/
-``after_prefill``; the fused mixed step retired the separate prefill
-dispatch, so prefill work now crosses the SAME ``before_decode``/
-``after_decode`` points — plans targeting the old prefill points would
-be dead and are rejected at validation.)
-
-Injection points are keyed on the Nth OCCURRENCE of the point (per-point
-call counters), so a schedule is reproducible independent of wall clock.
-``FaultInjector.log`` records every shot actually fired — tests assert the
-schedule really executed instead of silently passing on a dead plan.
-
-``random_schedule`` builds a randomized multi-fault plan from a seeded RNG
-for the property tests and ``tools/serving_fault_gate.py``: the invariant
-under ANY schedule is that page accounting stays exact (no leaks, no
-double frees) and non-implicated requests complete token-for-token equal
-to an unfaulted run.
+The occurrence-keyed injection harness was promoted to
+:mod:`paddle_tpu.faults` (PR 11) so the distributed fault-tolerance
+layer can drive the SAME injector against TCPStore ops, elastic
+heartbeats, and collective exchanges.  Serving imports keep working
+unchanged through these re-exports; see ``paddle_tpu/faults.py`` for
+the kind/point tables (serving rows unchanged) and
+``docs/serving.md`` / ``docs/distributed_faults.md`` for the failure
+models on either side.
 """
 from __future__ import annotations
 
-import time
-from collections import Counter
-from dataclasses import dataclass, field
-from typing import List, Optional, Sequence
-
-import numpy as np
+from ..faults import (  # noqa: F401
+    KIND_POINTS,
+    KINDS,
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+    random_schedule,
+)
 
 __all__ = ["InjectedFault", "FaultPlan", "FaultInjector", "random_schedule",
            "KINDS"]
-
-KINDS = ("step_exception", "step_stall", "nan_logits", "alloc_exhausted",
-         "callback_error")
-
-_KIND_POINTS = {
-    "step_exception": ("before_decode",),
-    "step_stall": ("before_decode",),
-    "nan_logits": ("after_decode",),
-    "alloc_exhausted": ("alloc",),
-    "callback_error": ("callback",),
-}
-
-
-class InjectedFault(RuntimeError):
-    """A deterministically injected serving fault.
-
-    ``state_intact=True`` (the default) tells the engine the fault fired
-    BEFORE any device dispatch — pool state is untouched, so containment
-    can stay surgical (fail one request / retry without a rebuild).
-    Schedules that model a mid-dispatch crash set it False to force the
-    conservative rebuild path."""
-
-    def __init__(self, msg: str, state_intact: bool = True):
-        super().__init__(msg)
-        self.state_intact = state_intact
-
-
-@dataclass
-class FaultPlan:
-    """One injection: fire ``kind`` at occurrences [at, at+times) of
-    ``point``."""
-
-    point: str                     # hook point name
-    at: int                        # 0-based occurrence index of the point
-    kind: str                      # one of KINDS
-    times: int = 1                 # consecutive occurrences to fire on
-    duration: float = 0.0          # step_stall: seconds to sleep
-    slots: Optional[Sequence[int]] = None   # nan_logits: slot indices (None
-    #                                         = every active slot)
-    state_intact: bool = True      # step_exception: pre-dispatch fault?
-
-    def __post_init__(self):
-        if self.kind not in KINDS:
-            raise ValueError(f"unknown fault kind {self.kind!r}; "
-                             f"expected one of {KINDS}")
-        if self.point not in _KIND_POINTS[self.kind]:
-            raise ValueError(
-                f"kind {self.kind!r} cannot fire at point {self.point!r} "
-                f"(valid: {_KIND_POINTS[self.kind]})")
-        if self.times < 1:
-            raise ValueError("times must be >= 1")
-
-
-@dataclass
-class _Shot:
-    """One fault that actually fired (FaultInjector.log entry)."""
-
-    point: str
-    occurrence: int
-    kind: str
-
-
-class FaultInjector:
-    """Deterministic fault scheduler implementing the engine's
-    ``_fault_hook(point, ctx)`` protocol.
-
-    Usage::
-
-        inj = FaultInjector()
-        inj.inject("before_decode", at=3, kind="step_exception")  # transient
-        inj.inject("after_decode", at=5, kind="nan_logits", slots=[1])
-        inj.install(engine)
-        ... drive the engine; assert inj.log shows both shots fired ...
-    """
-
-    def __init__(self, plans: Optional[List[FaultPlan]] = None):
-        self.plans: List[FaultPlan] = list(plans or [])
-        self.log: List[_Shot] = []
-        self._calls: Counter = Counter()
-
-    def inject(self, point: str, at: int, kind: str, **kw) -> "FaultInjector":
-        self.plans.append(FaultPlan(point=point, at=at, kind=kind, **kw))
-        return self
-
-    def install(self, engine) -> "FaultInjector":
-        """Attach to an engine's hook points (and its allocator's)."""
-        engine._fault_hook = self.hook
-        engine.allocator._fault_hook = self.hook
-        return self
-
-    # -- the hook ----------------------------------------------------------
-    def hook(self, point: str, ctx: Optional[dict] = None):
-        n = self._calls[point]
-        self._calls[point] += 1
-        for plan in self.plans:
-            if plan.point != point or not plan.at <= n < plan.at + plan.times:
-                continue
-            self.log.append(_Shot(point, n, plan.kind))
-            self._fire(plan, n, ctx)
-
-    def _fire(self, plan: FaultPlan, n: int, ctx: Optional[dict]):
-        if plan.kind == "step_exception":
-            raise InjectedFault(
-                f"injected step exception at {plan.point}#{n}",
-                state_intact=plan.state_intact)
-        if plan.kind == "step_stall":
-            time.sleep(plan.duration)
-            return
-        if plan.kind == "nan_logits":
-            fin = ctx["finite"] if ctx else None
-            if fin is not None:
-                if plan.slots is None:
-                    fin[:] = False
-                else:
-                    for s in plan.slots:
-                        if s < len(fin):
-                            fin[s] = False
-            return
-        if plan.kind == "alloc_exhausted":
-            if ctx is not None:
-                ctx["force_none"] = True
-            return
-        if plan.kind == "callback_error":
-            raise InjectedFault(
-                f"injected callback error at {plan.point}#{n}")
-
-    # -- introspection -----------------------------------------------------
-    def fired(self, kind: Optional[str] = None) -> int:
-        """How many shots fired (optionally of one kind)."""
-        return sum(1 for s in self.log if kind is None or s.kind == kind)
-
-    def occurrences(self, point: str) -> int:
-        """How many times the engine reached ``point``."""
-        return self._calls[point]
-
-
-def random_schedule(rng: np.random.RandomState, *, horizon: int = 40,
-                    n_faults: int = 4, num_slots: int = 4,
-                    include_stalls: bool = False,
-                    stall_duration: float = 0.3) -> FaultInjector:
-    """Build a randomized fault schedule over roughly ``horizon`` decode
-    steps: the property tests and the CI gate drive engines under many
-    seeds and assert the accounting/containment invariants hold for ALL of
-    them.  Stalls are opt-in (they cost wall clock per shot and need a
-    watchdog-enabled engine)."""
-    kinds = ["step_exception", "nan_logits", "alloc_exhausted",
-             "callback_error"]
-    if include_stalls:
-        kinds.append("step_stall")
-    inj = FaultInjector()
-    for _ in range(n_faults):
-        kind = kinds[rng.randint(len(kinds))]
-        at = int(rng.randint(1, horizon))
-        if kind == "step_exception":
-            # times=1 exercises retry-once; times>=2 forces recovery
-            inj.inject("before_decode", at=at, kind=kind,
-                       times=int(rng.randint(1, 4)))
-        elif kind == "step_stall":
-            inj.inject("before_decode", at=at, kind=kind,
-                       duration=stall_duration)
-        elif kind == "nan_logits":
-            inj.inject("after_decode", at=at, kind=kind,
-                       slots=[int(rng.randint(num_slots))])
-        elif kind == "alloc_exhausted":
-            inj.inject("alloc", at=at, kind=kind,
-                       times=int(rng.randint(1, 6)))
-        else:
-            inj.inject("callback", at=at, kind=kind)
-    return inj

@@ -32,6 +32,14 @@
 # overhead <3% of a compiled dispatch, Chrome-trace export valid with
 # nested serving-phase spans, Prometheus exposition parses; see
 # docs/observability.md).  PADDLE_TPU_SKIP_OBS_GATE=1 skips it.
+#
+# A distributed fault-tolerance gate runs seventh (tools/dist_fault_gate.py
+# — real multi-process scenarios: kill-a-rank mid-collective must raise a
+# typed PeerLostError within 2x the detector TTL, a restarted rank must
+# never consume a prior generation's store keys, randomized store-outage
+# storms must be absorbed by the bounded retry, and kill -> elastic
+# restart -> resume must be bitwise-equal to the uninterrupted run; see
+# docs/distributed_faults.md).  PADDLE_TPU_SKIP_DIST_FAULT_GATE=1 skips it.
 export JAX_PLATFORMS=cpu
 export PYTHONPATH=$(python - << 'PY'
 import os
@@ -91,6 +99,15 @@ if [ -z "$PADDLE_TPU_SKIP_OBS_GATE" ]; then
     python "$(dirname "$0")/tools/obs_gate.py" || {
         rc=$?
         echo "run_tests: telemetry gate FAILED (rc=$rc)"
+        exit $rc
+    }
+fi
+
+if [ -z "$PADDLE_TPU_SKIP_DIST_FAULT_GATE" ]; then
+    echo "run_tests: distributed fault gate (tools/dist_fault_gate.py)"
+    python "$(dirname "$0")/tools/dist_fault_gate.py" || {
+        rc=$?
+        echo "run_tests: distributed fault gate FAILED (rc=$rc)"
         exit $rc
     }
 fi

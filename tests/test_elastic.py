@@ -38,6 +38,108 @@ def test_membership_and_failure_detection():
     m0.stop()
 
 
+def test_restart_same_rank_mid_ttl_no_spurious_change():
+    """A rank whose process restarts and re-registers under the SAME rank
+    id BEFORE its TTL expires must never be reported dead: the beat
+    counter keeps moving (the new incarnation's add continues the old
+    counter), so membership stays stable and on_change never fires."""
+    store = TCPStore(host="127.0.0.1", port=0, is_master=True, world_size=2)
+    changes = []
+    m0 = ElasticManager(store, rank=0, nnodes=2, ttl=0.8, interval=0.1,
+                        on_change=lambda alive: changes.append(list(alive)))
+    m1 = ElasticManager(store, rank=1, nnodes=2, ttl=0.8, interval=0.1)
+    m0.start()
+    m1.start()
+    time.sleep(0.3)
+    assert sorted(m0.alive_nodes()) == [0, 1]
+    # incarnation A dies...
+    m1.stop()
+    # ...and incarnation B re-registers under rank 1 well inside the TTL
+    time.sleep(0.2)
+    m1b = ElasticManager(store, rank=1, nnodes=2, ttl=0.8, interval=0.1)
+    m1b.start()
+    # observe for ~2x TTL: membership must stay [0, 1] throughout
+    deadline = time.time() + 1.6
+    while time.time() < deadline:
+        assert sorted(m0.alive_nodes()) == [0, 1]
+        time.sleep(0.1)
+    assert changes == [], f"spurious membership change(s): {changes}"
+    m0.stop()
+    m1b.stop()
+
+
+def test_deliver_retries_after_failing_chained_callback():
+    """chain_on_change keeps the delivery contract: when the chained
+    callback raises, the notification is NOT swallowed — the next
+    detection re-fires it (and the failure never propagates into the
+    alive_nodes() caller)."""
+    store = TCPStore(host="127.0.0.1", port=0, is_master=True, world_size=2)
+    order = []
+
+    def first(alive):
+        order.append(("first", list(alive)))
+
+    boom = [True]
+
+    def chained(alive):
+        if boom[0]:
+            boom[0] = False
+            raise RuntimeError("flaky downstream")
+        order.append(("chained", list(alive)))
+
+    m0 = ElasticManager(store, rank=0, nnodes=2, ttl=0.5, interval=0.1,
+                        on_change=first)
+    m0.chain_on_change(chained)
+    m1 = ElasticManager(store, rank=1, nnodes=2, ttl=0.5, interval=0.1)
+    m0.start()
+    m1.start()
+    time.sleep(0.3)
+    m0.alive_nodes()  # records [0, 1] silently (first computation)
+    m1.stop()         # rank 1 dies -> change to [0]
+    deadline = time.time() + 12
+    while time.time() < deadline and ("chained", [0]) not in order:
+        m0.alive_nodes()  # must never raise despite the failing callback
+        time.sleep(0.1)
+    assert ("chained", [0]) in order, order
+    # the retry re-ran the WHOLE chain in order: first fired (at least)
+    # twice — the failed delivery and the successful retry
+    firsts = [o for o in order if o == ("first", [0])]
+    assert len(firsts) >= 2, order
+    assert order.index(("first", [0])) < order.index(("chained", [0]))
+    m0.stop()
+
+
+def test_wait_returns_false_exactly_at_monotonic_deadline(monkeypatch):
+    """wait()'s deadline check is strict (`now < deadline`): a clock that
+    lands EXACTLY on the deadline returns False instead of sneaking one
+    more membership poll in."""
+    import paddle_tpu.distributed.fleet.elastic as elastic_mod
+
+    store = TCPStore(host="127.0.0.1", port=0, is_master=True, world_size=2)
+    m = ElasticManager(store, rank=0, nnodes=2, ttl=1.0, interval=0.2)
+    polled = []
+    m.alive_nodes = lambda: polled.append(1) or [0]  # would be < min=2
+
+    class FakeTime:
+        def __init__(self, base):
+            self._t = base
+            self._calls = 0
+
+        def monotonic(self):
+            self._calls += 1
+            # call 1 computes the deadline (base + timeout); call 2 lands
+            # exactly ON it
+            return self._t if self._calls == 1 else self._t + 5.0
+
+        @staticmethod
+        def sleep(_s):
+            raise AssertionError("wait() slept past its deadline")
+
+    monkeypatch.setattr(elastic_mod, "time", FakeTime(1000.0))
+    assert m.wait(timeout=5.0) is False
+    assert polled == [], "alive_nodes polled at/past the deadline"
+
+
 def test_launcher_elastic_restart(tmp_path):
     """A worker that crashes once is relaunched and the job succeeds."""
     script = tmp_path / "flaky.py"

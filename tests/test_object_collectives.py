@@ -60,9 +60,11 @@ os.environ["PADDLE_TPU_NO_JAX_DIST"] = "1"
 import paddle_tpu.distributed as D
 from paddle_tpu.distributed import env as E
 E.init_parallel_env()
-objs = []
-D.all_gather_object(objs, {"rank": 1})
-assert objs == [{"rank": 0}, {"rank": 1}], objs
+for i in range(5):
+    objs = []
+    D.all_gather_object(objs, {"rank": 1, "round": i})
+    assert objs == [{"rank": 0, "round": i},
+                    {"rank": 1, "round": i}], objs
 ol = [None]
 D.broadcast_object_list(ol, src=0)
 assert ol == ["from0"], ol
@@ -102,15 +104,27 @@ def test_object_collectives_cross_process(tmp_path):
         E._store = None
         E._initialized = False
         E.init_parallel_env()
-        objs = []
-        D.all_gather_object(objs, {"rank": 0})
-        assert objs == [{"rank": 0}, {"rank": 1}], objs
+        for i in range(5):
+            objs = []
+            D.all_gather_object(objs, {"rank": 0, "round": i})
+            assert objs == [{"rank": 0, "round": i},
+                            {"rank": 1, "round": i}], objs
         ol = ["from0"]
         D.broadcast_object_list(ol, src=0)
         assert ol == ["from0"]
         out, _ = proc.communicate(timeout=120)
         assert proc.returncode == 0, out[-1500:]
         assert "CHILD_DONE" in out
+        # leak regression (PR-11 satellite): N collective rounds used to
+        # leave one __barrier__/obj/.../done counter per round on the
+        # rank-0 store forever; now payload AND barrier keys all sweep
+        import time as _time
+
+        _time.sleep(0.5)  # the child's barrier departures finish sweeps
+        store = E.get_store()
+        leaked = [k for k in store.keys()
+                  if "/obj/" in k or k.startswith("__barrier__/g")]
+        assert leaked == [], f"store grew {len(leaked)} keys: {leaked[:8]}"
     finally:
         proc.kill()
         for k in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",

@@ -429,6 +429,26 @@ def main() -> int:
             trace.disable()
         eng.close()
 
+    # -- distributed fault tolerance: one REAL kill-and-recover scenario
+    # with spawned worker processes on this host — a rank killed
+    # mid-collective must surface as a typed PeerLostError on every
+    # survivor within 2x the detector TTL, the survivors re-rendezvous
+    # at a new generation, and the store drains to zero collective keys.
+    # The workers exercise the host-side control plane (native TCPStore
+    # sockets, heartbeats, generation rendezvous); each pins its own
+    # backend to CPU so three processes don't contend for the chip ------
+    def dist_fault():
+        import os
+        import sys as _sys
+
+        _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if _repo not in _sys.path:
+            _sys.path.insert(0, _repo)
+        from tools import dist_fault_gate
+
+        assert dist_fault_gate.scenario_kill_rank(verbose=False), \
+            "kill-and-recover scenario failed (see output above)"
+
     check("flash_attention", flash)
     check("decode_attention", decode_attention)
     check("paged_attention", paged_attention)
@@ -440,6 +460,7 @@ def main() -> int:
     check("serving_faults", serving_faults)
     check("autotune_sweep", autotune_sweep)
     check("telemetry", telemetry)
+    check("dist_fault", dist_fault)
 
     if failures:
         print(f"tpu_smoke: FAILED: {failures}")
