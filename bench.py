@@ -49,7 +49,7 @@ os.environ.setdefault("JAX_DEFAULT_MATMUL_PRECISION", "default")
 # persistent compilation cache: repeated bench runs skip recompiles
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/paddle_tpu_jax_cache")
 
-# TPU back-off ladder: (model, batch, seq, steps, remat, pure_bf16).
+# TPU back-off ladder: (model, batch, seq, steps, remat, regime).
 # Rung 0 is the headline config — the BASELINE flagship GPT-3 1.3B model
 # (largest batch that fits one v5e chip) in the pure-bf16 regime (bf16
 # params AND bf16 AdamW moments, the reference's non-multi-precision
@@ -60,13 +60,34 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/paddle_tpu_jax_cache")
 # for 1.3B pure-bf16), so larger batches fit than round 3's ladder.
 # Later rungs trade shape for fitting so the bench ALWAYS produces an
 # on-TPU number before considering the CPU cliff.
+# regime: "bf16" = pure bf16 (bf16 params+moments, no masters), "master" =
+# bf16 params + fp32 master weights/moments (halved param HBM traffic per
+# step vs fp32, fp32-faithful update — needs ~2.4x the pure-bf16 optimizer
+# HBM), "fp32" = fp32 params under AMP O1.  BENCH_PRECISION overrides the
+# rung's regime for A/B runs.
 _RUNGS = [
-    ("1p3b", 8, 1024, 10, 1, True),
-    ("1p3b", 4, 1024, 10, 1, True),
-    ("1p3b", 2, 1024, 10, 1, True),
-    ("small", 16, 1024, 20, 1, True),
-    ("small", 2, 512, 20, 1, False),
+    ("1p3b", 8, 1024, 10, 1, "bf16"),
+    ("1p3b", 4, 1024, 10, 1, "bf16"),
+    ("1p3b", 2, 1024, 10, 1, "bf16"),
+    ("small", 16, 1024, 20, 1, "bf16"),
+    ("small", 2, 512, 20, 1, "fp32"),
 ]
+
+_REGIMES = ("bf16", "master", "fp32")
+
+
+def _parse_regime(tok: str, strict: bool = False) -> str:
+    """BENCH_CONFIG back-compat: the old boolean pure_bf16 sixth field
+    still parses ('1'/'true' -> bf16, '0'/'false' -> fp32).  ``strict``
+    (the BENCH_PRECISION path) rejects unknown tokens instead — a typo'd
+    regime must not silently record an fp32 measurement labeled as
+    something else."""
+    if tok in _REGIMES:
+        return tok
+    if strict:
+        raise ValueError(
+            f"BENCH_PRECISION={tok!r}: expected one of {_REGIMES}")
+    return "bf16" if tok in ("1", "true", "True") else "fp32"
 
 
 def _emit(metric, value, unit, vs_baseline):
@@ -382,41 +403,79 @@ def main():
     devs = jax.devices()
     on_tpu = devs[0].platform != "cpu"
     if on_tpu:
-        custom = os.environ.get("BENCH_CONFIG")  # "model:bs:seq:steps:remat:bf16"
+        custom = os.environ.get("BENCH_CONFIG")  # "model:bs:seq:steps:remat:regime"
         if custom:
-            name, batch, seq, steps, remat, pure_bf16 = custom.split(":")
+            name, batch, seq, steps, remat, regime = custom.split(":")
             batch, seq, steps, remat = map(int, (batch, seq, steps, remat))
-            pure_bf16 = pure_bf16 in ("1", "true", "True")
+            regime = _parse_regime(regime)
         else:
             rung = int(os.environ.get("BENCH_RUNG", "0"))
-            name, batch, seq, steps, remat, pure_bf16 = _RUNGS[rung]
+            name, batch, seq, steps, remat, regime = _RUNGS[rung]
+    else:
+        # CPU fallback uses a toy shape so the bench always completes
+        # (BENCH_CPU_STEPS lengthens the timed window for CPU A/B runs)
+        name, batch, seq, steps, remat, regime = "small", 2, 128, 3, 1, "fp32"
+        steps = int(os.environ.get("BENCH_CPU_STEPS", steps))
+    env_precision = os.environ.get("BENCH_PRECISION")
+    regime = (_parse_regime(env_precision, strict=True) if env_precision
+              else _parse_regime(regime))
+    param_dtype = "float32" if regime == "fp32" else "bfloat16"
+
+    # remat config precedence: env pin > measured autotune-table winner >
+    # rung default.  The table search space is (recompute_interval,
+    # recompute_policy) on the stacked scan — tools/autotune.py times each
+    # candidate train step once on-device and persists the winner under
+    # the same shape-key discipline as the Pallas kernels.
+    remat_policy = os.environ.get("BENCH_REMAT_POLICY") or None
+    env_interval = os.environ.get("BENCH_REMAT_INTERVAL")
+    if env_interval is not None:
+        remat = int(env_interval)
+    elif remat_policy is None:
+        from paddle_tpu.analysis import autotune as _autotune
+
+        mk_probe = gpt_1p3b if name == "1p3b" else gpt_small
+        layers = mk_probe().num_layers if on_tpu else 2
+        remat_shape = {"layers": layers,
+                       "hidden": mk_probe().hidden_size if on_tpu else 768,
+                       "batch": batch, "seq": seq}
+        tuned = _autotune.kernel_params("train_remat", remat_shape,
+                                        param_dtype)
+        if tuned is not None:
+            remat, remat_policy = _autotune.remat_params_to_config(tuned)
+            sys.stderr.write(f"bench: train_remat table hit: "
+                             f"interval={remat} policy={remat_policy}\n")
+
+    if on_tpu:
         mk = gpt_1p3b if name == "1p3b" else gpt_small
-        # BENCH_REMAT_POLICY=dots: selective remat (save MXU outputs,
+        # remat policy: "dots" = selective remat (save MXU outputs,
         # recompute only VPU work in backward) — trades HBM for the ~33%
-        # recompute FLOPs full remat pays
+        # recompute FLOPs full remat pays; interval k groups k blocks per
+        # checkpoint boundary on the stacked scan
         cfg = mk(hidden_dropout=0.0, attention_dropout=0.0,
                  max_position_embeddings=max(seq, 1024),
                  recompute_interval=remat,
-                 recompute_policy=os.environ.get("BENCH_REMAT_POLICY") or None,
+                 recompute_policy=remat_policy,
                  use_flash_attention=True)
     else:
-        # CPU fallback uses a toy shape so the bench always completes
-        name, batch, seq, steps, pure_bf16 = "small", 2, 128, 3, False
         cfg = gpt_small(hidden_dropout=0.0, attention_dropout=0.0,
-                        recompute_interval=1)
+                        recompute_interval=remat,
+                        recompute_policy=remat_policy)
         cfg.num_layers = 2
 
     pt.seed(0)
     model = GPTStackedForPretraining(cfg)
-    if pure_bf16:
-        # pure-bf16 regime: params + moments in bf16 (no fp32 master) —
-        # reference analog: amp O2 decorate + adam multi_precision=False
+    if regime in ("bf16", "master"):
+        # bf16 params (halved parameter HBM traffic per step): "bf16" is
+        # the pure regime (bf16 moments, no masters — the reference's
+        # non-multi-precision adam); "master" keeps fp32 master weights +
+        # fp32 moments in the optimizer (reference multi_precision adam) —
+        # the update reads/writes the masters, convergence tracks fp32
         pt.amp.decorate(model, level="O2", dtype="bfloat16")
     # BENCH_FUSED_ADAM=1: route the update through the owned Pallas
     # multi-tensor kernel (ops/pallas_kernels/fused_adamw.py) for A/B
     # against the XLA-composed chain
     opt = pt.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
-                             multi_precision=not pure_bf16,
+                             multi_precision=regime != "bf16",
                              use_fused_kernel=os.environ.get(
                                  "BENCH_FUSED_ADAM") in ("1", "true", "True"))
 
@@ -424,14 +483,23 @@ def main():
     ids = pt.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)), dtype="int64")
     labels = pt.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)), dtype="int64")
 
-    @pt.jit.to_static
-    def train_step(ids, labels):
-        with pt.amp.auto_cast(enable=True, level="O1", dtype="bfloat16"):
-            loss = model(ids, labels=labels)
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        return loss
+    # ONE donated fused program: fwd + bwd + AdamW update (params, moments
+    # and masters alias in place; Graph Lint GL004 gates regressions here)
+    train_step = pt.optimizer.FusedTrainStep(
+        lambda ids, labels: model(ids, labels=labels), opt,
+        amp_level="O1", amp_dtype="bfloat16")
+
+    # async host->device input pipeline: a small pool of distinct host
+    # batches cycles through a depth-2 device prefetcher, so the timed
+    # loop's device_put overlaps the running step; consumer wait (the
+    # input stall the pipeline hides) is measured per batch
+    _pool = [(rng.randint(0, cfg.vocab_size, (batch, seq)),
+              rng.randint(0, cfg.vocab_size, (batch, seq)))
+             for _ in range(min(4, steps))]
+
+    def _host_batches(n):
+        for i in range(n):
+            yield _pool[i % len(_pool)]
 
     # Phase-logged protocol (round-3 postmortem: the failing child died at
     # the final sync with no indication of WHICH phase exhausted HBM).
@@ -454,21 +522,51 @@ def main():
         raise
     pt_memory.log_memory("after steady-state warmup")
 
+    from paddle_tpu.core import op_cache as pt_op_cache
+    from paddle_tpu.io import DevicePrefetcher
+
+    disp0 = train_step.dispatch_count
+    eager0 = pt_op_cache.summary()["calls"]
+    # BENCH_INPUT_MODE=sync: per-step inline host->device conversion (the
+    # no-pipeline baseline) for A/B against the default prefetch path
+    input_mode = os.environ.get("BENCH_INPUT_MODE", "prefetch")
     profile_dir = os.environ.get("BENCH_PROFILE_DIR")
     if profile_dir:
         import jax.profiler as _jprof
         _jprof.start_trace(profile_dir)
+    prefetcher = None
     try:
+        # the prefetcher is constructed INSIDE the timed window: its
+        # producer thread starts issuing device_puts immediately, and
+        # letting that head start run before t0 would flatter the
+        # prefetch arm vs the sync baseline by ~depth/steps of transfer
         t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = train_step(ids, labels)
+        if input_mode != "sync":
+            prefetcher = DevicePrefetcher(_host_batches(steps), depth=2)
+            for bids, blabels in prefetcher:
+                loss = train_step(bids, blabels)
+        else:
+            for hids, hlabels in _host_batches(steps):
+                loss = train_step(pt.to_tensor(hids, dtype="int64"),
+                                  pt.to_tensor(hlabels, dtype="int64"))
         final = float(loss)  # forces completion of the async chain
         dt = time.perf_counter() - t0
     finally:
+        if prefetcher is not None:
+            prefetcher.close()
         if profile_dir:
             _jprof.stop_trace()
             sys.stderr.write(f"bench: profile trace in {profile_dir}\n")
     assert np.isfinite(final), f"bench diverged: loss={final}"
+    pf_stats = (prefetcher.stats() if prefetcher is not None
+                else {"stall_seconds_total": float("nan")})
+    stall_share = (pf_stats["stall_seconds_total"] / dt
+                   if dt > 0 and prefetcher is not None else float("nan"))
+    # per-step dispatch count: ONE fused program per step + any eager
+    # dispatches that leaked into the timed loop (should be zero)
+    disp_fused = train_step.dispatch_count - disp0
+    disp_eager = pt_op_cache.summary()["calls"] - eager0
+    disp_per_step = (disp_fused + disp_eager) / max(steps, 1)
 
     peak_mib = pt_memory.max_memory_allocated() / 2**20
     sys.stderr.write(pt_memory.memory_summary() + "\n")
@@ -477,7 +575,6 @@ def main():
     # (cache falls back under tracing by design), but model/optimizer
     # build + data prep run eager — the hit rate here tracks how much of
     # the off-to_static surface rides the compiled fast path
-    from paddle_tpu.core import op_cache as pt_op_cache
     cache_sum = pt_op_cache.summary()
     sys.stderr.write("bench: dispatch-cache: " + json.dumps(cache_sum) + "\n")
 
@@ -498,6 +595,10 @@ def main():
         f"gpt_{name}_train_tokens_per_sec_per_chip",
         round(tokens_per_sec, 1),
         f"tokens/s (bs={batch} seq={seq} mfu={mfu:.3f} "
+        f"regime={regime} remat={cfg.recompute_interval}:"
+        f"{cfg.recompute_policy or 'full'} "
+        f"stall_share={stall_share:.4f} "
+        f"disp_per_step={disp_per_step:.2f} "
         f"peak_hbm={peak_mib:.0f}MiB hbm_cap={hbm}GiB "
         f"device='{kind}' peak_flops={peak/1e12:.0f}e12 "
         f"opcache_calls={cache_sum['calls']} "
@@ -506,7 +607,24 @@ def main():
         round(mfu / 0.45, 4),
     )
     train_costs = train_step.cost_reports()
+    # exact-FLOPs MFU: the static cost model counts the compiled program's
+    # actual FLOPs (2NK dots from dimension_numbers — remat recompute
+    # included), so this line moves when a REAL lever moves (remat policy,
+    # fused head, regime) where the heuristic token formula cannot.
+    # Companion line gpt_*_train_mfu sits next to the roofline fraction.
     if train_costs:
+        exact_flops = train_costs[0].flops
+        exact_mfu = (exact_flops * steps / dt) / peak
+        _emit(
+            f"gpt_{name}_train_mfu",
+            round(exact_mfu, 4),
+            f"frac (cost-model program flops={exact_flops / 1e9:.1f}gflop "
+            f"x{steps} steps / {dt:.3f}s / peak={peak / 1e12:.0f}e12; "
+            f"heuristic_mfu={mfu:.4f} stall_share={stall_share:.4f} "
+            f"disp_per_step={disp_per_step:.2f} regime={regime} "
+            f"on {'tpu' if on_tpu else 'cpu'})",
+            round(exact_mfu / 0.45, 4),
+        )
         _emit_roofline("train", name, [(train_costs[0], steps)], spec, dt,
                        on_tpu)
 

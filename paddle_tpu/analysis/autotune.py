@@ -46,14 +46,43 @@ from .codes import (TILE_LANE, TILE_SUBLANE, decode_gate_reason,
                     paged_gate_reason, ragged_gate_reason)
 
 __all__ = [
-    "KERNELS", "enumerate_candidates", "default_params", "static_rank",
-    "vmem_bytes_estimate", "table_key", "AutotuneTable", "table_path",
-    "load_table", "reset", "kernel_params", "force", "set_entry",
-    "validate_table", "sweep",
+    "KERNELS", "REMAT_POLICIES", "enumerate_candidates", "default_params",
+    "static_rank", "vmem_bytes_estimate", "table_key", "AutotuneTable",
+    "table_path", "load_table", "reset", "kernel_params", "force",
+    "set_entry", "validate_table", "sweep", "remat_params_to_config",
+    "remat_config_to_params",
 ]
 
 KERNELS = ("flash_attention", "decode_attention", "paged_attention",
-           "ragged_paged_attention")
+           "ragged_paged_attention", "train_remat")
+
+# train_remat: the measured remat-policy search over the stacked-GPT train
+# step — not a Pallas kernel, but the same shape-keyed persisted-table
+# discipline.  Candidates are (recompute_interval, recompute_policy) pairs
+# encoded as ints (the table stores ints); policy index -> config string:
+REMAT_POLICIES = (None, "full", "dots")
+# interval 0 == remat off entirely (policy must be 0 then); k >= 1 groups
+# k blocks per checkpoint boundary on the stacked scan (pp_spmd.scan_blocks)
+_REMAT_MAX_INTERVAL = 8
+
+
+def remat_params_to_config(params: Dict[str, int]):
+    """Table entry -> (recompute_interval, recompute_policy) as
+    GPTConfig understands them.  ``(0, None)`` means remat off."""
+    interval = int(params.get("interval", 1))
+    policy = REMAT_POLICIES[int(params.get("policy", 1))]
+    if interval == 0:
+        return 0, None
+    return interval, policy
+
+
+def remat_config_to_params(interval: int, policy) -> Dict[str, int]:
+    if interval <= 0:
+        return {"interval": 0, "policy": 0}
+    if policy is None:
+        policy = "full"
+    return {"interval": int(interval),
+            "policy": REMAT_POLICIES.index(policy)}
 
 # static VMEM budget for candidate filtering: ~16 MiB/core physical, keep
 # headroom for Mosaic's own buffers and semaphores
@@ -100,6 +129,8 @@ def vmem_bytes_estimate(kernel: str, shape: Dict[str, int], dtype: str,
     blocks (double-buffered — Pallas pipelines the DMA) plus the fp32
     scratch accumulators.  Deliberately conservative; its job is to reject
     candidates that cannot fit, not to model occupancy."""
+    if kernel == "train_remat":
+        return 0  # whole-program HBM trade, not a VMEM-resident kernel
     it = _itemsize(dtype)
     d = int(shape["head_dim"])
     if kernel == "flash_attention":
@@ -144,7 +175,7 @@ def enumerate_candidates(kernel: str, shape: Dict[str, int],
     shared tile rules + the VMEM estimate.  Empty when the kernel's own
     eligibility gate rejects the shape (then there is nothing to tune —
     the kernel would fall back to XLA anyway)."""
-    d = int(shape["head_dim"])
+    d = int(shape.get("head_dim", 0))  # train_remat keys carry no head_dim
     out: List[Dict[str, int]] = []
     if kernel == "flash_attention":
         seq = int(shape["seq"])
@@ -172,6 +203,14 @@ def enumerate_candidates(kernel: str, shape: Dict[str, int],
             return []
         for tb in _TOKEN_BLOCK_CHOICES:
             out.append({"token_block": tb})
+    elif kernel == "train_remat":
+        L = int(shape["layers"])
+        out.append({"interval": 0, "policy": 0})  # remat off
+        for k in range(1, min(L, _REMAT_MAX_INTERVAL) + 1):
+            if L % k:
+                continue  # grouped scan needs L % interval == 0
+            for pol in (1, 2):  # full, dots
+                out.append({"interval": k, "policy": pol})
     else:
         raise ValueError(
             f"unknown kernel {kernel!r} (expected one of {KERNELS})")
@@ -192,6 +231,9 @@ def default_params(kernel: str, shape: Dict[str, int],
         return {"q_rows": 8}
     if kernel == "ragged_paged_attention":
         return {"token_block": 8}
+    if kernel == "train_remat":
+        # the historical bench default: full remat, per-block boundary
+        return {"interval": 1, "policy": 1}
     raise ValueError(f"unknown kernel {kernel!r} (expected one of {KERNELS})")
 
 
@@ -212,6 +254,10 @@ def static_rank(kernel: str, shape: Dict[str, int], dtype: str,
             return (seq // p["block_q"]) * (seq // p["block_kv"])
         if kernel == "decode_attention":
             return int(shape["max_seq"]) // p["block_kv"]
+        if kernel == "train_remat":
+            # prior: least recompute work first (off < dots < full), then
+            # tighter boundaries (smaller interval = lower peak residency)
+            return {0: 0, 2: 1, 1: 2}[p["policy"]] * 100 + p["interval"]
         return 1  # paged: the grid is fixed by max_pages
 
     return sorted(cands, key=lambda p: (

@@ -71,18 +71,53 @@ def _checkpoint(fn, policy):
 
 
 def scan_blocks(block_fn: Callable, stacked: Sequence, x, *, remat: bool = False,
-                remat_policy: str | None = None):
+                remat_policy: str | None = None, remat_interval: int = 1):
     """Run L stacked homogeneous blocks sequentially: x -> block(p_i, x).
 
     ``block_fn(params_tuple, x) -> y`` with params_tuple holding one
     layer's slices. ``stacked`` is a tuple of [L, ...] arrays.
+
+    ``remat_interval`` groups the rematerialisation boundary: ``k > 1``
+    reshapes the stacked leading dim to [L/k, k, ...] and checkpoints a
+    k-block group body, so backward saves only every k-th block boundary
+    (1/k the saved residuals) at the cost of k blocks' activations live
+    during each group's recompute.  Identical math to ``k == 1`` — same
+    block sequence, each block recomputed exactly once — so the
+    (interval, policy) pair is a pure memory/locality trade the measured
+    autotune search can explore (docs/training_perf.md).  Requires
+    ``L % k == 0``.
     """
-    body = _checkpoint(block_fn, remat_policy) if remat else block_fn
+    k = int(remat_interval) if remat else 1
+    if k <= 1:
+        body = _checkpoint(block_fn, remat_policy) if remat else block_fn
 
-    def step(h, params):
-        return body(params, h), None
+        def step(h, params):
+            return body(params, h), None
 
-    out, _ = jax.lax.scan(step, x, tuple(stacked))
+        out, _ = jax.lax.scan(step, x, tuple(stacked))
+        return out
+
+    L = int(np.shape(stacked[0])[0])
+    if L % k != 0:
+        raise ValueError(
+            f"remat_interval={k} must divide the stacked layer count {L}")
+
+    def group(params_group, h):
+        # k consecutive blocks under ONE checkpoint boundary
+        def inner(carry, params):
+            return block_fn(params, carry), None
+
+        h2, _ = jax.lax.scan(inner, h, params_group)
+        return h2
+
+    gbody = _checkpoint(group, remat_policy)
+    grouped = tuple(a.reshape((L // k, k) + tuple(a.shape[1:]))
+                    for a in stacked)
+
+    def step(h, params_group):
+        return gbody(params_group, h), None
+
+    out, _ = jax.lax.scan(step, x, grouped)
     return out
 
 

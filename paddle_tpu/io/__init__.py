@@ -8,3 +8,4 @@ workers use the same worker-loop design when num_workers>0.
 from .dataset import ChainDataset, ComposeDataset, ConcatDataset, Dataset, IterableDataset, Subset, TensorDataset, random_split  # noqa: F401
 from .sampler import BatchSampler, DistributedBatchSampler, RandomSampler, Sampler, SequenceSampler, WeightedRandomSampler  # noqa: F401
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .device_prefetch import DevicePrefetcher  # noqa: F401

@@ -130,6 +130,27 @@ class DataLoader:
             raise TypeError("IterableDataset DataLoader has no len()")
         return len(self.batch_sampler)
 
+    @property
+    def prefetch_window(self) -> int:
+        """Depth of the in-flight batch pipeline.  ``num_workers *
+        prefetch_factor`` is the multiprocess window, but computed
+        unclamped it collapses to a 0-deep pipeline for the common
+        single-process ``num_workers == 0`` path — treat the consumer
+        process as one worker there, so ``prefetch_factor`` keeps its
+        meaning (a depth-``prefetch_factor`` background pipeline) and
+        the window is always >= 1."""
+        return max(self.num_workers, 1) * self.prefetch_factor
+
+    def device_prefetch(self, depth: int = 2, sharding=None):
+        """Wrap iteration in a :class:`~paddle_tpu.io.DevicePrefetcher`:
+        up to ``depth`` batches are ``device_put`` (with ``sharding`` when
+        given) ahead of the consumer, overlapping host->device transfer
+        with the running step; consumer wait lands in the
+        ``train_input_stall_seconds`` histogram."""
+        from .device_prefetch import DevicePrefetcher
+
+        return DevicePrefetcher(iter(self), depth=depth, sharding=sharding)
+
     def _batches(self):
         if self._iterable_mode:
             batch = []
@@ -169,7 +190,7 @@ class DataLoader:
         try:
             all_batches = list(self.batch_sampler)
             n = len(all_batches)
-            window = self.num_workers * self.prefetch_factor
+            window = self.prefetch_window
             sent = 0
             for sent in range(min(window, n)):
                 index_queues[sent % self.num_workers].put(
@@ -227,8 +248,9 @@ class DataLoader:
         if not self.use_buffer_reader:
             yield from self._batches()
             return
-        # background prefetch thread (async host pipeline)
-        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_factor)
+        # background prefetch thread (async host pipeline); window clamped
+        # >= 1 even at num_workers == 0 (the single-process bench path)
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch_window)
         sentinel = object()
         err = []
         # consumer-side shutdown signal: a consumer that breaks out of

@@ -1,0 +1,197 @@
+"""Async host->device input pipeline (double-buffered ``device_put``).
+
+The train step's input stall is pure pipeline bubble: the compiled step
+cannot launch until the batch's host->device transfer lands, so a
+synchronous ``to_tensor`` at the top of the loop serializes PCIe/ICI
+transfer time into every step.  :class:`DevicePrefetcher` overlaps it — a
+background thread pulls host batches from the source iterable, issues the
+``jax.device_put`` for up to ``depth`` batches ahead of the consumer
+(XLA's transfer engine runs them asynchronously), and the consumer pops
+already-landing device batches.  Steady state, the next batch's transfer
+runs concurrently with the current step's compute and ``__next__``
+returns without blocking.
+
+Observability: every ``__next__`` records how long the consumer actually
+waited into the process-wide telemetry histogram
+``train_input_stall_seconds`` (docs/observability.md) and into
+``stats()`` — bench.py reports the stall share of the measured train
+window from it.
+
+Sharding-aware: pass a ``jax.sharding.Sharding`` (e.g. a NamedSharding
+over the 'dp' axis for the multichip dryrun path) and batches land
+pre-placed for the SPMD step instead of being re-laid-out at dispatch.
+
+Buffer lifetime: landing buffers are owned by the consumer once popped —
+step args are not donated, so the batch dies by refcount as soon as the
+step that consumed it retires (at most ``depth + 1`` batches are ever
+resident).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Iterable, Iterator, Optional
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["DevicePrefetcher"]
+
+
+def _histogram():
+    from ..telemetry import registry
+
+    return registry().histogram(
+        "train_input_stall_seconds",
+        help="time the training loop blocked waiting for the next "
+             "device-resident batch (0 when the prefetch pipeline is ahead)",
+        unit="seconds")
+
+
+def _put_tree(obj, sharding, wrap: bool):
+    """Host tree -> device tree: numpy leaves through ``jax.device_put``
+    (with ``sharding`` when given), Tensor leaves re-placed only when a
+    sharding is requested; containers recurse."""
+    import jax
+
+    if isinstance(obj, Tensor):
+        if sharding is not None:
+            return Tensor(jax.device_put(obj._value, sharding),
+                          stop_gradient=obj.stop_gradient)
+        return obj
+    if isinstance(obj, np.ndarray):
+        raw = jax.device_put(obj, sharding)
+        return Tensor(raw) if wrap else raw
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_put_tree(o, sharding, wrap) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _put_tree(v, sharding, wrap) for k, v in obj.items()}
+    return obj
+
+
+class DevicePrefetcher:
+    """Iterate device-resident batches ``depth`` ahead of the consumer.
+
+    ``source`` is any iterable of batch trees with numpy / Tensor leaves
+    (a :class:`~paddle_tpu.io.DataLoader`, a generator of numpy tuples,
+    ...).  numpy leaves are ``device_put`` and wrapped as Tensors
+    (``wrap_tensors=False`` keeps raw jax arrays); Tensor leaves pass
+    through (re-placed when ``sharding`` is given).
+
+    The background thread owns the transfers; the consumer's ``next()``
+    measures its own wait (the input stall the pipeline exists to hide)
+    into both :func:`stats` and the ``train_input_stall_seconds``
+    histogram.  Errors in the source or the transfer re-raise in the
+    consumer; ``close()`` (also on ``with`` exit / early ``break`` via
+    ``__del__``) retires the thread without draining the source.
+    """
+
+    def __init__(self, source: Iterable, depth: int = 2,
+                 sharding=None, wrap_tensors: bool = True):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.depth = int(depth)
+        self._sharding = sharding
+        self._wrap = wrap_tensors
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._sentinel = object()
+        self._err: list = []
+        self._stop = threading.Event()
+        self._stall_total = 0.0
+        self._batches = 0
+        self._hist = _histogram()
+        self._thread = threading.Thread(
+            target=self._producer, args=(iter(source),), daemon=True)
+        self._thread.start()
+
+    # -- producer ----------------------------------------------------------
+    def _producer(self, it: Iterator):
+        try:
+            for batch in it:
+                dev = _put_tree(batch, self._sharding, self._wrap)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(dev, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised in consumer
+            self._err.append(e)
+        finally:
+            # same shutdown discipline as DataLoader's prefetch producer:
+            # wait for space on the normal path (never displace a real
+            # batch); force-place on shutdown so nothing ever blocks
+            placed = False
+            while not self._stop.is_set():
+                try:
+                    self._q.put(self._sentinel, timeout=0.1)
+                    placed = True
+                    break
+                except queue.Full:
+                    continue
+            while not placed:
+                try:
+                    self._q.put_nowait(self._sentinel)
+                    placed = True
+                except queue.Full:
+                    try:
+                        self._q.get_nowait()
+                    except queue.Empty:
+                        pass
+
+    # -- consumer ----------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        t0 = time.perf_counter()
+        item = self._q.get()
+        stall = time.perf_counter() - t0
+        if item is self._sentinel:
+            self.close()
+            if self._err:
+                raise self._err[0]
+            raise StopIteration
+        self._stall_total += stall
+        self._batches += 1
+        self._hist.observe(stall)
+        return item
+
+    def stats(self) -> dict:
+        """``{"batches", "stall_seconds_total", "stall_seconds_mean"}`` for
+        the batches consumed so far."""
+        n = self._batches
+        return {
+            "batches": n,
+            "stall_seconds_total": self._stall_total,
+            "stall_seconds_mean": (self._stall_total / n) if n else 0.0,
+        }
+
+    def close(self):
+        """Retire the producer thread; safe to call more than once."""
+        self._stop.set()
+        while True:  # drain so a blocked put releases immediately
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=0.5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001
+            pass

@@ -207,6 +207,10 @@ class StaticFunction:
 
         self._fn = convert_to_static(fn)
         self._cache: Dict[Any, _CompiledEntry] = {}
+        # compiled-program executions (shared holder so bound copies from
+        # __get__ keep one count); bench/gates read dispatch_count to
+        # assert "one program dispatch per train step"
+        self._dispatches: List[int] = [0]
         functools.update_wrapper(self, fn)
         _STATIC_REGISTRY.add(self)
 
@@ -214,12 +218,17 @@ class StaticFunction:
     def code_cache(self):
         return self._cache
 
+    @property
+    def dispatch_count(self) -> int:
+        return self._dispatches[0]
+
     def __get__(self, instance, owner):
         if instance is None:
             return self
         bound = StaticFunction.__new__(StaticFunction)
         bound._fn = self._fn.__get__(instance, owner)
         bound._cache = self._cache  # share compiled programs per class fn
+        bound._dispatches = self._dispatches
         return bound
 
     def __call__(self, *args, **kwargs):
@@ -288,8 +297,8 @@ class StaticFunction:
     def _span_name(self) -> str:
         return f"jit.{getattr(self._fn, '__name__', 'program')}"
 
-    @staticmethod
-    def _run_compiled(entry, arg_tensors):
+    def _run_compiled(self, entry, arg_tensors):
+        self._dispatches[0] += 1
         raw_args = [t._value for t in arg_tensors]
         raw_mut = [t._value for t in entry.mut_caps]
         raw_ro = [t._value for t in entry.ro_caps]

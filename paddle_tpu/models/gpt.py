@@ -1008,9 +1008,20 @@ class GPTStackedDecoder(Layer):
                     n_virtual=cfg.virtual_pp_degree)
                 return out.reshape(b, *h.shape[1:])
         else:
+            # recompute_interval > 1 groups the remat boundary on the
+            # stacked scan: [L/k, k] groups, one checkpoint per group —
+            # same math, 1/k the saved residuals (the measured remat
+            # search in analysis/autotune enumerates (interval, policy))
+            k_remat = cfg.recompute_interval if remat else 1
+            if remat and k_remat > 1 and cfg.num_layers % k_remat != 0:
+                raise ValueError(
+                    f"recompute_interval={k_remat} must divide "
+                    f"num_layers={cfg.num_layers} on the stacked scan")
+
             def raw(h, *stacked):
                 return pp_spmd.scan_blocks(block, stacked, h, remat=remat,
-                                           remat_policy=remat_policy)
+                                           remat_policy=remat_policy,
+                                           remat_interval=k_remat)
 
         return dispatch.apply(raw, hidden, *stacked_in,
                               op_name="gpt_stacked_decoder")

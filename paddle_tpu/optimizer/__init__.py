@@ -1,4 +1,5 @@
 """optimizer namespace (reference: python/paddle/optimizer/__init__.py)."""
 from . import lr  # noqa: F401
+from .fused_step import FusedTrainStep  # noqa: F401
 from .optimizer import Optimizer  # noqa: F401
 from .optimizers import SGD, Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum, RMSProp  # noqa: F401

@@ -202,6 +202,13 @@ class Optimizer:
             for i, p in enumerate(self._parameter_list):
                 if id(p) in store:
                     sd[f"{name}_{i}"] = store[id(p)]
+        # fp32 master weights (multi_precision Adam/AdamW): without these a
+        # resumed bf16 run would re-seed masters from the ROUNDED bf16
+        # params, silently re-quantizing the fp32 trajectory mid-training
+        for i, p in enumerate(self._parameter_list):
+            m = getattr(self, "_master", {}).get(id(p))
+            if m is not None:
+                sd[f"master_{i}"] = m
         for k, t in self._aux_state.items():
             sd[f"aux_{k}"] = t
         if isinstance(self._learning_rate, LRScheduler):
@@ -217,6 +224,13 @@ class Optimizer:
                     store[id(p)]._set_value(
                         v._value if isinstance(v, Tensor) else jnp.asarray(v)
                     )
+        for i, p in enumerate(self._parameter_list):
+            m = getattr(self, "_master", {}).get(id(p))
+            key = f"master_{i}"
+            if m is not None and key in state_dict:
+                v = state_dict[key]
+                m._set_value(
+                    v._value if isinstance(v, Tensor) else jnp.asarray(v))
         # aux scalars (Adam/Adamax beta-power accumulators): state_dict()
         # always saved these, but restore dropped them — a resumed Adam run
         # silently restarted bias correction at t=0, breaking deterministic

@@ -33,7 +33,13 @@
 # nested serving-phase spans, Prometheus exposition parses; see
 # docs/observability.md).  PADDLE_TPU_SKIP_OBS_GATE=1 skips it.
 #
-# A distributed fault-tolerance gate runs seventh (tools/dist_fault_gate.py
+# A train-perf gate runs seventh (tools/train_perf_gate.py — the fused
+# train step must stay ONE program with one dispatch per step, GL004-clean
+# donation over params/moments/masters, an accounting-exact device input
+# pipeline, and CPU tokens/sec above the recorded floor; see
+# docs/training_perf.md).  PADDLE_TPU_SKIP_TRAIN_PERF_GATE=1 skips it.
+#
+# A distributed fault-tolerance gate runs eighth (tools/dist_fault_gate.py
 # — real multi-process scenarios: kill-a-rank mid-collective must raise a
 # typed PeerLostError within 2x the detector TTL, a restarted rank must
 # never consume a prior generation's store keys, randomized store-outage
@@ -99,6 +105,15 @@ if [ -z "$PADDLE_TPU_SKIP_OBS_GATE" ]; then
     python "$(dirname "$0")/tools/obs_gate.py" || {
         rc=$?
         echo "run_tests: telemetry gate FAILED (rc=$rc)"
+        exit $rc
+    }
+fi
+
+if [ -z "$PADDLE_TPU_SKIP_TRAIN_PERF_GATE" ]; then
+    echo "run_tests: train-perf gate (tools/train_perf_gate.py)"
+    python "$(dirname "$0")/tools/train_perf_gate.py" || {
+        rc=$?
+        echo "run_tests: train-perf gate FAILED (rc=$rc)"
         exit $rc
     }
 fi

@@ -1,0 +1,443 @@
+"""Train-path MFU push: fused donated train step, async device input
+pipeline, bf16 params with fp32 master weights, measured remat-policy
+search (docs/training_perf.md).
+
+Invariants pinned here:
+- FusedTrainStep compiles EXACTLY one program per input signature and
+  every step is one compiled dispatch;
+- fp32 mode through FusedTrainStep is BITWISE the legacy inline
+  jit.to_static step (this PR must not move fp32 numerics);
+- the bf16+master regime's fp32 masters track the fp32 reference within
+  bf16-expected tolerance, and masters survive state_dict /
+  CheckpointManager round-trips bitwise (the PR 4 resume invariant
+  extended to multi_precision);
+- the traced GradScaler protocol skips non-finite steps without touching
+  any optimizer state and drives the dynamic scale as traced state;
+- grouped remat (recompute_interval k > 1 on the stacked scan) is
+  numerically identical to per-block remat;
+- the DataLoader prefetch window clamps to >= 1 at num_workers == 0;
+- DevicePrefetcher preserves order, accounts stalls, propagates errors;
+- the autotune train_remat search space enumerates/validates/dispatches
+  under the shared table discipline.
+"""
+import hashlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.io import DataLoader, DevicePrefetcher
+from paddle_tpu.io.dataset import Dataset
+from paddle_tpu.models import GPTStackedForPretraining, gpt_tiny
+from paddle_tpu.optimizer import FusedTrainStep
+
+
+def _batch(cfg, seed=1, b=2, s=16):
+    rng = np.random.RandomState(seed)
+    ids = pt.to_tensor(rng.randint(0, cfg.vocab_size, (b, s)), dtype="int64")
+    labels = pt.to_tensor(rng.randint(0, cfg.vocab_size, (b, s)),
+                          dtype="int64")
+    return ids, labels
+
+
+def _build(seed=0, regime="fp32", interval=1, policy=None, grad_clip=None):
+    pt.seed(seed)
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0,
+                   recompute_interval=interval, recompute_policy=policy)
+    model = GPTStackedForPretraining(cfg)
+    if regime in ("bf16", "master"):
+        pt.amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters(),
+                             multi_precision=regime != "bf16",
+                             grad_clip=grad_clip)
+    return cfg, model, opt
+
+
+def _param_sha(model) -> str:
+    h = hashlib.sha256()
+    for name, t in sorted(model.state_dict().items()):
+        h.update(name.encode())
+        h.update(np.asarray(t._value).tobytes())
+    return h.hexdigest()
+
+
+class TestFusedTrainStep:
+    def test_compiles_exactly_once(self):
+        """Trace-counter invariant: N same-shape steps = 1 program,
+        N compiled dispatches."""
+        cfg, model, opt = _build()
+        step = FusedTrainStep(lambda i, l: model(i, labels=l), opt)
+        ids, labels = _batch(cfg)
+        for _ in range(4):
+            loss = step(ids, labels)
+        assert np.isfinite(float(loss))
+        assert step.program_count == 1
+        assert step.dispatch_count == 4
+        assert step.last_step_applied
+
+    def test_fp32_bitwise_vs_legacy_inline_step(self):
+        """fp32 mode through FusedTrainStep is BITWISE the hand-rolled
+        jit.to_static loss.backward(); opt.step() wrapper."""
+        cfg, m1, o1 = _build(seed=7)
+        ids, labels = _batch(cfg, seed=3)
+
+        @pt.jit.to_static
+        def legacy(ids, labels):
+            loss = m1(ids, labels=labels)
+            loss.backward()
+            o1.step()
+            o1.clear_grad()
+            return loss
+
+        ref = [float(legacy(ids, labels)) for _ in range(4)]
+        ref_sha = _param_sha(m1)
+
+        cfg, m2, o2 = _build(seed=7)
+        ids, labels = _batch(cfg, seed=3)
+        step = FusedTrainStep(lambda i, l: m2(i, labels=l), o2)
+        got = [float(step(ids, labels)) for _ in range(4)]
+        assert got == ref  # exact float equality
+        assert _param_sha(m2) == ref_sha
+
+    def test_master_weights_track_fp32_reference(self):
+        """bf16 params + fp32 masters: the update runs on the masters, so
+        the loss curve and the master values track the fp32 reference
+        within bf16-forward-noise tolerance (the pure-bf16 regime drifts
+        much further — that is the regime gap masters close)."""
+        cfg, mf, of = _build(seed=11, regime="fp32")
+        ids, labels = _batch(cfg, seed=5)
+        sf = FusedTrainStep(lambda i, l: mf(i, labels=l), of)
+        ref = [float(sf(ids, labels)) for _ in range(5)]
+
+        cfg, mm, om = _build(seed=11, regime="master")
+        ids, labels = _batch(cfg, seed=5)
+        sm = FusedTrainStep(lambda i, l: mm(i, labels=l), om,
+                            amp_level="O1", amp_dtype="bfloat16")
+        got = [float(sm(ids, labels)) for _ in range(5)]
+        # bf16 forward noise bounds the loss gap; the curve must not drift
+        np.testing.assert_allclose(got, ref, rtol=2e-2, atol=2e-2)
+        # every master stays close to its fp32-reference counterpart (the
+        # parameter lists build in the same order under the same seed)
+        n_masters = 0
+        for p_ref, p in zip(of._parameter_list, om._parameter_list):
+            master = om._master.get(id(p))
+            if master is None:
+                continue
+            n_masters += 1
+            assert master._value.dtype == np.float32
+            np.testing.assert_allclose(
+                np.asarray(master._value), np.asarray(p_ref._value),
+                atol=1e-2, rtol=0.2)
+        assert n_masters > 0  # bf16 params actually have masters
+
+    def test_traced_scaler_skips_nonfinite_step(self):
+        """An overflowing scaled grad leaves params/moments/masters/scale
+        counters consistent: params bitwise-unchanged, scale decayed; the
+        next finite steps apply and regrow the scale — all without a host
+        sync inside the step."""
+        from paddle_tpu.tensor import Parameter
+        import jax.numpy as jnp
+
+        p = Parameter(jnp.ones((4, 4), jnp.float16))
+        opt = pt.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = pt.amp.GradScaler(enable=True, init_loss_scaling=2.0 ** 14,
+                                   incr_every_n_steps=2,
+                                   decr_every_n_nan_or_inf=1)
+        step = FusedTrainStep(lambda x: (p * x).astype("float32").sum(),
+                              opt, scaler=scaler)
+        x_ok = pt.to_tensor(np.full((4, 4), 0.01, np.float16))
+        x_bad = pt.to_tensor(np.full((4, 4), np.float16(60000)))
+        before = np.asarray(p._value).copy()
+        step(x_bad)  # scaled grad overflows fp16
+        assert not step.last_step_applied
+        assert np.array_equal(before, np.asarray(p._value))
+        assert float(np.asarray(scaler._scale._value)) == 2.0 ** 13
+        step(x_ok)
+        assert step.last_step_applied
+        assert not np.array_equal(before, np.asarray(p._value))
+        step(x_ok)  # second consecutive good step -> scale grows
+        assert float(np.asarray(scaler._scale._value)) == 2.0 ** 14
+        assert step.program_count == 1  # one program serves all of it
+
+    def test_rejects_unknown_amp_level(self):
+        cfg, model, opt = _build()
+        with pytest.raises(ValueError):
+            FusedTrainStep(lambda i, l: model(i, labels=l), opt,
+                           amp_level="O2")
+
+
+class TestMasterWeightCheckpoint:
+    def test_state_dict_carries_masters(self):
+        cfg, model, opt = _build(regime="master")
+        ids, labels = _batch(cfg)
+        step = FusedTrainStep(lambda i, l: model(i, labels=l), opt,
+                              amp_level="O1")
+        float(step(ids, labels))
+        sd = opt.state_dict()
+        masters = [k for k in sd if k.startswith("master_")]
+        assert masters
+        # restore into a fresh optimizer: masters land bitwise
+        cfg2, m2, o2 = _build(seed=123, regime="master")
+        o2.set_state_dict(sd)
+        for i, (p, p2) in enumerate(zip(opt._parameter_list,
+                                        o2._parameter_list)):
+            m, m2_ = opt._master.get(id(p)), o2._master.get(id(p2))
+            if m is not None:
+                assert m2_ is not None
+                assert np.array_equal(np.asarray(m._value),
+                                      np.asarray(m2_._value))
+
+    def test_master_resume_bitwise(self, tmp_path):
+        """train(4) == train(2); checkpoint through CheckpointManager;
+        restore into a FRESH model; train(2) — bitwise (PR 4 invariant
+        extended across fp32 master weights)."""
+        from paddle_tpu.checkpoint import CheckpointManager, TrainState
+
+        def setup(seed):
+            cfg, model, opt = _build(seed=seed, regime="master")
+            step = FusedTrainStep(lambda i, l: model(i, labels=l), opt,
+                                  amp_level="O1")
+            ids, labels = _batch(cfg, seed=9)
+            return model, opt, step, ids, labels
+
+        m, o, s, ids, labels = setup(0)
+        ref = [float(s(ids, labels)) for _ in range(4)]
+        ref_sha = _param_sha(m)
+
+        m1, o1, s1, ids, labels = setup(0)
+        pre = [float(s1(ids, labels)) for _ in range(2)]
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(TrainState(m1, o1).capture(position={"step": 2}), step=2)
+        mgr.wait()
+
+        m2, o2, s2, ids, labels = setup(999)  # different init
+        tree, _ = mgr.restore()
+        TrainState(m2, o2).restore(tree)
+        post = [float(s2(ids, labels)) for _ in range(2)]
+        assert pre == ref[:2]
+        assert post == ref[2:]  # bitwise resume incl. masters
+        assert _param_sha(m2) == ref_sha
+
+
+class TestRematInterval:
+    @pytest.mark.parametrize("interval,policy", [(2, "full"), (2, "dots"),
+                                                 (1, "dots")])
+    def test_grouped_remat_numeric_parity(self, interval, policy):
+        """Grouped remat boundaries change memory, never math: losses are
+        exactly the per-block remat run's."""
+        def run(k, pol):
+            cfg, model, opt = _build(seed=4, interval=k, policy=pol)
+            step = FusedTrainStep(lambda i, l: model(i, labels=l), opt)
+            ids, labels = _batch(cfg, seed=2)
+            return [float(step(ids, labels)) for _ in range(3)]
+
+        assert run(interval, policy) == run(1, "full")
+
+    def test_interval_must_divide_layers(self):
+        cfg, model, opt = _build(seed=4, interval=5)  # gpt_tiny: 2 layers
+        model.train()
+        step = FusedTrainStep(lambda i, l: model(i, labels=l), opt)
+        ids, labels = _batch(cfg)
+        with pytest.raises(ValueError, match="must divide"):
+            step(ids, labels)
+
+
+class TestDataLoaderPrefetchWindow:
+    def test_window_clamped_at_zero_workers(self):
+        """Regression: num_workers * prefetch_factor == 0 collapsed the
+        single-process pipeline to depth 0 — clamp to >= 1."""
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return np.int64(i)
+
+            def __len__(self):
+                return 8
+
+        dl = DataLoader(DS(), batch_size=2, num_workers=0)
+        assert dl.prefetch_window >= 1
+        # prefetch_factor keeps its meaning in single-process mode: the
+        # buffered reader's queue must stay prefetch_factor deep, not 1
+        dl4 = DataLoader(DS(), batch_size=2, num_workers=0,
+                         prefetch_factor=4)
+        assert dl4.prefetch_window == 4
+        dl2 = DataLoader(DS(), batch_size=2, num_workers=3,
+                         prefetch_factor=4)
+        assert dl2.prefetch_window == 12
+        # the clamped window still iterates correctly
+        out = [np.asarray(b._value).tolist() for b in dl]
+        assert out == [[0, 1], [2, 3], [4, 5], [6, 7]]
+
+    def test_dataloader_device_prefetch(self):
+        class DS(Dataset):
+            def __getitem__(self, i):
+                return np.full((3,), i, np.float32)
+
+            def __len__(self):
+                return 6
+
+        dl = DataLoader(DS(), batch_size=2, num_workers=0)
+        pf = dl.device_prefetch(depth=2)
+        got = [np.asarray(b._value)[:, 0].tolist() for b in pf]
+        assert got == [[0.0, 1.0], [2.0, 3.0], [4.0, 5.0]]
+        assert pf.stats()["batches"] == 3
+
+
+class TestDevicePrefetcher:
+    def test_order_and_stats(self):
+        def gen():
+            for i in range(5):
+                yield {"x": np.full((2,), i, np.float32),
+                       "pair": (np.int64(i), [np.float32(i)])}
+
+        pf = DevicePrefetcher(gen(), depth=2)
+        seen = []
+        for b in pf:
+            assert isinstance(b, dict)
+            seen.append(int(np.asarray(b["x"]._value)[0]))
+        assert seen == [0, 1, 2, 3, 4]
+        st = pf.stats()
+        assert st["batches"] == 5
+        assert st["stall_seconds_total"] >= 0.0
+
+    def test_stall_histogram_records_per_batch(self):
+        from paddle_tpu.telemetry import registry
+
+        hist = registry().histogram("train_input_stall_seconds")
+        before = hist.summary().get("count", 0)
+        pf = DevicePrefetcher((np.zeros((2,), np.float32)
+                               for _ in range(4)), depth=1)
+        assert sum(1 for _ in pf) == 4
+        assert hist.summary().get("count", 0) - before == 4
+
+    def test_source_error_propagates(self):
+        def gen():
+            yield np.zeros((2,), np.float32)
+            raise RuntimeError("boom in source")
+
+        pf = DevicePrefetcher(gen(), depth=2)
+        next(pf)
+        with pytest.raises(RuntimeError, match="boom in source"):
+            for _ in pf:
+                pass
+
+    def test_early_close_releases_producer(self):
+        def gen():
+            for i in range(100):
+                yield np.full((2,), i, np.float32)
+
+        pf = DevicePrefetcher(gen(), depth=2)
+        next(pf)
+        pf.close()
+        assert not pf._thread.is_alive()
+        with pytest.raises(StopIteration):
+            next(pf)
+
+    def test_wrap_tensors_false_yields_raw_arrays(self):
+        import jax
+
+        pf = DevicePrefetcher((np.ones((2,), np.float32) for _ in range(2)),
+                              depth=1, wrap_tensors=False)
+        b = next(pf)
+        assert isinstance(b, jax.Array)
+        pf.close()
+
+    def test_invalid_depth(self):
+        with pytest.raises(ValueError):
+            DevicePrefetcher(iter(()), depth=0)
+
+
+class TestTrainRematAutotune:
+    SHAPE = {"layers": 12, "hidden": 768, "batch": 16, "seq": 1024}
+
+    def test_enumeration_and_defaults(self):
+        from paddle_tpu.analysis import autotune
+
+        cands = autotune.enumerate_candidates("train_remat", self.SHAPE,
+                                              "bfloat16")
+        assert {"interval": 0, "policy": 0} in cands  # remat off
+        assert {"interval": 1, "policy": 1} in cands  # historical default
+        for c in cands:
+            k = c["interval"]
+            assert k == 0 or self.SHAPE["layers"] % k == 0
+        assert autotune.default_params("train_remat", self.SHAPE,
+                                       "bfloat16") == {"interval": 1,
+                                                       "policy": 1}
+
+    def test_param_config_mapping_roundtrip(self):
+        from paddle_tpu.analysis import autotune
+
+        assert autotune.remat_params_to_config(
+            {"interval": 0, "policy": 0}) == (0, None)
+        assert autotune.remat_params_to_config(
+            {"interval": 2, "policy": 2}) == (2, "dots")
+        for iv, pol in [(0, None), (1, "full"), (4, "dots")]:
+            params = autotune.remat_config_to_params(iv, pol)
+            assert autotune.remat_params_to_config(params) == (
+                (iv, pol) if iv > 0 else (0, None))
+
+    def test_table_roundtrip_and_dispatch(self, tmp_path, monkeypatch):
+        from paddle_tpu.analysis import autotune
+
+        path = str(tmp_path / "table.json")
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_TABLE", path)
+        autotune.reset()
+        try:
+            t = autotune.AutotuneTable()
+            t.put("train_remat", self.SHAPE, "bfloat16",
+                  {"interval": 2, "policy": 2}, measured_us=123.0,
+                  source="measured", device="test")
+            assert autotune.validate_table(t) == []
+            t.save(path)
+            autotune.reset()
+            got = autotune.kernel_params("train_remat", self.SHAPE,
+                                         "bfloat16")
+            assert got == {"interval": 2, "policy": 2}
+            # an illegal entry fails strict replay
+            t.put("train_remat", self.SHAPE, "bfloat16",
+                  {"interval": 5, "policy": 1})
+            t.save(path)
+            with pytest.raises(ValueError):
+                autotune.load_table(path, strict=True)
+        finally:
+            autotune.reset()
+
+    def test_committed_table_covers_bench_train_shapes(self):
+        """The packaged table seeds train_remat entries for the bench
+        ladder's pure-bf16 rungs, so bench dispatch flows through the
+        table before any chip measured anything."""
+        from paddle_tpu.analysis import autotune
+
+        table = autotune.load_table(os.path.join(
+            os.path.dirname(autotune.__file__), "autotune_table.json"))
+        for shape in ({"layers": 24, "hidden": 2048, "batch": 8,
+                       "seq": 1024},
+                      {"layers": 12, "hidden": 768, "batch": 16,
+                       "seq": 1024}):
+            assert table.get("train_remat", shape, "bfloat16") is not None
+
+
+class TestFusedStepLint:
+    def test_fused_master_step_gl004_clean(self):
+        """The donation regression this PR is designed to prevent: with
+        FLAGS_graph_lint, the fused master-weight step must carry ZERO
+        GL004 findings (params, moments AND masters donated)."""
+        from paddle_tpu import analysis
+
+        pt.set_flags({"FLAGS_graph_lint": True})
+        analysis.set_announce(False)
+        try:
+            cfg, model, opt = _build(seed=1, regime="master")
+            step = FusedTrainStep(lambda i, l: model(i, labels=l), opt,
+                                  amp_level="O1")
+            ids, labels = _batch(cfg)
+            float(step(ids, labels))
+            reports = step.lint_reports()
+            assert reports, "lint hook did not run"
+            gl004 = [f for rep in reports for f in rep.findings
+                     if f.code == "GL004"]
+            assert not gl004, [f.render() for f in gl004]
+        finally:
+            pt.set_flags({"FLAGS_graph_lint": False})

@@ -58,6 +58,27 @@ BENCH_KEYS = [
      "bfloat16"),
     ("ragged_paged_attention", {"page_size": 128, "head_dim": 64},
      "bfloat16"),
+    # measured remat-policy search on the stacked-GPT train step: the
+    # bench ladder's pure-bf16 rungs (1.3B bs 8/4, small bs 16).  Each
+    # candidate (recompute_interval, recompute_policy) is timed as ONE
+    # full fused train step on-device — expensive (a compile per
+    # candidate), which is why the winner persists in the table and
+    # bench.py only ever reads it.
+    ("train_remat", {"layers": 24, "hidden": 2048, "batch": 8, "seq": 1024},
+     "bfloat16"),
+    ("train_remat", {"layers": 24, "hidden": 2048, "batch": 4, "seq": 1024},
+     "bfloat16"),
+    ("train_remat", {"layers": 12, "hidden": 768, "batch": 16, "seq": 1024},
+     "bfloat16"),
+]
+
+# the bench's CPU-fallback train shape: --train-sweep times these on a
+# CPU-only host (a whole-train-step measurement is backend-agnostic in a
+# way a Mosaic kernel launch is not; entries are provenance-tagged with
+# the measuring device and only ever read back for the SAME shape key)
+TRAIN_REMAT_CPU_KEYS = [
+    ("train_remat", {"layers": 2, "hidden": 768, "batch": 2, "seq": 128},
+     "float32"),
 ]
 
 
@@ -83,6 +104,9 @@ def _timing_fn(kernel, shape, dtype_name):
     """Build the per-candidate timing closure for one bench key.  Each
     closure forces the candidate through the kernel's public dispatch
     (autotune.force) so exactly the production code path is timed."""
+    if kernel == "train_remat":
+        return _train_remat_timing_fn(shape, dtype_name)
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -186,6 +210,59 @@ def _timing_fn(kernel, shape, dtype_name):
     raise ValueError(kernel)
 
 
+def _train_remat_timing_fn(shape, dtype_name):
+    """Timing closure for the remat-policy search: ONE steady-state fused
+    train step (fwd+bwd+AdamW, AMP O1, donated) per candidate, on the
+    REAL bench model shape.  The model is built once per shape key; each
+    candidate mutates the remat config and compiles a fresh FusedTrainStep
+    (the config is read at trace time).  A candidate that OOMs raises and
+    is recorded as dead — exactly the failure mode the static model
+    cannot see."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.analysis import autotune
+    from paddle_tpu.models import (GPTStackedForPretraining, gpt_1p3b,
+                                   gpt_small, gpt_tiny)
+
+    presets = {2048: gpt_1p3b, 768: gpt_small, 64: gpt_tiny}
+    mk = presets[int(shape["hidden"])]
+    cfg = mk(hidden_dropout=0.0, attention_dropout=0.0,
+             max_position_embeddings=max(int(shape["seq"]), 1024),
+             recompute_interval=1, use_flash_attention=True)
+    cfg.num_layers = int(shape["layers"])
+    pt.seed(0)
+    model = GPTStackedForPretraining(cfg)
+    if dtype_name == "bfloat16":
+        pt.amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters(),
+                             multi_precision=dtype_name != "bfloat16")
+    rng = np.random.RandomState(0)
+    b, s = int(shape["batch"]), int(shape["seq"])
+    ids = pt.to_tensor(rng.randint(0, cfg.vocab_size, (b, s)), dtype="int64")
+    labels = pt.to_tensor(rng.randint(0, cfg.vocab_size, (b, s)),
+                          dtype="int64")
+
+    def run(params):
+        cfg.recompute_interval, cfg.recompute_policy = (
+            autotune.remat_params_to_config(params))
+        step = pt.optimizer.FusedTrainStep(
+            lambda i, l: model(i, labels=l), opt,
+            amp_level="O1", amp_dtype="bfloat16")
+        float(step(ids, labels))  # compile + first dispatch
+        # best-of-3 steady-state: one whole-train-step sample is noisier
+        # than a kernel launch, and a noise-picked winner persists
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(step(ids, labels))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return run
+
+
 def run(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="autotune.py",
@@ -196,6 +273,11 @@ def run(argv=None) -> int:
                     help="write static-default entries for the bench keys")
     ap.add_argument("--report", action="store_true",
                     help="print table entries + static candidate ranking")
+    ap.add_argument("--train-sweep", action="store_true",
+                    help="measured remat-policy sweep over the train_remat "
+                         "keys only — times FULL fused train steps, so it "
+                         "also runs on CPU-only hosts (against the bench's "
+                         "CPU-fallback shape)")
     ap.add_argument("--table", default=None, metavar="PATH",
                     help="table path (default: the packaged table / "
                          "PADDLE_TPU_AUTOTUNE_TABLE)")
@@ -259,12 +341,38 @@ def run(argv=None) -> int:
                   + "; ".join(str(p) for p in ranked[:4]))
         return 0
 
-    # -- measured sweep (TPU only) ----------------------------------------
+    # -- measured sweep (TPU only, except --train-sweep) -------------------
     import jax
 
-    if jax.devices()[0].platform == "cpu":
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if args.train_sweep:
+        device = ("cpu" if on_cpu
+                  else getattr(jax.devices()[0], "device_kind", "tpu"))
+        keys = (TRAIN_REMAT_CPU_KEYS if on_cpu else
+                [k for k in BENCH_KEYS if k[0] == "train_remat"])
+        table = (autotune.AutotuneTable.load(path) if os.path.exists(path)
+                 else autotune.AutotuneTable())
+        for kernel, shape, dtype in keys:
+            cands = autotune.enumerate_candidates(kernel, shape, dtype)
+            print(f"autotune: {kernel} {shape} {dtype}: timing "
+                  f"{len(cands)} candidates (full train steps, "
+                  f"device={device})...")
+            winner, results = autotune.sweep(
+                kernel, shape, dtype, _timing_fn(kernel, shape, dtype),
+                table=table, device=str(device))
+            for params, seconds in sorted(results, key=lambda ps: ps[1]):
+                mark = " <- winner" if params == winner else ""
+                t = ("FAILED" if seconds == float("inf")
+                     else f"{seconds * 1e3:8.2f}ms")
+                print(f"  {t}  {params}{mark}")
+        table.save(path)
+        print(f"autotune: wrote {len(table.entries)} entries -> {path}")
+        return 0
+
+    if on_cpu:
         print("autotune: no TPU backend; nothing to time (the table loads "
-              "in validated replay mode on CPU — use --validate/--seed)")
+              "in validated replay mode on CPU — use --validate/--seed, "
+              "or --train-sweep for the whole-step remat search)")
         return 2
     device = getattr(jax.devices()[0], "device_kind", "tpu")
     table = (autotune.AutotuneTable.load(path) if os.path.exists(path)

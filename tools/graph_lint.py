@@ -94,6 +94,32 @@ def _lint_train(pt, np):
 
     train_step(ids, labels)  # compile -> the FLAGS_graph_lint hook lints
 
+    # the fused master-weight regime (bf16 params + fp32 masters/moments +
+    # global-norm clip through FusedTrainStep): the GL004 donation pass
+    # over the optimizer state — masters and moments are the largest
+    # consumed-and-rebound buffers in the step, and an un-donated one
+    # would double-buffer the whole optimizer state every step.  This is
+    # the regression the train-perf push is designed to prevent.
+    from paddle_tpu.models import gpt_tiny as _tiny
+    from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+
+    cfg2 = _tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    model2 = _build_model(pt, cfg2)
+    opt2 = pt.optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model2.parameters(),
+                              multi_precision=True,
+                              grad_clip=ClipGradByGlobalNorm(1.0))
+    fused = pt.optimizer.FusedTrainStep(
+        lambda ids, labels: model2(ids, labels=labels), opt2,
+        amp_level="O1", amp_dtype="bfloat16")
+    ids2 = pt.to_tensor(
+        rng.randint(0, cfg2.vocab_size, (_TRAIN_BATCH, _TRAIN_SEQ)),
+        dtype="int64")
+    labels2 = pt.to_tensor(
+        rng.randint(0, cfg2.vocab_size, (_TRAIN_BATCH, _TRAIN_SEQ)),
+        dtype="int64")
+    fused(ids2, labels2)  # compile -> hook lints 'fused_train_step'
+
 
 def _lint_decode(pt, np):
     from paddle_tpu.models import gpt_tiny

@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Train-perf gate: the fused train step's structural invariants, on CPU.
+
+The MFU push (docs/training_perf.md) rests on three properties a refactor
+can silently break long before a TPU round notices:
+
+ 1. **One program, one dispatch per step** — FusedTrainStep compiles
+    exactly one program for a fixed input signature, and every train step
+    is one compiled dispatch (no eager leakage, no retraces).
+ 2. **Donation cleanliness** — with FLAGS_graph_lint, the fused
+    master-weight step carries ZERO GL004 findings: params, moments, and
+    fp32 masters are all donated, so the update aliases in place instead
+    of double-buffering the optimizer state every step.
+ 3. **Input pipeline** — DevicePrefetcher delivers every batch, in order,
+    and its stall accounting (the ``train_input_stall_seconds``
+    histogram) records one sample per consumed batch.
+
+Plus a coarse **throughput floor**: CPU tokens/sec on the tiny fused step
+must not fall below the recorded floor (tools/train_perf_floor.json) by
+more than 10%.  The committed floor is deliberately conservative (about a
+third of the recording host's measurement) so slow CI hosts don't flake;
+``--record`` re-measures and writes measured/3, and
+``PADDLE_TPU_TRAIN_PERF_FLOOR`` overrides per host.
+
+Wired into run_tests.sh (PADDLE_TPU_SKIP_TRAIN_PERF_GATE=1 skips).
+Exit 0 pass / 1 fail.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+FLOOR_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "train_perf_floor.json")
+_BATCH, _SEQ, _STEPS = 2, 64, 6
+
+
+def _build(pt, np):
+    from paddle_tpu.models import GPTStackedForPretraining, gpt_tiny
+    from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+
+    pt.seed(0)
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0,
+                   recompute_interval=1)
+    model = GPTStackedForPretraining(cfg)
+    # the master-weight regime: bf16 params + fp32 masters/moments + clip —
+    # the step with the most donated optimizer state (the GL004 surface)
+    pt.amp.decorate(model, level="O2", dtype="bfloat16")
+    opt = pt.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters(),
+                             multi_precision=True,
+                             grad_clip=ClipGradByGlobalNorm(1.0))
+    step = pt.optimizer.FusedTrainStep(
+        lambda ids, labels: model(ids, labels=labels), opt,
+        amp_level="O1", amp_dtype="bfloat16")
+    return cfg, step
+
+
+def run(argv=None) -> int:
+    record = argv is not None and "--record" in argv
+    failures = []
+
+    def check(name, ok, detail=""):
+        print(f"train_perf_gate: {name}: "
+              f"{'OK' if ok else 'FAIL'}{' — ' + detail if detail else ''}")
+        if not ok:
+            failures.append(name)
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu.core import op_cache
+    from paddle_tpu.io import DevicePrefetcher
+
+    pt.set_flags({"FLAGS_graph_lint": True})
+    from paddle_tpu import analysis
+
+    analysis.set_announce(False)
+
+    cfg, step = _build(pt, np)
+    rng = np.random.RandomState(0)
+
+    def batches(n):
+        for _ in range(n):
+            yield (rng.randint(0, cfg.vocab_size, (_BATCH, _SEQ)),
+                   rng.randint(0, cfg.vocab_size, (_BATCH, _SEQ)))
+
+    # warmup: compile + one steady-state dispatch
+    ids = pt.to_tensor(rng.randint(0, cfg.vocab_size, (_BATCH, _SEQ)),
+                       dtype="int64")
+    labels = pt.to_tensor(rng.randint(0, cfg.vocab_size, (_BATCH, _SEQ)),
+                          dtype="int64")
+    float(step(ids, labels))
+    float(step(ids, labels))
+
+    disp0 = step.dispatch_count
+    eager0 = op_cache.summary()["calls"]
+    pf = DevicePrefetcher(batches(_STEPS), depth=2)
+    losses = []
+    t0 = time.perf_counter()
+    for bids, blabels in pf:
+        losses.append(step(bids, blabels))
+    final = float(losses[-1])
+    dt = time.perf_counter() - t0
+    pf.close()
+
+    # 1. one program / one dispatch per step
+    check("program_count", step.program_count == 1,
+          f"{step.program_count} compiled programs (expected 1)")
+    disp = step.dispatch_count - disp0
+    eager = op_cache.summary()["calls"] - eager0
+    check("dispatch_per_step", disp == _STEPS and eager == 0,
+          f"fused={disp}/{_STEPS} eager={eager}")
+
+    # 2. donation cleanliness: GL004 must be absent from the fused step
+    reports = step.lint_reports()
+    gl004 = [f for rep in reports for f in rep.findings if f.code == "GL004"]
+    check("donation_gl004", bool(reports) and not gl004,
+          f"{len(reports)} lint report(s), "
+          f"{len(gl004)} GL004 finding(s)" if reports else
+          "no lint report (FLAGS_graph_lint hook did not run)")
+
+    # 3. input pipeline accounting
+    st = pf.stats()
+    check("prefetch_batches", st["batches"] == _STEPS,
+          f"{st['batches']}/{_STEPS} batches")
+    from paddle_tpu.telemetry import registry
+
+    hist = registry().get("train_input_stall_seconds")
+    hcount = (hist.summary().get("count", 0)
+              if hist is not None else 0)
+    check("stall_histogram", hist is not None and hcount >= _STEPS,
+          f"histogram count={hcount} (>= {_STEPS} expected)")
+    check("loss_finite", bool(np.isfinite(final)), f"loss={final}")
+
+    # 4. throughput floor
+    tps = _BATCH * _SEQ * _STEPS / dt
+    if record:
+        with open(FLOOR_PATH, "w") as f:
+            json.dump({"cpu_tokens_per_sec_floor": round(tps / 3.0, 1),
+                       "recorded_tokens_per_sec": round(tps, 1),
+                       "batch": _BATCH, "seq": _SEQ, "steps": _STEPS}, f,
+                      indent=2)
+            f.write("\n")
+        print(f"train_perf_gate: recorded floor {tps / 3.0:.1f} tok/s "
+              f"(measured {tps:.1f}) -> {FLOOR_PATH}")
+    floor_env = os.environ.get("PADDLE_TPU_TRAIN_PERF_FLOOR")
+    if floor_env:
+        floor = float(floor_env)
+    elif os.path.exists(FLOOR_PATH):
+        with open(FLOOR_PATH) as f:
+            floor = float(json.load(f)["cpu_tokens_per_sec_floor"])
+    else:
+        floor = 0.0
+    if floor > 0:
+        check("tokens_per_sec_floor", tps >= floor * 0.9,
+              f"{tps:.1f} tok/s vs floor {floor:.1f} (-10% allowed)")
+    else:
+        print("train_perf_gate: no floor recorded; skipping throughput "
+              "check (run --record)")
+
+    if failures:
+        print(f"train_perf_gate: FAILED: {failures}")
+        return 1
+    print(f"train_perf_gate: all checks passed ({tps:.1f} tok/s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1:]))
