@@ -707,9 +707,39 @@ def main():
             s_new, n_req, plens = 4, 4, (8, 20, 12, 16)
         reset_serve_trace_counts()
         analysis.clear_cost_reports()  # this phase's programs only
-        eng = ServingEngine(model, **s_kw)
-        # warmup compiles the fused greedy step; the timed run reuses it
-        eng.submit(rng.randint(0, cfg.vocab_size, (plens[0],)), 2)
+        # mesh-sharded serving (docs/serving.md "Sharded serving"):
+        # BENCH_SERVING_MESH=dp,mp runs the phase on a ShardedServingEngine
+        # — dp replicas x mp tensor-parallel chips behind one placement
+        # scheduler.  Default 1,1 keeps the single-chip trajectory
+        # comparable; insufficient devices fall back with a stderr note.
+        s_dp, s_mp = 1, 1
+        raw_mesh = os.environ.get("BENCH_SERVING_MESH", "1,1")
+        try:
+            s_dp, s_mp = (int(x) for x in raw_mesh.split(","))
+        except ValueError:
+            sys.stderr.write(f"bench: BENCH_SERVING_MESH={raw_mesh!r} "
+                             "unparsable (want dp,mp); using 1,1\n")
+        if s_dp < 1 or s_mp < 1:
+            sys.stderr.write(f"bench: BENCH_SERVING_MESH={raw_mesh!r}: "
+                             "axes must be >= 1; using 1,1\n")
+            s_dp = s_mp = 1
+        if s_dp * s_mp > len(jax.devices()):
+            sys.stderr.write(
+                f"bench: BENCH_SERVING_MESH={s_dp},{s_mp} needs "
+                f"{s_dp * s_mp} devices, host has {len(jax.devices())}; "
+                "using 1,1\n")
+            s_dp = s_mp = 1
+        if s_dp * s_mp > 1:
+            from paddle_tpu.serving import ShardedServingEngine
+
+            eng = ShardedServingEngine(model, dp=s_dp, mp=s_mp, **s_kw)
+        else:
+            eng = ServingEngine(model, **s_kw)
+        # warmup compiles the fused greedy step — one request per dp
+        # replica (least-loaded placement seats each on its own replica)
+        # so NO replica's SPMD compile lands in the timed window
+        for _ in range(s_dp):
+            eng.submit(rng.randint(0, cfg.vocab_size, (plens[0],)), 2)
         eng.run_until_idle()
         m0 = eng.metrics()
         mem_before = pt_memory.memory_allocated()
@@ -733,12 +763,18 @@ def main():
         q_row_occ = ((mets["block_rows"] - m0["block_rows"]) / d_rcap
                      if d_rcap else 0.0)
         pt_memory.log_memory("after serving bench")
+        # per-chip pool accounting: the head-sharded pool holds 1/mp of
+        # the page bytes per chip; aggregate page capacity grows with dp
+        pool_per_chip_mib = mets["cache_bytes_per_chip"] / 2 ** 20
         _emit(
             f"gpt_{name}_serving_tokens_per_sec_per_chip",
-            round(s_tokens / s_dt, 1),
-            f"tokens/s (slots={s_kw['num_slots']} reqs={n_req} "
+            round(s_tokens / s_dt / max(s_dp * s_mp, 1), 1),
+            f"tokens/s (mesh={s_dp}x{s_mp} slots={s_kw['num_slots']} "
+            f"reqs={n_req} "
             f"page={s_kw['page_size']} ctx={s_kw['max_context']} "
-            f"new={s_new} pool={eng.allocator.capacity}pages "
+            f"new={s_new} pool={mets['pages_capacity']}pages "
+            f"pool_per_chip={pool_per_chip_mib:.2f}MiB "
+            f"aggregate_tps={s_tokens / s_dt:.1f} "
             f"completed={mets['completed']} "
             f"grid_occ={grid_occ:.3f} "
             f"q_row_occ={q_row_occ:.3f} "
@@ -750,21 +786,33 @@ def main():
         # histograms (TTFT = submission -> first token, queue included;
         # ITL = gap between consecutive tokens of one request) — the
         # latency companions to the throughput line above
-        slo = mets.get("slo", {})
+        # sharded runs: per-request SLO histograms are per replica and do
+        # not merge exactly — quote replica 0 as the representative
+        slo = mets.get("slo") or (
+            mets["per_replica"][0].get("slo", {})
+            if mets.get("per_replica") else {})
 
         def _ms(h, q):
             return round(h.get(q, 0.0) * 1000.0, 3)
 
         tt, it = slo.get("ttft", {}), slo.get("itl", {})
+        sharded_run = s_dp * s_mp > 1
         print(json.dumps({
             "metric": f"gpt_{name}_serving_slo_ms",
+            "mesh": f"{s_dp}x{s_mp}",
+            # sharded runs quote ONE replica's histograms (percentiles of
+            # different replicas do not merge); the scope tag keeps the
+            # trajectory discontinuity visible when comparing commits
+            "scope": "replica0" if sharded_run else "engine",
             "ttft_p50": _ms(tt, "p50"), "ttft_p95": _ms(tt, "p95"),
             "ttft_p99": _ms(tt, "p99"), "ttft_count": int(tt.get("count", 0)),
             "itl_p50": _ms(it, "p50"), "itl_p95": _ms(it, "p95"),
             "itl_p99": _ms(it, "p99"),
             "queue_wait_p50": _ms(slo.get("queue_wait", {}), "p50"),
             "unit": "ms (per-request serving SLOs; includes the warmup "
-                    "request's compile-dominated TTFT sample)",
+                    "request's compile-dominated TTFT sample"
+                    + ("; replica-0 scope on a sharded mesh)" if sharded_run
+                       else ")"),
         }))
         sys.stdout.flush()
         srv_costs = {c.program: c for c in analysis.cost_reports()}

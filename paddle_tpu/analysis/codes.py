@@ -20,6 +20,7 @@ __all__ = [
     "misaligned_dims", "padded_shape", "padding_waste_elems",
     "default_block", "GateReason", "flash_gate_reason",
     "decode_gate_reason", "paged_gate_reason", "ragged_gate_reason",
+    "mesh_shard_gate_reason",
 ]
 
 # code -> (short name, default severity).  Severities: "error" (correctness
@@ -159,24 +160,74 @@ def decode_gate_reason(max_seq: int, head_dim: int) -> Optional[GateReason]:
     return _attention_gate(max_seq, head_dim, "decode_attention", "max_seq")
 
 
-def paged_gate_reason(page_size: int, head_dim: int) -> Optional[GateReason]:
+def _shard_problems(num_heads: Optional[int], mp: int) -> List[str]:
+    """The mesh-shard preconditions of the per-head paged/ragged kernel
+    partition (serving over the ``mp`` axis): the head axis must split
+    evenly, with at least one head per shard.  Shared by the kernel gates
+    (when asked with the shard geometry) and the serving engine's
+    construction-time validation — a violation is reported as a typed
+    GL002-style reason instead of a shard_map crash."""
+    problems: List[str] = []
+    mp = int(mp)
+    if mp <= 1 or num_heads is None:
+        return problems
+    num_heads = int(num_heads)
+    if num_heads % mp:
+        problems.append(
+            f"num_heads={num_heads} is not divisible by mp={mp} "
+            "(per-head pool shard)")
+    elif num_heads // mp < 1:
+        problems.append(
+            f"num_heads={num_heads} leaves no head per shard at mp={mp}")
+    return problems
+
+
+def mesh_shard_gate_reason(num_heads: int, mp: int,
+                           kernel: str = "ragged_paged_attention"
+                           ) -> Optional[GateReason]:
+    """None when the per-head ``mp`` partition of ``kernel`` can exist,
+    else the GL002-coded reason.  This is the HARD precondition the
+    serving engine checks at construction: unlike the tile rules (which
+    only cost the Pallas kernel and fall back to XLA), an indivisible head
+    axis cannot be sharded at all."""
+    problems = _shard_problems(num_heads, mp)
+    if not problems:
+        return None
+    return GateReason("GL002", kernel, "; ".join(problems))
+
+
+def paged_gate_reason(page_size: int, head_dim: int,
+                      num_heads: Optional[int] = None,
+                      mp: int = 1) -> Optional[GateReason]:
     """None when the paged decode-attention kernel accepts the block-pool
     shape, else the GL002-coded reason it falls back to the XLA gather
     reference.  A KV page is one kernel block, so the same tiling rules
     apply to ``page_size`` that the contiguous decode kernel applies to its
-    KV blocking of ``max_seq``."""
-    return _attention_gate(page_size, head_dim, "paged_attention",
+    KV blocking of ``max_seq``.  With ``mp > 1`` (the mesh-sharded serving
+    pool) the per-head shard preconditions are checked too: the head axis
+    must split evenly, and the per-SHARD layout still obeys the same
+    head_dim/tile rules (head_dim is never split, so those are
+    unchanged)."""
+    base = _attention_gate(page_size, head_dim, "paged_attention",
                            "page_size")
+    problems = [base.detail] if base is not None else []
+    problems += _shard_problems(num_heads, mp)
+    if not problems:
+        return None
+    return GateReason("GL002", "paged_attention", "; ".join(problems))
 
 
 def ragged_gate_reason(page_size: int, head_dim: int,
-                       token_block: int = 8) -> Optional[GateReason]:
+                       token_block: int = 8,
+                       num_heads: Optional[int] = None,
+                       mp: int = 1) -> Optional[GateReason]:
     """None when the ragged paged-attention kernel accepts the (pool,
     work-list) layout, else the GL002-coded reason it falls back to the
     XLA gather reference.  Pool rules are the paged kernel's verbatim (a
     page is one KV block); the query token block additionally must be a
     sublane multiple — the q rows of every work item form one (8, 128)
-    tile column."""
+    tile column.  With ``mp > 1`` the per-head shard preconditions apply
+    (see :func:`paged_gate_reason`)."""
     base = _attention_gate(page_size, head_dim, "ragged_paged_attention",
                            "page_size")
     problems = [base.detail] if base is not None else []
@@ -184,6 +235,7 @@ def ragged_gate_reason(page_size: int, head_dim: int,
         problems.append(
             f"token_block={token_block} is not an {TILE_SUBLANE}-multiple "
             f">= {TILE_SUBLANE} (query sublane rows)")
+    problems += _shard_problems(num_heads, mp)
     if not problems:
         return None
     return GateReason("GL002", "ragged_paged_attention",

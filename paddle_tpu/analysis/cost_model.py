@@ -28,6 +28,11 @@ Loop handling: ``scan`` bodies are multiplied by their trip count;
 (the static model cannot bound them); ``cond`` takes its most expensive
 branch.  Equations that carry sub-jaxprs contribute ONLY their bodies
 (counting both the call eqn's operands and the body would double-count).
+``shard_map`` bodies (the mesh-sharded serving step) see PER-SHARD
+shapes, so they are multiplied by the shard count — the product of the
+mesh axes the body runs manually over — keeping every count in GLOBAL
+(whole-cluster) units like the rest of the program's GSPMD-annotated
+equations.
 
 Entry points mirror the linter: :func:`cost` traces a function
 abstractly, :func:`cost_jaxpr` takes a ClosedJaxpr,
@@ -415,6 +420,28 @@ class _Acc:
         self.unbounded = False
 
 
+def _shard_count(eqn) -> int:
+    """Shards a ``shard_map`` eqn's body runs as: the product of the mesh
+    axes the body handles manually (every mesh axis minus the ``auto``
+    set GSPMD keeps).  The body's jaxpr has PER-SHARD shapes, so its
+    costs multiply by this to stay in global units.  Defensive: any
+    unreadable params count as 1 (never crash a lint/cost pass on an odd
+    jax version — the satellite contract of ISSUE 14)."""
+    try:
+        mesh = eqn.params.get("mesh")
+        if mesh is None:
+            return 1
+        auto = eqn.params.get("auto") or frozenset()
+        shape = dict(mesh.shape)
+        n = 1
+        for name, size in shape.items():
+            if name not in auto:
+                n *= int(size)
+        return max(n, 1)
+    except Exception:  # noqa: BLE001 — cost model must never crash a walk
+        return 1
+
+
 def _eqn_bytes(eqn) -> int:
     return (sum(_nbytes(v) for v in eqn.invars)
             + sum(_nbytes(v) for v in eqn.outvars))
@@ -433,6 +460,11 @@ def _cost_walk(jaxpr, acc: _Acc, mult: int, depth: int = 0):
                 length = int(eqn.params.get("length", 1) or 1)
                 for sub in subs:
                     _cost_walk(sub, acc, mult * max(length, 1), depth + 1)
+            elif prim == "shard_map":
+                # per-shard body shapes x shard count = global totals
+                shards = _shard_count(eqn)
+                for sub in subs:
+                    _cost_walk(sub, acc, mult * shards, depth + 1)
             elif prim == "while":
                 acc.unbounded = True
                 for sub in subs:

@@ -22,6 +22,7 @@ from .collective import (  # noqa: F401
     send,
 )
 from .parallel import DataParallel  # noqa: F401
+from . import serving_mesh  # noqa: F401  (mesh-native serving helpers)
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from . import auto_parallel  # noqa: F401

@@ -293,6 +293,47 @@ def _attend_with_cache(q: Tensor, k: Tensor, v: Tensor, ck_t: Tensor,
 
 def _raw_attend_paged(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
                       page_size, ragged_plan=None):
+    """Raw (traced) paged cache write + attend for continuous batching —
+    dispatching between the single-pool body and, under an active serving
+    mesh with ``mp > 1`` (``distributed/serving_mesh.py``), the SAME body
+    run per head shard under ``shard_map``: each chip scatters into and
+    attends over its own ``[P, H/mp, page_size, D]`` pool shard, with the
+    page tables / positions / ragged plan replicated.  The head-parallel
+    path is psum-free; the first cross-chip reduce is the row-parallel
+    post-attention projection GSPMD inserts outside this function.  See
+    :func:`_attend_paged_shard` for the shapes and semantics."""
+    from ..distributed import serving_mesh as _srv_mesh
+
+    mesh = _srv_mesh.active_mesh()
+    if mesh is not None and _srv_mesh.mp_size(mesh) > 1:
+        from jax.sharding import PartitionSpec as _P
+
+        from ..core.compat import shard_map as _shard_map
+
+        n_plan = len(ragged_plan) if ragged_plan is not None else 0
+
+        def body(qh_, kh_, vh_, pkr_, pvr_, tbl_, posr_, *planr):
+            return _attend_paged_shard(
+                qh_, kh_, vh_, pkr_, pvr_, tbl_, posr_,
+                head_dim=head_dim, page_size=page_size,
+                ragged_plan=planr if n_plan else None)
+
+        hs = _P(None, "mp", None, None)     # head axis of q/k/v and pools
+        rep = _P()
+        sm = _shard_map(
+            body, mesh,
+            in_specs=(hs, hs, hs, hs, hs, rep, rep) + (rep,) * n_plan,
+            out_specs=(hs, hs, hs),
+            check_vma=False)
+        return sm(qh, kh, vh, pkr, pvr, tables, posr,
+                  *(tuple(ragged_plan) if n_plan else ()))
+    return _attend_paged_shard(qh, kh, vh, pkr, pvr, tables, posr,
+                               head_dim=head_dim, page_size=page_size,
+                               ragged_plan=ragged_plan)
+
+
+def _attend_paged_shard(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
+                        page_size, ragged_plan=None):
     """Raw (traced) paged cache write + attend for continuous batching.
 
     qh/kh/vh: [S, N, C, D] head-major fresh projections (S decode slots —

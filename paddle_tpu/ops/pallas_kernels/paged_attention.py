@@ -184,14 +184,21 @@ def _paged_pallas(q, k_pool, v_pool, page_tables, lengths, scale,
 # public API
 # ---------------------------------------------------------------------------
 
-def _pick_q_rows(page_size: int, d: int, dtype) -> int:
+def _pick_q_rows(page_size: int, d: int, dtype,
+                 local_heads=None) -> int:
     """Query sublane-broadcast rows for one pool specialization: the
     autotune table's entry when one exists (``analysis/autotune.py``),
-    else the historical 8."""
+    else the historical 8.  ``local_heads`` (the POST-SHARD head count,
+    passed when the pool is sharded per-head over ``mp``) joins the shape
+    key so table entries stay valid per shard — the sharded grid
+    ``(S*H/mp, max_pages)`` is a different specialization; unsharded
+    lookups keep the historical key."""
     from ...analysis import autotune as _autotune
 
-    tuned = _autotune.kernel_params(
-        "paged_attention", {"page_size": page_size, "head_dim": d}, dtype)
+    shape = {"page_size": page_size, "head_dim": d}
+    if local_heads is not None:
+        shape["num_heads"] = int(local_heads)
+    tuned = _autotune.kernel_params("paged_attention", shape, dtype)
     if tuned:
         qr = int(tuned.get("q_rows", 8))
         if qr > 0 and qr % 8 == 0:
@@ -232,7 +239,15 @@ def paged_attention(q, k_pool, v_pool, page_tables, lengths, *,
     q = q.astype(k_pool.dtype)
     s = q.shape[0]
     if _on_tpu() and paged_shape_supported(page_size, d):
-        qr = _pick_q_rows(page_size, d, k_pool.dtype)
+        # under an active serving-mesh shard the pool's head axis is
+        # already LOCAL (H/mp) — key the autotune lookup on it so sharded
+        # and unsharded specializations never share a table entry
+        from ...distributed import serving_mesh as _srv_mesh
+
+        sharded = _srv_mesh.mp_size(_srv_mesh.active_mesh()) > 1 \
+            if _srv_mesh.active_mesh() is not None else False
+        qr = _pick_q_rows(page_size, d, k_pool.dtype,
+                          local_heads=h if sharded else None)
         q8 = jnp.broadcast_to(q.reshape(s * h, 1, d), (s * h, qr, d))
         out = _paged_pallas(q8, k_pool, v_pool, page_tables, lengths, scale)
         return out[:, 0, :].reshape(s, h, d)

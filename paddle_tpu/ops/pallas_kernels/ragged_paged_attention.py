@@ -42,7 +42,7 @@ differentiates through the pool.
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -104,17 +104,27 @@ def ragged_shape_supported(page_size: int, head_dim: int,
     return reason is None
 
 
-def ragged_token_block(page_size: int, head_dim: int, dtype) -> int:
+def ragged_token_block(page_size: int, head_dim: int, dtype,
+                       local_heads: Optional[int] = None) -> int:
     """The query token-block size (sublane rows per work item) for one
     pool specialization: the autotune table's entry when one exists
     (``analysis/autotune.py``), else the historical 8.  The serving
     engine asks ONCE at construction — the host-built plan bakes the
-    block size into every step's work list."""
+    block size into every step's work list.
+
+    ``local_heads``: the POST-SHARD head count when the pool is sharded
+    per-head over ``mp`` (docs/serving.md "Sharded serving").  It joins
+    the shape key — the sharded launch's grid is ``(H/mp, WL)``, a
+    different specialization than the full-head pool, so a winner
+    measured unsharded must not silently dispatch a shard and vice
+    versa.  Unsharded lookups keep the historical key (committed table
+    entries stay valid)."""
     from ...analysis import autotune as _autotune
 
-    tuned = _autotune.kernel_params(
-        "ragged_paged_attention",
-        {"page_size": page_size, "head_dim": head_dim}, dtype)
+    shape = {"page_size": page_size, "head_dim": head_dim}
+    if local_heads is not None:
+        shape["num_heads"] = int(local_heads)
+    tuned = _autotune.kernel_params("ragged_paged_attention", shape, dtype)
     if tuned:
         tb = int(tuned.get("token_block", 8))
         if tb >= 8 and tb % 8 == 0:

@@ -6,9 +6,17 @@ request mix (PAPERS.md: "Ragged Paged Attention", arxiv 2604.15464).
 
 - :mod:`paged_cache` — the global KV page pool (``PagedKVCache``) and the
   free-list ``BlockAllocator`` (page 0 reserved as the null page);
-- :mod:`scheduler` — fixed decode slots, admission with up-front page
-  reservation (out-of-pages admission backpressures into the queue),
-  immediate page free on retirement;
+- :mod:`admission` — the per-replica scheduler: fixed decode slots,
+  admission with up-front page reservation (out-of-pages admission
+  backpressures into the queue), immediate page free on retirement
+  (:mod:`scheduler` remains the compatibility facade);
+- :mod:`placement` — the cluster-level scheduler: which ``dp`` replica
+  seats a request (least-loaded, queue-depth backpressure signal; typed
+  shed only when ALL replicas backpressure);
+- :mod:`sharded` — ``ShardedServingEngine``: ``dp`` replica engines x
+  ``mp`` tensor-parallel chips (per-head-sharded pool + shard_map'd
+  ragged kernels + column/row-parallel weights) behind one placement
+  scheduler — docs/serving.md "Sharded serving";
 - :mod:`engine` — ``ServingEngine`` / ``RequestQueue``: request lifecycle
   (SUBMITTED -> PREFILL -> DECODE -> DONE | CANCELLED | TIMED_OUT |
   FAILED), chunked prefill into pages, ONE donated retrace-free jitted
@@ -39,13 +47,24 @@ from .engine import (  # noqa: F401
 )
 from .faults import FaultInjector, FaultPlan, InjectedFault, random_schedule  # noqa: F401,E501
 from .paged_cache import NULL_PAGE, BlockAllocator, PagedKVCache  # noqa: F401
-from .scheduler import Scheduler, Slot  # noqa: F401
+from .scheduler import (  # noqa: F401
+    AdmissionScheduler,
+    LeastLoadedPlacement,
+    PlacementScheduler,
+    Scheduler,
+    Slot,
+    replica_load,
+)
+from .sharded import ShardedServingEngine  # noqa: F401
 
 __all__ = [
     "Request", "RequestQueue", "RequestState", "SamplingParams",
-    "ServingEngine", "serve_trace_counts", "reset_serve_trace_counts",
+    "ServingEngine", "ShardedServingEngine",
+    "serve_trace_counts", "reset_serve_trace_counts",
     "ServingError", "Overloaded", "DeadlineExceeded", "RequestCancelled",
     "StepStalledError", "NaNLogitsError",
     "FaultInjector", "FaultPlan", "InjectedFault", "random_schedule",
-    "NULL_PAGE", "BlockAllocator", "PagedKVCache", "Scheduler", "Slot",
+    "NULL_PAGE", "BlockAllocator", "PagedKVCache",
+    "AdmissionScheduler", "Scheduler", "Slot",
+    "PlacementScheduler", "LeastLoadedPlacement", "replica_load",
 ]

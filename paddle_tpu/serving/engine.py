@@ -86,6 +86,7 @@ import numpy as np
 
 from .. import ops
 from ..analysis.cost_model import ragged_padding_waste
+from ..distributed import serving_mesh as _srv_mesh
 from ..ops import dispatch
 from ..telemetry import metrics as _tmetrics
 from ..telemetry import trace as _ttrace
@@ -93,8 +94,8 @@ from ..ops.pallas_kernels.ragged_paged_attention import (
     RAGGED_PLAN_FIELDS, build_ragged_plan, ragged_token_block,
 )
 from ..tensor import Tensor, to_tensor
+from .admission import AdmissionScheduler, StepWork
 from .paged_cache import BlockAllocator
-from .scheduler import Scheduler, StepWork
 
 __all__ = [
     "RequestState", "SamplingParams", "Request", "RequestQueue",
@@ -313,8 +314,17 @@ class RequestQueue:
 # python-body execution counters (same invariant as models/generation):
 # the step bodies run ONLY while tracing — frozen counters across N steps
 # of request churn == the retrace-freedom proof.  One key since the fused
-# step collapsed the prefill/decode phase pair.
+# step collapsed the prefill/decode phase pair.  Lock-guarded: a sharded
+# cluster traces its dp replicas' steps on concurrent threads, and an
+# interleaved `+=` losing an increment would let a genuinely-retracing
+# step slip under the <= 2-per-replica gates.
 _SERVE_TRACE_COUNTS = {"fused": 0}
+_SERVE_TRACE_LOCK = threading.Lock()
+
+
+def _count_fused_trace():
+    with _SERVE_TRACE_LOCK:
+        _SERVE_TRACE_COUNTS["fused"] += 1
 
 # registry label for each engine's counters/histograms (one process may
 # host many engines; tests create dozens — the label keeps them distinct)
@@ -330,17 +340,22 @@ def reset_serve_trace_counts():
 
 
 def _sample_per_slot(logits: Tensor, temperature: Tensor, top_p: Tensor,
-                     top_k: Tensor, do_sample: Tensor) -> Tensor:
+                     top_k: Tensor, do_sample: Tensor,
+                     generator=None) -> Tensor:
     """Next-token selection over [S, V] logits with PER-SLOT params (all
     traced [S] vectors) -> int64 [S].  Greedy rows take the raw argmax
     (bit-identical to ``generation.sample_tokens`` greedy); sampling rows
     apply temperature, then top-k (k-th sorted value as threshold;
     k <= 0 = off) and top-p (smallest probability-sorted prefix reaching
     mass p; 1.0 = off), then draw via Gumbel-argmax with a key split from
-    the global generator (functionalizes under jit.to_static)."""
-    from ..ops.random import default_generator
+    ``generator`` — the global one by default; mesh-sharded engines pass
+    their OWN (the donated key state would otherwise ping-pong between
+    replica meshes and fail the next replica's dispatch with a
+    device-mismatch)."""
+    if generator is None:
+        from ..ops.random import default_generator as generator
 
-    key = default_generator.split()
+    key = generator.split()
 
     def fn(raw, t, p, k, ds):
         raw = raw.astype(jnp.float32)
@@ -497,8 +512,22 @@ class ServingEngine:
                  max_queue_depth: Optional[int] = None,
                  max_queue_wait_s: Optional[float] = None,
                  readmission_backoff_s: float = 0.05,
-                 backoff_max_s: float = 5.0):
+                 backoff_max_s: float = 5.0,
+                 mesh=None):
         cfg = model.config
+        # mesh-sharded replica (docs/serving.md "Sharded serving"): the
+        # page pool is sharded per-head over the mesh's 'mp' axis, step
+        # inputs land replicated on the replica mesh, and the fused step
+        # compiles ONCE as an SPMD program over it.  The model's weights
+        # must already be committed to the same mesh
+        # (serving_mesh.shard_model_for_serving) — ShardedServingEngine
+        # does both per dp replica.
+        self.mesh = mesh
+        self._mp = _srv_mesh.mp_size(mesh) if mesh is not None else 1
+        if self._mp > 1:
+            # hard precondition, typed: an indivisible head axis cannot be
+            # sharded at all (GL002-formatted, not a shard_map crash)
+            _srv_mesh.validate_head_sharding(cfg.num_heads, self._mp)
         max_context = int(max_context or cfg.max_position_embeddings)
         if max_context > cfg.max_position_embeddings:
             raise ValueError(
@@ -530,11 +559,10 @@ class ServingEngine:
         self.prefill_token_budget = prefill_token_budget
         self.cache_dtype = str(cache_dtype)
         self.num_pages = int(num_pages)
-        self.cache = model.new_paged_kv_cache(num_pages, page_size,
-                                              dtype=cache_dtype)
+        self.cache = self._new_pool()
         self.allocator = BlockAllocator(num_pages)
-        self.scheduler = Scheduler(num_slots, max_pages_per_slot, page_size,
-                                   self.allocator)
+        self.scheduler = AdmissionScheduler(num_slots, max_pages_per_slot,
+                                            page_size, self.allocator)
         self.queue = RequestQueue(max_depth=max_queue_depth)
         self._lock = threading.RLock()
         self._closed = False
@@ -542,10 +570,27 @@ class ServingEngine:
         # fixed fused-step geometry: the flat token axis, block count, and
         # work-list length are engine constants (retrace-freedom); the
         # token-block size comes from the autotune table for this pool
-        # specialization (ops/pallas_kernels/ragged_paged_attention.py)
+        # specialization (ops/pallas_kernels/ragged_paged_attention.py) —
+        # keyed on the LOCAL (post-shard) head count under mp sharding
         self.head_dim = int(cfg.head_dim)
-        self.token_block = ragged_token_block(self.page_size, cfg.head_dim,
-                                              self.cache_dtype)
+        self.token_block = ragged_token_block(
+            self.page_size, cfg.head_dim, self.cache_dtype,
+            local_heads=(cfg.num_heads // self._mp if self._mp > 1
+                         else None))
+        # sampling RNG: the global generator single-chip (bit-compat with
+        # generate()); a PRIVATE stream per mesh-sharded engine — the
+        # donated key state commits to the replica mesh, and one shared
+        # key bouncing between replicas' meshes would fail dispatch
+        self._generator = None
+        if mesh is not None:
+            from ..ops.random import Generator, default_generator
+
+            self._generator = Generator(
+                int(np.asarray(default_generator.split())[0]) % (2 ** 31))
+            # materialize the key NOW: a lazily-created key Tensor inside
+            # the fused step's abstract scout would read as trace-created
+            # state and break the scout's creation-ordinal matching
+            self._generator._state  # noqa: B018 — lazy-init side effect
         self._t_max = self.num_slots + self.prefill_token_budget
         # blocks: a slot contributes ONE run per step — a decode token
         # (one block) or a prefill run of c tokens (1 + (c-1)//qb blocks).
@@ -674,6 +719,26 @@ class ServingEngine:
 
         self._build_steps()
 
+    def _new_pool(self):
+        """A fresh page pool, committed to the replica mesh (per-head
+        sharded over 'mp') when this engine is mesh-sharded.  Used at init
+        and by ``_rebuild``."""
+        cache = self.model.new_paged_kv_cache(self.num_pages, self.page_size,
+                                              dtype=self.cache_dtype)
+        if self.mesh is not None:
+            _srv_mesh.shard_paged_cache(cache, self.mesh)
+        return cache
+
+    def _host_to_dev(self, arr: np.ndarray) -> Tensor:
+        """Host step input -> device Tensor: replicated onto the replica
+        mesh when sharded (one explicit placement instead of relying on
+        jit to resolve an uncommitted array against a submesh program),
+        the default device otherwise."""
+        if self.mesh is None:
+            return to_tensor(arr)
+        return Tensor(_srv_mesh.replicate_to_mesh(
+            np.ascontiguousarray(arr), self.mesh))
+
     def _build_steps(self):
         """Compile-on-first-use fused-step closures over the CURRENT page
         pool.  Called at init and again by ``_rebuild`` after a
@@ -696,13 +761,19 @@ class ServingEngine:
         def _unpack(p):
             return tuple(jnp.reshape(p[a:b], shp) for a, b, shp in slices)
 
+        mesh = self.mesh
+        generator = self._generator
+
         def _mk_fused(with_sampling):
             def fused_step(ids, packed, temp, top_p, top_k, do_sample):
-                _SERVE_TRACE_COUNTS["fused"] += 1
+                _count_fused_trace()
                 (token_tables, positions, out_rows, *plan) = \
                     dispatch.apply_nondiff(_unpack, packed)
                 plan = tuple(plan)
-                with dispatch.no_grad():
+                # the serving-mesh context is TRACE-time state: the paged
+                # attention path reads it to shard_map the scatter+attend
+                # per head shard over 'mp' (no-op for mesh=None)
+                with _srv_mesh.activate(mesh), dispatch.no_grad():
                     logits = model._paged_lm_logits(ids, cache,
                                                     token_tables, positions,
                                                     ragged_plan=plan,
@@ -711,7 +782,8 @@ class ServingEngine:
                     fin = _slotwise_finite(rows)
                     if with_sampling:
                         tok = _sample_per_slot(rows, temp, top_p, top_k,
-                                               do_sample)
+                                               do_sample,
+                                               generator=generator)
                     else:
                         tok = ops.argmax(rows, axis=-1)
                 return tok, fin
@@ -954,11 +1026,12 @@ class ServingEngine:
             # that already invalidated the cache and re-admitted with new
             # sampling params) can never overwrite live sampling state.
             built = cache = (
-                to_tensor(self._temp.copy()), to_tensor(self._top_p.copy()),
-                to_tensor(self._top_k.copy()),
-                to_tensor(self._do_sample.copy()))
+                self._host_to_dev(self._temp.copy()),
+                self._host_to_dev(self._top_p.copy()),
+                self._host_to_dev(self._top_k.copy()),
+                self._host_to_dev(self._do_sample.copy()))
         toks, fin = fused(
-            *(to_tensor(np.ascontiguousarray(a)) for a in inputs),
+            *(self._host_to_dev(np.ascontiguousarray(a)) for a in inputs),
             *cache)
         return (np.asarray(toks.numpy()),
                 np.array(np.asarray(fin.numpy()), bool), built)
@@ -1201,8 +1274,7 @@ class ServingEngine:
             f"rebuild leaked {self.allocator.used_pages} pages"
         with _ttrace.span("serve.rebuild"):
             old = self.cache
-            self.cache = self.model.new_paged_kv_cache(
-                self.num_pages, self.page_size, dtype=self.cache_dtype)
+            self.cache = self._new_pool()
             self.scheduler.reset_mirrors()
             self._build_steps()
             if release_old:
@@ -1318,6 +1390,11 @@ class ServingEngine:
         out["pages_capacity"] = self.allocator.capacity
         out["occupancy"] = self.scheduler.occupancy
         out["cache_bytes"] = self.cache.nbytes if not self._closed else 0
+        # per-chip pool accounting: the head-sharded pool puts 1/mp of the
+        # page bytes on each chip of the replica mesh (docs/serving.md
+        # "Sharded serving"; mp=1 single-chip -> identical numbers)
+        out["mp"] = self._mp
+        out["cache_bytes_per_chip"] = out["cache_bytes"] // self._mp
         wc = self._totals["work_capacity"]
         rc = self._totals["block_row_capacity"]
         out["mean_grid_occupancy"] = (self._totals["work_items"] / wc

@@ -1,0 +1,123 @@
+"""Placement layer: which ``dp`` replica seats a request.
+
+The cluster-level half of the PR-14 scheduler split (the per-replica half
+— pages, slots, queues — is ``serving/admission.py``).  The placement
+scheduler never touches pages or slots itself: it ranks replicas by load
+and forwards ``submit`` to the chosen replica's own admission path, so
+every per-replica invariant (all-or-nothing page reservation, bounded
+queues, exact accounting under faults) holds unchanged per replica.
+
+Backpressure composes upward: a replica sheds (typed ``Overloaded``) when
+its own bounded queue is full; the placement layer sheds only when EVERY
+replica does — one busy replica never rejects work another could absorb.
+
+The default policy is least-loaded with queue depth as the primary
+signal: queue depth is the only metric that keeps growing after a replica
+saturates (occupancy and active slots clip at capacity), so it is the
+gradient that actually spreads a hot spot.  Ties break toward fewer
+reserved pages, then fewer active slots, then replica index
+(deterministic).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+from .engine import Overloaded, Request
+
+__all__ = ["LeastLoadedPlacement", "PlacementScheduler", "replica_load"]
+
+
+def replica_load(engine) -> Tuple[int, float, int]:
+    """One replica's load signal for placement ranking:
+    ``(queue_depth, pages_reserved_fraction, active_slots)`` — ordered by
+    how discriminating each is past saturation."""
+    alloc = engine.allocator
+    cap = max(alloc.capacity, 1)
+    return (engine.queue.depth, alloc.used_pages / cap,
+            engine.scheduler.active_slots)
+
+
+class LeastLoadedPlacement:
+    """Rank replicas least-loaded first (see :func:`replica_load`)."""
+
+    def rank(self, engines: Sequence) -> List[int]:
+        return sorted(range(len(engines)),
+                      key=lambda i: (replica_load(engines[i]), i))
+
+
+class PlacementScheduler:
+    """Cluster-level request placement over ``dp`` replica engines.
+
+    ``submit`` walks the policy's ranking and seats the request on the
+    first replica that accepts it; per-replica ``Overloaded`` (bounded
+    queue full) moves on to the next candidate.  Only when EVERY replica
+    sheds does the placement layer raise ``Overloaded`` itself — the
+    cluster is genuinely out of capacity, not just one replica.
+
+    Validation errors (oversized prompt, bad arguments) are raised by the
+    first replica verbatim: they would fail identically everywhere, and
+    retrying them across the fleet would just turn one clear error into
+    ``dp`` of them.
+    """
+
+    def __init__(self, engines: Sequence, policy=None):
+        if not engines:
+            raise ValueError("PlacementScheduler needs at least one replica")
+        self.engines = list(engines)
+        self.policy = policy or LeastLoadedPlacement()
+        # requests routed per replica (placement observability; the
+        # sharded bench prints these as per-replica occupancy companions)
+        self.routed = [0] * len(self.engines)
+        # cluster-level sheds (every replica backpressured).  Separate
+        # from the replicas' own ``shed`` counters so one rejected
+        # request is counted ONCE here, not dp times below.
+        self.shed_total = 0
+        # counter lock: submit() is documented as callable from any
+        # thread, and a bare `+=` is the interleaved read-modify-write
+        # the PR-9 counter hardening removed from the engine
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _has_queue_room(engine) -> bool:
+        q = engine.queue
+        return q.max_depth is None or q.depth < q.max_depth
+
+    def submit(self, prompt, max_new_tokens: int = 32, **kwargs) -> Request:
+        """Place and queue one request; returns the replica's Request.
+        Raises typed ``Overloaded`` only when all replicas shed.
+
+        Full replicas are skipped by a queue-room check BEFORE calling
+        their ``submit`` — probing a full replica's submit would bump its
+        own ``shed`` counter for a request another replica then serves.
+        The check races concurrent submitters, so a replica-level
+        ``Overloaded`` can still surface; it is caught and the walk moves
+        on (that replica's counter recorded a genuine full-queue event).
+        """
+        last: Optional[Overloaded] = None
+        for i in self.policy.rank(self.engines):
+            if not self._has_queue_room(self.engines[i]):
+                continue
+            try:
+                req = self.engines[i].submit(prompt, max_new_tokens,
+                                             **kwargs)
+            except Overloaded as e:
+                last = e
+                continue
+            with self._lock:
+                self.routed[i] += 1
+            req.replica = i
+            return req
+        with self._lock:
+            self.shed_total += 1
+        raise Overloaded(
+            f"all {len(self.engines)} replicas backpressured: "
+            "cluster out of queue capacity — back off and retry") from last
+
+    def pending(self) -> int:
+        """Queued + seated requests across every replica."""
+        return sum(e.queue.depth + e.scheduler.active_slots
+                   for e in self.engines)
+
+    def loads(self) -> List[Tuple[int, float, int]]:
+        return [replica_load(e) for e in self.engines]

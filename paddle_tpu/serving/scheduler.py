@@ -1,212 +1,31 @@
-"""Continuous-batching slot scheduler (host-side bookkeeping).
+"""Compatibility facade over the PR-14 scheduler split.
 
-A fixed number of *slots* share one compiled fused step; the scheduler
-owns which request occupies which slot, each slot's page-table row,
-position, and not-yet-prefilled prompt remainder, and the block-pool
-accounting:
+``serving/scheduler.py`` historically held the one slot scheduler.  It is
+now two layers (docs/serving.md "Sharded serving"):
 
-- **admission** reserves every page a request can ever touch up front
-  (``ceil((prompt + max_new_tokens) / page_size)``).  All-or-nothing: a
-  request the pool cannot fully serve stays queued (backpressure) — a
-  mid-decode out-of-pages condition therefore cannot exist, so live slots
-  are never corrupted or preempted by page exhaustion.
-- **per-step token planning** (``plan_step``) is first-class *variable
-  tokens per step*: each tick, a seated slot contributes either one
-  decode token or a budgeted run of prefill tokens from its pending
-  prompt — the counts vary freely because the page math is keyed on
-  TOKENS, not phases (admission already reserved every page any split
-  can touch).  ROADMAP item 5 (speculative decoding, per-request LoRA)
-  builds on the same path: ``advance(idx, n)`` accepts any n.
-- **retirement** frees the slot's pages back to the allocator immediately
-  (they are reusable the same step) and zeroes its table row to the null
-  page.
+- :mod:`serving.admission` — per-replica: slots, up-front page
+  reservation, per-step token planning, retirement (the class that used
+  to live here, now ``AdmissionScheduler`` with the old ``Scheduler``
+  name kept as an alias);
+- :mod:`serving.placement` — cluster-level: which ``dp`` replica seats a
+  request (least-loaded, queue-depth backpressure signal; sheds only when
+  every replica does).
 
-The numpy arrays (``tables`` [num_slots, max_pages] int32, ``positions``
-[num_slots] int32) are the exact host mirrors the engine ships to the
-jitted step each call — fixed shapes, so the step never retraces as the
-request mix churns.
+Import sites that predate the split keep working through this module.
 """
-from __future__ import annotations
+from .admission import (  # noqa: F401
+    AdmissionScheduler,
+    Scheduler,
+    Slot,
+    StepWork,
+)
+from .placement import (  # noqa: F401
+    LeastLoadedPlacement,
+    PlacementScheduler,
+    replica_load,
+)
 
-from typing import List, Optional, Tuple
-
-import numpy as np
-
-from .paged_cache import NULL_PAGE, BlockAllocator
-
-__all__ = ["Slot", "Scheduler", "StepWork"]
-
-
-class Slot:
-    """One decode slot: the request occupying it + its page reservation.
-
-    ``pending`` holds the prompt tokens not yet written into the pool
-    (set at admission, consumed by the fused step's prefill runs); an
-    empty/None pending means the slot is decoding.  ``seq`` is the
-    admission sequence number — ``plan_step`` drains the prefill budget
-    oldest-admission-first, so slot INDEX (which admission reuses as soon
-    as a slot frees) never decides who prefills."""
-
-    __slots__ = ("request", "pages", "pos", "pending", "seq")
-
-    def __init__(self, request, pages: List[int], pos: int = 0,
-                 pending: Optional[np.ndarray] = None, seq: int = 0):
-        self.request = request
-        self.pages = pages
-        self.pos = pos       # tokens written into the slot's pages so far
-        self.pending = pending
-        self.seq = seq
-
-
-class StepWork:
-    """One slot's share of a fused step: ``count`` tokens starting at
-    absolute position ``base`` — a prefill run (``kind='prefill'``,
-    ``completes`` when it exhausts the slot's pending prompt, so the
-    step's sampled token is the request's FIRST generated token) or one
-    decode token (``kind='decode'``)."""
-
-    __slots__ = ("slot", "kind", "count", "base", "completes")
-
-    def __init__(self, slot: int, kind: str, count: int, base: int,
-                 completes: bool):
-        self.slot = slot
-        self.kind = kind
-        self.count = count
-        self.base = base
-        self.completes = completes
-
-    @property
-    def has_output(self) -> bool:
-        """Whether this run samples a token (decode always; a prefill run
-        only when it completes the prompt — mid-prefill runs emit
-        nothing)."""
-        return self.kind == "decode" or self.completes
-
-    def __repr__(self) -> str:
-        return (f"StepWork(slot={self.slot}, {self.kind}, count={self.count},"
-                f" base={self.base}, completes={self.completes})")
-
-
-class Scheduler:
-    def __init__(self, num_slots: int, max_pages_per_slot: int,
-                 page_size: int, allocator: BlockAllocator):
-        if num_slots < 1:
-            raise ValueError("num_slots must be >= 1")
-        self.num_slots = num_slots
-        self.max_pages_per_slot = max_pages_per_slot
-        self.page_size = page_size
-        self.allocator = allocator
-        self.slots: List[Optional[Slot]] = [None] * num_slots
-        self.tables = np.full((num_slots, max_pages_per_slot), NULL_PAGE,
-                              np.int32)
-        self.positions = np.zeros((num_slots,), np.int32)
-        self._admit_seq = 0          # monotonic admission counter (fairness)
-
-    # -- queries -----------------------------------------------------------
-    @property
-    def active_slots(self) -> int:
-        return sum(1 for s in self.slots if s is not None)
-
-    def free_slot_indices(self) -> List[int]:
-        return [i for i, s in enumerate(self.slots) if s is None]
-
-    def seated(self) -> List[Tuple[int, Slot]]:
-        """(index, slot) of every occupied slot — snapshot list, safe to
-        retire slots while iterating (the reap/recovery paths do)."""
-        return [(i, s) for i, s in enumerate(self.slots) if s is not None]
-
-    def active_mask(self) -> np.ndarray:
-        return np.array([s is not None for s in self.slots], bool)
-
-    @property
-    def occupancy(self) -> float:
-        """Fraction of the allocatable pool currently reserved."""
-        cap = self.allocator.capacity
-        return self.allocator.used_pages / cap if cap else 0.0
-
-    def pages_needed(self, total_tokens: int) -> int:
-        return -(-int(total_tokens) // self.page_size)
-
-    # -- admission / retirement --------------------------------------------
-    def try_admit(self, request, total_tokens: int) -> Optional[int]:
-        """Seat ``request`` in a free slot with pages reserved for
-        ``total_tokens``; None (nothing changed) when no slot is free, the
-        request cannot fit a slot's table, or the pool lacks pages."""
-        free = self.free_slot_indices()
-        if not free:
-            return None
-        n = self.pages_needed(total_tokens)
-        if n > self.max_pages_per_slot:
-            raise ValueError(
-                f"request needs {n} pages but a slot holds at most "
-                f"{self.max_pages_per_slot} (max_context "
-                f"{self.max_pages_per_slot * self.page_size})")
-        pages = self.allocator.alloc(n)
-        if pages is None:
-            return None          # pool backpressure: stays queued
-        idx = free[0]
-        self.slots[idx] = Slot(request, pages, seq=self._admit_seq)
-        self._admit_seq += 1
-        row = np.full((self.max_pages_per_slot,), NULL_PAGE, np.int32)
-        row[:n] = pages
-        self.tables[idx] = row
-        self.positions[idx] = 0
-        return idx
-
-    def retire(self, idx: int):
-        """Release slot ``idx``: pages back to the pool NOW, table row to
-        the null page, position to 0 (the inactive-slot encoding)."""
-        slot = self.slots[idx]
-        if slot is None:
-            raise ValueError(f"retire({idx}): slot is already free")
-        self.allocator.free(slot.pages)
-        self.slots[idx] = None
-        self.tables[idx] = NULL_PAGE
-        self.positions[idx] = 0
-
-    def reset_mirrors(self):
-        """Re-derive the host mirrors from the slot list (engine recovery:
-        after every implicated slot is retired, the mirrors must encode
-        exactly the inactive-slot pattern the fresh pool expects)."""
-        assert all(s is None for s in self.slots), \
-            "reset_mirrors with seated requests would corrupt their tables"
-        self.tables[:] = NULL_PAGE
-        self.positions[:] = 0
-
-    def advance(self, idx: int, n: int = 1):
-        """Record ``n`` more tokens written into slot ``idx`` (any n — the
-        variable-tokens-per-step contract; the pages those tokens touch
-        were reserved at admission)."""
-        slot = self.slots[idx]
-        assert slot is not None
-        slot.pos += n
-        self.positions[idx] = slot.pos
-
-    # -- variable tokens per step (the fused mixed prefill/decode plan) ----
-    def plan_step(self, prefill_token_budget: int) -> List[StepWork]:
-        """Plan one fused step: every seated slot contributes a
-        :class:`StepWork` — a run of up to the remaining shared
-        ``prefill_token_budget`` pending-prompt tokens, or one decode
-        token.  Slots are visited OLDEST ADMISSION FIRST (``Slot.seq``,
-        not slot index — admission reuses a freed index immediately, so
-        index order would let a low-index slot that churns through
-        budget-sized prompts starve an older mid-prefill slot forever);
-        a pending slot that gets no budget this tick simply waits (its
-        entry is omitted).  The plan never touches allocator or mirror
-        state — it is pure bookkeeping the engine turns into the step's
-        flat token arrays, and it only commits (``advance`` + pending
-        consumption) after the step succeeds, which is what makes a
-        failed step's retry idempotent."""
-        budget = int(prefill_token_budget)
-        work: List[StepWork] = []
-        for i, slot in sorted(self.seated(), key=lambda t: t[1].seq):
-            if slot.pending is not None and len(slot.pending):
-                if budget <= 0:
-                    continue
-                k = min(budget, len(slot.pending))
-                work.append(StepWork(i, "prefill", k, slot.pos,
-                                     k == len(slot.pending)))
-                budget -= k
-            else:
-                work.append(StepWork(i, "decode", 1, slot.pos, False))
-        return work
+__all__ = [
+    "AdmissionScheduler", "Scheduler", "Slot", "StepWork",
+    "LeastLoadedPlacement", "PlacementScheduler", "replica_load",
+]

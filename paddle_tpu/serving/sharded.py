@@ -1,0 +1,187 @@
+"""Mesh-native serving: ``dp`` replica engines x ``mp`` tensor-parallel
+chips behind ONE placement scheduler.
+
+``ShardedServingEngine`` is the cluster front end of the PR-14 scheduler
+split (docs/serving.md "Sharded serving"):
+
+- it builds one ``('mp',)`` submesh per ``dp`` replica over disjoint
+  device rows (``distributed/serving_mesh.replica_meshes``), gives each
+  replica its OWN model copy (weights column/row-parallel over ``mp``,
+  replicated across replicas) and its own :class:`ServingEngine` — pool,
+  slots, admission, fault containment, and the donated fused step all
+  per replica, compiled ONCE per replica as an SPMD program;
+- the paged KV pool inside each replica is sharded per-head
+  (``[num_pages, H/mp, page_size, D]`` per chip), the ragged/paged
+  kernels run per head shard under ``shard_map``, and the only hot-path
+  cross-chip reduce is the row-parallel post-attention/post-MLP
+  projection all-reduce GSPMD inserts;
+- ``submit`` goes through the placement layer
+  (``serving/placement.py``): least-loaded replica wins, queue-depth
+  backpressure is the signal, and a typed ``Overloaded`` shed happens
+  only when EVERY replica backpressures.
+
+Scaling shape: aggregate decode slots and page-pool HBM grow linearly
+with ``dp`` (each replica owns a full pool on its own chips); per-chip
+pool bytes shrink ~1/mp.  Greedy serving stays token-for-token equal to
+the single-chip engine and to ``generate()`` — the parity suite in
+tests/test_sharded_serving.py pins it for (dp, mp) in
+{(1,2), (2,1), (2,2)} on the forced-8-device CPU mesh.
+"""
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..distributed import serving_mesh as _srv_mesh
+from .engine import Request, RequestState, ServingEngine, ServingError
+from .placement import LeastLoadedPlacement, PlacementScheduler
+
+__all__ = ["ShardedServingEngine"]
+
+
+class ShardedServingEngine:
+    """``dp`` x ``mp`` sharded serving behind one submit/step interface.
+
+    ``model`` becomes replica 0 (its parameters are committed to replica
+    0's submesh — the engine takes placement ownership); further replicas
+    are fresh instances loaded from its exact ``state_dict``
+    (``model_factory`` overrides construction for classes whose
+    ``__init__`` needs more than the config).  Engine knobs
+    (``num_slots``, ``page_size``, pool sizing, fault containment, ...)
+    pass through to every replica unchanged — they are per-replica
+    quantities, so aggregate capacity is ``dp`` times each."""
+
+    def __init__(self, model, *, dp: int = 1, mp: int = 1,
+                 devices=None, model_factory: Optional[Callable] = None,
+                 placement=None, **engine_kw):
+        dp, mp = int(dp), int(mp)
+        if mp > 1:
+            # hard shard precondition, typed at construction (GL002
+            # formatting) — not a shard_map crash deep in the first step
+            _srv_mesh.validate_head_sharding(model.config.num_heads, mp)
+        self.dp, self.mp = dp, mp
+        self.meshes = _srv_mesh.replica_meshes(dp, mp, devices)
+        self.replicas: List[ServingEngine] = []
+        for i, mesh in enumerate(self.meshes):
+            rm = model if i == 0 else _srv_mesh.clone_model(
+                model, model_factory)
+            _srv_mesh.shard_model_for_serving(rm, mesh)
+            self.replicas.append(ServingEngine(rm, mesh=mesh, **engine_kw))
+        self.placement = PlacementScheduler(
+            self.replicas, policy=placement or LeastLoadedPlacement())
+        # per-tick replica stepping runs on one thread per replica (dp>1)
+        # so the replicas' device work overlaps: each engine's step holds
+        # only its own lock and drives only its own submesh, and the GIL
+        # is released for the device execution + host fetch — strictly
+        # sequential stepping would serialize the dp devices and break
+        # the ~linear aggregate-tokens/s scaling on real hardware
+        self._pool = (ThreadPoolExecutor(
+            max_workers=dp, thread_name_prefix="sharded-serving-step")
+            if dp > 1 else None)
+
+    # -- submission (placement layer) --------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 32, **kwargs) -> Request:
+        """Place the request on the least-loaded replica and queue it
+        there.  Typed ``Overloaded`` only when ALL replicas shed; the
+        seated replica's index rides on ``request.replica``."""
+        return self.placement.submit(prompt, max_new_tokens, **kwargs)
+
+    # -- the serving loop --------------------------------------------------
+    def step(self) -> dict:
+        """One cluster tick: every replica runs its own fused step (its
+        own admission, pool and fault containment), concurrently across
+        replicas when dp > 1.  Returns aggregate step metrics plus the
+        per-replica list (replica order preserved)."""
+        if self._pool is not None:
+            per = list(self._pool.map(lambda e: e.step(), self.replicas))
+        else:
+            per = [eng.step() for eng in self.replicas]
+        pages_used = sum(m["pages_used"] for m in per)
+        pages_cap = sum(m["pages_capacity"] for m in per)
+        agg = {
+            "active_slots": sum(m["active_slots"] for m in per),
+            "queue_depth": sum(m["queue_depth"] for m in per),
+            "pages_used": pages_used,
+            "pages_capacity": pages_cap,
+            "occupancy": pages_used / pages_cap if pages_cap else 0.0,
+            "replica_occupancy": [m["occupancy"] for m in per],
+            "tokens_this_step": sum(m["tokens_this_step"] for m in per),
+            "replicas": per,
+        }
+        return agg
+
+    def run_until_idle(self, max_steps: Optional[int] = None) -> dict:
+        """Step until every replica's queue and slots drain."""
+        steps = 0
+        while self.placement.pending():
+            met = self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+            if (not met["active_slots"] and not met["tokens_this_step"]
+                    and self.placement.pending()):
+                time.sleep(0.001)       # post-recovery backoff, any replica
+        return self.metrics()
+
+    def generate_batch(self, prompts, max_new_tokens: int = 32, *,
+                       raise_on_failure: bool = True,
+                       **kwargs) -> List[np.ndarray]:
+        """Submit every prompt through placement, drain the cluster,
+        return prompt+generated ids in submission order (the single-engine
+        ``generate_batch`` contract, including the typed error on non-DONE
+        terminals)."""
+        reqs = [self.submit(p, max_new_tokens, **kwargs) for p in prompts]
+        self.run_until_idle()
+        bad = [r for r in reqs if r.state != RequestState.DONE]
+        if bad and raise_on_failure:
+            detail = ", ".join(f"request {r.id}: {r.state}" for r in bad)
+            raise ServingError(
+                f"generate_batch: {len(bad)}/{len(reqs)} request(s) did "
+                f"not complete ({detail})") from bad[0].error
+        return [r.output_ids() for r in reqs]
+
+    # -- observability -----------------------------------------------------
+    def metrics(self) -> dict:
+        """Cluster metrics: summed counters/capacities (aggregate slots
+        and page HBM scale linearly with ``dp`` — the acceptance
+        criterion), per-chip pool bytes (shrink ~1/mp), and the full
+        per-replica metrics list."""
+        per = [eng.metrics() for eng in self.replicas]
+        sum_keys = ("steps", "tokens", "admitted", "completed",
+                    "fused_steps", "prefill_tokens", "failed", "cancelled",
+                    "timed_out", "shed", "quarantined", "recoveries",
+                    "rebuilds", "pages_used", "pages_capacity",
+                    "active_slots", "queue_depth", "cache_bytes",
+                    "work_items", "work_capacity", "block_rows",
+                    "block_row_capacity", "padded_rows", "padded_flops")
+        out = {k: sum(int(m.get(k, 0)) for m in per) for k in sum_keys}
+        # cluster-level sheds (all replicas backpressured) on top of the
+        # replicas' own shed counters (queue-wait shedding etc.) — the
+        # placement layer skips full replicas instead of probing their
+        # submit, so one rejected request counts exactly once
+        out["shed"] += self.placement.shed_total
+        out["placement_shed"] = self.placement.shed_total
+        out["dp"] = self.dp
+        out["mp"] = self.mp
+        out["slot_capacity"] = sum(e.num_slots for e in self.replicas)
+        out["cache_bytes_per_chip"] = (per[0]["cache_bytes_per_chip"]
+                                       if per else 0)
+        out["routed"] = list(self.placement.routed)
+        out["per_replica"] = per
+        return out
+
+    @property
+    def compiled_programs(self) -> int:
+        return sum(e.compiled_programs for e in self.replicas)
+
+    def lint_reports(self):
+        return [r for e in self.replicas for r in e.lint_reports()]
+
+    def close(self):
+        for eng in self.replicas:
+            eng.close()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)

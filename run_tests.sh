@@ -14,8 +14,11 @@
 #
 # A serving gate runs third (tools/serving_bench.py --gate — continuous
 # batching must stay retrace-free, match single-shot generate(), and keep
-# block accounting sound under pool backpressure; see docs/serving.md).
-# PADDLE_TPU_SKIP_SERVING_GATE=1 skips it.
+# block accounting sound under pool backpressure; on this 4+-device host
+# it also runs the sharded scenario: a (dp=2, mp=2) ShardedServingEngine
+# must reproduce generate() token-for-token through the placement layer
+# with exact page accounting on every replica; see docs/serving.md
+# "Sharded serving").  PADDLE_TPU_SKIP_SERVING_GATE=1 skips it.
 #
 # A serving fault-containment gate runs fourth (tools/serving_fault_gate.py
 # — injected step crashes/stalls/NaN logits/pool exhaustion must fail only

@@ -138,7 +138,12 @@ def _lint_decode(pt, np):
 def _lint_serve(pt, np):
     """The serving paged decode step — the hottest program under load, now
     a DEFAULT lint target instead of only being reachable via
-    ``ServingEngine.lint_reports()``."""
+    ``ServingEngine.lint_reports()``.  On hosts with >= 2 devices the
+    mesh-sharded fused step (shard_map'd per-head attention + GSPMD
+    column/row-parallel weights) lints too: the walkers must recurse into
+    the shard_map body without crashing and stay exit-0."""
+    import jax
+
     from paddle_tpu.models import gpt_tiny
     from paddle_tpu.serving import ServingEngine
 
@@ -154,6 +159,23 @@ def _lint_serve(pt, np):
         eng.run_until_idle()
     finally:
         eng.close()
+    if len(jax.devices()) >= 2:
+        from paddle_tpu.serving import ShardedServingEngine
+
+        model_s = _build_model(pt, cfg)
+        model_s.eval()
+        eng = ShardedServingEngine(model_s, dp=1, mp=2,
+                                   num_slots=_SRV_SLOTS,
+                                   page_size=_SRV_PAGE,
+                                   max_context=_SRV_CTX,
+                                   cache_dtype="bfloat16")
+        try:
+            for plen in _SRV_PROMPTS:
+                eng.submit(rng.randint(0, cfg.vocab_size, (plen,)),
+                           _SRV_NEW)
+            eng.run_until_idle()
+        finally:
+            eng.close()
 
 
 def _inject(analysis, code: str):

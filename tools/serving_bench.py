@@ -130,12 +130,18 @@ def _prompt_lengths(dist: str, n: int, fixed_cycle, max_prompt: int,
 
 
 def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24,
-          lengths: str = "fixed") -> int:
+          lengths: str = "fixed", mesh=(1, 1)) -> int:
     import jax
 
-    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving import ServingEngine, ShardedServingEngine
 
     on_tpu = jax.devices()[0].platform != "cpu"
+    dp, mp = int(mesh[0]), int(mesh[1])
+    if dp * mp > len(jax.devices()):
+        print(f"serving_bench: --mesh {dp},{mp} needs {dp * mp} devices, "
+              f"host has {len(jax.devices())}", file=sys.stderr)
+        return 1
+    sharded = dp * mp > 1
     model, cfg, kw, prompt_lens, max_new = _build(on_tpu)
     rng = np.random.RandomState(0)
     max_prompt = kw["max_context"] - max_new
@@ -144,12 +150,20 @@ def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24,
     prompts = [rng.randint(0, cfg.vocab_size, (plens[i],))
                for i in range(n_requests)]
     for load in loads:
-        eng = ServingEngine(model, **kw)
-        # warmup: compile the fused step outside the timed region
-        eng.submit(prompts[0], 2)
+        if sharded:
+            # fresh replica models per level would re-clone weights; the
+            # engine re-places the ONE model each time (same mesh) — cheap
+            eng = ShardedServingEngine(model, dp=dp, mp=mp, **kw)
+        else:
+            eng = ServingEngine(model, **kw)
+        # warmup: compile EVERY replica's fused step outside the timed
+        # region (one request per replica — least-loaded placement seats
+        # the k-th warmup on the k-th replica while the others queue)
+        for _ in range(dp if sharded else 1):
+            eng.submit(prompts[0], 2)
         eng.run_until_idle()
         base = eng.metrics()
-        occ, qd, steps, injected = [], [], 0, 0.0
+        occ, qd, rocc, steps, injected = [], [], [], 0, 0.0
         t0 = time.perf_counter()
         reqs = []
         while True:
@@ -161,8 +175,11 @@ def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24,
             steps += 1
             occ.append(met["occupancy"])
             qd.append(met["queue_depth"])
-            drained = (len(reqs) >= n_requests and not eng.queue.depth
-                       and not eng.scheduler.active_slots)
+            if sharded:
+                rocc.append(met["replica_occupancy"])
+            pending = (eng.placement.pending() if sharded
+                       else eng.queue.depth + eng.scheduler.active_slots)
+            drained = len(reqs) >= n_requests and not pending
             if drained or steps > 100000:
                 break
         dt = time.perf_counter() - t0
@@ -174,7 +191,7 @@ def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24,
         d_wcap = mets["work_capacity"] - base["work_capacity"]
         d_rows = mets["block_rows"] - base["block_rows"]
         d_rcap = mets["block_row_capacity"] - base["block_row_capacity"]
-        print(json.dumps({
+        line = {
             "metric": "serving_sweep",
             "offered_load": load,
             "lengths": lengths,
@@ -186,8 +203,27 @@ def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24,
             "completed": sum(r.finished for r in reqs),
             "steps": steps,
             "platform": "tpu" if on_tpu else "cpu",
-            **_slo_keys(mets),
-        }))
+        }
+        if sharded:
+            # mesh geometry + the dp-scaling evidence: AGGREGATE tokens/s
+            # (== tokens_per_sec), aggregate slot/page capacity, per-chip
+            # pool bytes (~1/mp), per-replica mean occupancy and routing.
+            # Per-request SLO percentiles are per-replica histograms and
+            # do not merge exactly — see metrics()["per_replica"].
+            line.update({
+                "dp": mets["dp"], "mp": mets["mp"],
+                "aggregate_tokens_per_sec": line["tokens_per_sec"],
+                "slot_capacity": mets["slot_capacity"],
+                "pages_capacity": mets["pages_capacity"],
+                "pool_bytes_per_chip": mets["cache_bytes_per_chip"],
+                "replica_occupancy": [
+                    round(float(np.mean(col)), 4)
+                    for col in np.asarray(rocc, float).T],
+                "routed": mets["routed"],
+            })
+        else:
+            line.update(_slo_keys(mets))
+        print(json.dumps(line))
         sys.stdout.flush()
         eng.close()
     return 0
@@ -267,7 +303,55 @@ def gate() -> int:
         return 1
     print(f"serving_gate: OK ({len(reqs)} requests, {steps} steps, "
           f"traces={tc}, peak_pages={peak}/{eng.allocator.capacity})")
-    return 0
+    eng.close()
+    return _gate_sharded(pt, serving, m, prompts, new_toks, refs)
+
+
+def _gate_sharded(pt, serving, model, prompts, new_toks, refs) -> int:
+    """The sharded half of the serving gate (4+ devices, e.g. the
+    run_tests.sh forced-8-device CPU mesh): a (dp=2, mp=2)
+    ShardedServingEngine must reproduce single-shot ``generate()``
+    token-for-token through the placement layer, stay retrace-free per
+    replica, and close page accounting on EVERY replica."""
+    import jax
+
+    from paddle_tpu.serving import ShardedServingEngine
+
+    if len(jax.devices()) < 4:
+        print("serving_gate: sharded scenario skipped "
+              f"({len(jax.devices())} devices < 4)")
+        return 0
+    serving.reset_serve_trace_counts()
+    eng = ShardedServingEngine(model, dp=2, mp=2, num_slots=2, page_size=16,
+                               max_context=64, num_pages=5,
+                               cache_dtype="float32")
+    try:
+        reqs = [eng.submit(p, n) for p, n in zip(prompts, new_toks)]
+        eng.run_until_idle(max_steps=2000)
+        tc = serving.serve_trace_counts()
+        if tc["fused"] > 2 * eng.dp:
+            print(f"serving_gate: FAIL sharded step retraced: {tc} "
+                  f"(> 2 per replica x dp={eng.dp})")
+            return 1
+        bad = sum(1 for r, ref in zip(reqs, refs)
+                  if not (r.finished
+                          and np.array_equal(r.output_ids(), ref)))
+        if bad:
+            print(f"serving_gate: FAIL sharded: {bad}/{len(reqs)} requests "
+                  "diverged from single-shot generate()")
+            return 1
+        for i, rep in enumerate(eng.replicas):
+            if rep.allocator.used_pages != 0:
+                print(f"serving_gate: FAIL sharded replica {i} leaked "
+                      f"{rep.allocator.used_pages} pages")
+                return 1
+        mets = eng.metrics()
+        print(f"serving_gate: sharded OK (dp=2 mp=2, {len(reqs)} requests, "
+              f"traces={tc}, routed={mets['routed']}, "
+              f"pool_per_chip={mets['cache_bytes_per_chip']}B)")
+        return 0
+    finally:
+        eng.close()
 
 
 def chaos(n_requests: int = 36, lengths: str = "fixed") -> int:
@@ -396,14 +480,25 @@ def main() -> int:
                     help="prompt-length distribution: the historical fixed "
                          "cycle, or a bounded Zipf long-tail (the skewed "
                          "regime the ragged fused step targets)")
+    ap.add_argument("--mesh", type=str, default="1,1", metavar="DP,MP",
+                    help="serving mesh geometry dp,mp (sweep mode): dp "
+                         "replica engines x mp tensor-parallel chips "
+                         "behind one placement scheduler; sweep lines "
+                         "gain dp/mp/aggregate tokens/s, per-replica "
+                         "occupancy and per-chip pool bytes")
     args = ap.parse_args()
     if args.gate:
         return gate()
     if args.chaos:
         return chaos(max(args.requests, 36) if args.requests != 24
                      else 36, lengths=args.lengths)
+    try:
+        mesh = tuple(int(x) for x in args.mesh.split(","))
+        assert len(mesh) == 2 and mesh[0] >= 1 and mesh[1] >= 1
+    except Exception:
+        ap.error(f"--mesh {args.mesh!r}: expected DP,MP (two ints >= 1)")
     return sweep(tuple(float(x) for x in args.loads.split(",")),
-                 args.requests, lengths=args.lengths)
+                 args.requests, lengths=args.lengths, mesh=mesh)
 
 
 if __name__ == "__main__":
