@@ -729,10 +729,45 @@ def main():
                 f"{s_dp * s_mp} devices, host has {len(jax.devices())}; "
                 "using 1,1\n")
             s_dp = s_mp = 1
+        # speculative serving (docs/serving.md "Speculative decoding"):
+        # BENCH_SPECULATE=draft,k opts the phase into a SpeculativeEngine
+        # — 'same' (acceptance 1.0) or '<n>layer' truncated draft, k
+        # proposals per slot per tick.  Off by default so the trajectory
+        # stays comparable; mutually exclusive with a >1 serving mesh.
+        s_spec = None
+        raw_spec = os.environ.get("BENCH_SPECULATE", "")
+        if raw_spec:
+            try:
+                sd, sk = raw_spec.split(",")
+                if sd != "same" and not (sd.endswith("layer")
+                                         and sd[:-len("layer")].isdigit()):
+                    raise ValueError(sd)
+                s_spec = (sd, int(sk))
+            except ValueError:
+                sys.stderr.write(f"bench: BENCH_SPECULATE={raw_spec!r} "
+                                 "unparsable (want same|<n>layer,k); "
+                                 "ignoring\n")
+            if s_spec and s_dp * s_mp > 1:
+                sys.stderr.write("bench: BENCH_SPECULATE ignored under "
+                                 "BENCH_SERVING_MESH>1,1 (speculation is "
+                                 "per-replica; use engine_factory)\n")
+                s_spec = None
         if s_dp * s_mp > 1:
             from paddle_tpu.serving import ShardedServingEngine
 
             eng = ShardedServingEngine(model, dp=s_dp, mp=s_mp, **s_kw)
+        elif s_spec is not None:
+            from paddle_tpu.serving import SpeculativeEngine
+
+            if s_spec[0] == "same":
+                s_draft = model
+            else:
+                from paddle_tpu.models import truncated_draft
+
+                s_draft = truncated_draft(model,
+                                          int(s_spec[0][:-len("layer")]))
+            eng = SpeculativeEngine(model, s_draft, spec_k=s_spec[1],
+                                    **s_kw)
         else:
             eng = ServingEngine(model, **s_kw)
         # warmup compiles the fused greedy step — one request per dp
@@ -779,7 +814,10 @@ def main():
             f"grid_occ={grid_occ:.3f} "
             f"q_row_occ={q_row_occ:.3f} "
             f"mem_delta={(mem_after - mem_before) / 2**20:.1f}MiB "
-            f"traces={tc} on {'tpu' if on_tpu else 'cpu'})",
+            + (f"spec={s_spec[0]},k={s_spec[1]} "
+               f"accept_rate={mets.get('spec_acceptance_rate', 0.0):.3f} "
+               if s_spec is not None else "")
+            + f"traces={tc} on {'tpu' if on_tpu else 'cpu'})",
             0.0,
         )
         # per-request SLO percentiles from the engine's telemetry

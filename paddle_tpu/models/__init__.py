@@ -16,6 +16,7 @@ from .gpt import (  # noqa: F401
     gpt_small,
     gpt_1p3b,
     gpt_13b,
+    truncated_draft,
 )
 from .ernie_moe import (  # noqa: F401
     ErnieMoEConfig, ErnieMoEForPretraining, ErnieMoEModel, ernie_moe_tiny,

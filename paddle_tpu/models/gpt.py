@@ -42,6 +42,7 @@ from ..distributed.fleet.layers.mpu.mp_layers import (
     VocabParallelEmbedding,
 )
 from ..distributed.fleet.recompute import recompute
+from ..ops.lora import lora_delta_raw
 from ..tensor import Parameter, Tensor, to_tensor
 from .generation import GenerationMixin, KVCache
 
@@ -53,6 +54,7 @@ __all__ = [
     "GPTStackedForPretraining",
     "GPTPretrainingCriterion",
     "KVCache",
+    "truncated_draft",
     "gpt_tiny",
     "gpt_small",
     "gpt_1p3b",
@@ -479,11 +481,17 @@ class GPTAttention(Layer):
     def forward(self, x: Tensor, attn_mask: Optional[Tensor] = None,
                 layer_kv=None, cache_index=None,
                 page_tables: Optional[Tensor] = None,
-                ragged_plan=None) -> Tensor:
+                ragged_plan=None, lora=None) -> Tensor:
         cfg = self._cfg
         b, s = x.shape[0], x.shape[1]
         nh, hd = cfg.num_heads, cfg.head_dim
         qkv = self.qkv_proj(x)                              # [B, S, 3H]
+        if lora is not None:
+            # per-token gathered low-rank delta on the SAME input as the
+            # base projection (serving/lora.py; slabs[0:2] = qkv A/B)
+            slabs, ids, lscale = lora
+            qkv = qkv + ops.gathered_lora_matmul(x, slabs[0], slabs[1],
+                                                 ids, lscale)
         qkv = ops.reshape(qkv, [b, s, 3, nh, hd])
         q = ops.squeeze(ops.slice(qkv, [2], [0], [1]), 2)   # [B, S, nh, hd]
         k = ops.squeeze(ops.slice(qkv, [2], [1], [2]), 2)
@@ -506,6 +514,10 @@ class GPTAttention(Layer):
                 out = _attend_paged(q, k, v, ck_t, cv_t, page_tables,
                                     _as_pos(cache_index), cfg,
                                     ragged_plan=ragged_plan)
+            elif lora is not None:
+                raise ValueError(
+                    "per-request LoRA adapters ride the paged serving "
+                    "step (page_tables required)")
             else:
                 out = _attend_with_cache(q, k, v, ck_t, cv_t,
                                          _as_pos(cache_index), cfg)
@@ -528,8 +540,12 @@ class GPTAttention(Layer):
                 use_flash=cfg.use_flash_attention,
             )                                               # [B, S, nh, hd]
         out = ops.reshape(out, [b, s, nh * hd])
-        out = self.out_proj(out)
-        return self.dropout(out)
+        proj = self.out_proj(out)
+        if lora is not None:
+            slabs, ids, lscale = lora
+            proj = proj + ops.gathered_lora_matmul(out, slabs[2], slabs[3],
+                                                   ids, lscale)
+        return self.dropout(proj)
 
 
 class GPTMLP(Layer):
@@ -545,8 +561,17 @@ class GPTMLP(Layer):
             self.fc2 = Linear(f, h, weight_attr=_winit(cfg))
         self.dropout = Dropout(cfg.hidden_dropout)
 
-    def forward(self, x: Tensor) -> Tensor:
-        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+    def forward(self, x: Tensor, lora=None) -> Tensor:
+        if lora is None:
+            return self.dropout(self.fc2(F.gelu(self.fc1(x),
+                                                approximate=True)))
+        slabs, ids, lscale = lora
+        u = self.fc1(x) + ops.gathered_lora_matmul(x, slabs[4], slabs[5],
+                                                   ids, lscale)
+        g = F.gelu(u, approximate=True)
+        y = self.fc2(g) + ops.gathered_lora_matmul(g, slabs[6], slabs[7],
+                                                   ids, lscale)
+        return self.dropout(y)
 
 
 class GPTDecoderLayer(Layer):
@@ -563,11 +588,11 @@ class GPTDecoderLayer(Layer):
     def forward(self, x: Tensor, attn_mask: Optional[Tensor] = None,
                 layer_kv=None, cache_index=None,
                 page_tables: Optional[Tensor] = None,
-                ragged_plan=None) -> Tensor:
+                ragged_plan=None, lora=None) -> Tensor:
         x = x + self.attn(self.ln1(x), attn_mask, layer_kv=layer_kv,
                           cache_index=cache_index, page_tables=page_tables,
-                          ragged_plan=ragged_plan)
-        x = x + self.mlp(self.ln2(x))
+                          ragged_plan=ragged_plan, lora=lora)
+        x = x + self.mlp(self.ln2(x), lora=lora)
         return _seq_shard(x, self._cfg)
 
 
@@ -587,7 +612,7 @@ class GPTModel(Layer):
                 attn_mask: Optional[Tensor] = None, kv_cache=None,
                 cache_index=None,
                 page_tables: Optional[Tensor] = None,
-                ragged_plan=None) -> Tensor:
+                ragged_plan=None, lora=None) -> Tensor:
         paged = bool(getattr(kv_cache, "paged", False))
         if paged and page_tables is None:
             raise ValueError("a paged KV cache needs page_tables "
@@ -605,15 +630,22 @@ class GPTModel(Layer):
         h = self.embeddings(input_ids, position_ids)
         k = self.config.recompute_interval
         for i, layer in enumerate(self.layers):
+            lr = None
+            if lora is not None:
+                # lora = (pool, per-token adapter-page ids): unpack this
+                # layer's slab 8-tuple (serving/lora.py layout)
+                pool_, ids_ = lora
+                lr = (pool_.layer_slabs(i), ids_, pool_.scaling)
             if kv_cache is not None:
                 h = layer(h, attn_mask, layer_kv=kv_cache.layer(i),
                           cache_index=pos,
                           page_tables=page_tables if paged else None,
-                          ragged_plan=ragged_plan if paged else None)
+                          ragged_plan=ragged_plan if paged else None,
+                          lora=lr)
             elif k and (i % k == 0) and self.training:
                 h = recompute(layer, h, attn_mask)
             else:
-                h = layer(h, attn_mask)
+                h = layer(h, attn_mask, lora=lr)
         return self.final_ln(h)
 
 
@@ -634,10 +666,12 @@ class GPTForPretraining(Layer, GenerationMixin):
                 attn_mask: Optional[Tensor] = None, kv_cache=None,
                 cache_index=None,
                 page_tables: Optional[Tensor] = None,
-                ragged_plan=None, out_rows: Optional[Tensor] = None) -> Tensor:
+                ragged_plan=None, out_rows: Optional[Tensor] = None,
+                lora=None) -> Tensor:
         h = self.gpt(input_ids, position_ids, attn_mask,
                      kv_cache=kv_cache, cache_index=cache_index,
-                     page_tables=page_tables, ragged_plan=ragged_plan)
+                     page_tables=page_tables, ragged_plan=ragged_plan,
+                     lora=lora)
         if out_rows is not None:
             # serving fused step: gather each slot's output row BEFORE the
             # vocab projection, so the LM head projects [S] rows instead of
@@ -669,16 +703,20 @@ class GPTForPretraining(Layer, GenerationMixin):
                             stacked=False)
 
     def _paged_lm_logits(self, input_ids, paged_cache, page_tables,
-                         positions, ragged_plan=None, out_rows=None):
+                         positions, ragged_plan=None, out_rows=None,
+                         lora=None):
         """[B, S, V] logits over the paged pool: ``positions`` is the
         per-slot position vector [B], ``page_tables`` [B, max_pages].
         With ``ragged_plan`` (the serving engine's fused mixed step),
         B is the flat token axis (S == 1) and attention runs through the
         ragged work-list kernel; ``out_rows`` [S] gathers each slot's
-        output row before the vocab projection (-> [S, 1, V])."""
+        output row before the vocab projection (-> [S, 1, V]).  ``lora``
+        is ``(LoRAAdapterPool, per-token adapter-page ids)`` — the
+        multi-tenant gathered low-rank deltas (serving/lora.py)."""
         return self.forward(input_ids, kv_cache=paged_cache,
                             cache_index=positions, page_tables=page_tables,
-                            ragged_plan=ragged_plan, out_rows=out_rows)
+                            ragged_plan=ragged_plan, out_rows=out_rows,
+                            lora=lora)
 
 
 class GPTStackedDecoder(Layer):
@@ -900,60 +938,93 @@ class GPTStackedDecoder(Layer):
         def ln(x, g, b):
             return _ln_f32(x, g, b, eps)
 
-        def block(p, h, kc, vc, tbl, pos, ragged_plan=None):
+        def block(p, h, kc, vc, tbl, pos, ragged_plan=None, lora=None):
             (l1g, l1b, qkvw, qkvb, pw, pb, l2g, l2b, f1w, f1b, f2w, f2b) = p
             if cdt is not None:
                 qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b = (
                     a.astype(cdt) for a in (qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b)
                 )
+            if lora is not None:
+                # per-token gathered low-rank deltas on the SAME inputs
+                # as the base projections (serving/lora.py slab layout)
+                (qa, qb, pa, pb2, f1a, f1b2, f2a, f2b2), ids, lsc = lora
+                ldelta = lambda x_, a_, b_: lora_delta_raw(x_, a_, b_, ids, lsc)  # noqa: E731,E501
+            else:
+                ldelta = lambda x_, a_, b_: jnp.zeros((), x_.dtype)  # noqa: E731,E501
+                qa = qb = pa = pb2 = f1a = f1b2 = f2a = f2b2 = None
             b, s, hidden = h.shape
             x = ln(h, l1g, l1b).astype(qkvw.dtype)
-            qkv = (x @ qkvw + qkvb).reshape(b, s, 3, nh, hd)
+            qkv = (x @ qkvw + qkvb + ldelta(x, qa, qb)).reshape(
+                b, s, 3, nh, hd)
             q, k, v = (jnp.swapaxes(qkv[:, :, i], 1, 2) for i in range(3))
             out, kc, vc = _raw_attend_paged(
                 q, k, v, kc, vc, tbl, pos, head_dim=hd, page_size=page_size,
                 ragged_plan=ragged_plan)
             out = jnp.swapaxes(out, 1, 2).reshape(b, s, hidden)
-            h = h + (out.astype(pw.dtype) @ pw + pb).astype(h.dtype)
+            oin = out.astype(pw.dtype)
+            h = h + (oin @ pw + pb + ldelta(oin, pa, pb2)).astype(h.dtype)
             y = ln(h, l2g, l2b).astype(f1w.dtype)
-            y = jax.nn.gelu(y @ f1w + f1b, approximate=True) @ f2w + f2b
+            g = jax.nn.gelu(y @ f1w + f1b + ldelta(y, f1a, f1b2),
+                            approximate=True)
+            y = g @ f2w + f2b + ldelta(g, f2a, f2b2)
             return h + y.astype(h.dtype), kc, vc
 
         return block
 
     def _forward_paged(self, hidden: Tensor, paged_cache, page_tables,
-                       cache_index, ragged_plan=None) -> Tensor:
+                       cache_index, ragged_plan=None, lora=None) -> Tensor:
         """Serving step over the stacked parameters with a STACKED
         [L, P, H, page_size, D] page pool: lax.scan carries the hidden
         state and scans the per-layer pool slices as xs/ys, exactly like
         _forward_cached scans the contiguous cache.  The updated pool is
         written back in place (mutation-logged -> donated under
         jit.to_static).  ``ragged_plan`` Tensors are scan constants: one
-        work list serves every layer of the fused mixed step."""
+        work list serves every layer of the fused mixed step.  ``lora``
+        is ``(LoRAAdapterPool, per-token adapter ids)``: the stacked
+        ``[L, pages, ...]`` adapter slabs scan alongside the parameters,
+        the ids ride as a scan constant."""
         from ..ops import dispatch
 
         pos = _as_pos(cache_index)
         block = self._paged_block_fn(int(paged_cache.page_size))
         plan = tuple(ragged_plan) if ragged_plan is not None else ()
         n_plan = len(plan)
+        if lora is not None:
+            pool_, ids_ = lora
+            slabs = tuple(pool_.stacked_slabs())     # 8 x [L, P, dim, r]
+            lscale = pool_.scaling
+            lora_in = (ids_,) + slabs
+        else:
+            lora_in, lscale = (), 0.0
+        n_lora = len(lora_in)
 
         def raw(h, posr, tbl, *rest):
             planr = rest[:n_plan] if n_plan else None
-            pk, pv, *stacked = rest[n_plan:]
+            rest = rest[n_plan:]
+            if n_lora:
+                idsr, *slabr = rest[:n_lora]
+                rest = rest[n_lora:]
+            pk, pv, *stacked = rest
 
             def step(carry, xs):
-                params, kc, vc = xs[:-2], xs[-2], xs[-1]
+                if n_lora:
+                    params, sl = xs[:-10], xs[-10:-2]
+                    lr = (tuple(sl), idsr, lscale)
+                else:
+                    params, lr = xs[:-2], None
+                kc, vc = xs[-2], xs[-1]
                 h2, kc2, vc2 = block(params, carry, kc, vc,
                                      tbl.astype(jnp.int32),
                                      posr.astype(jnp.int32),
-                                     ragged_plan=planr)
+                                     ragged_plan=planr, lora=lr)
                 return h2, (kc2, vc2)
 
-            h2, (pk2, pv2) = jax.lax.scan(step, h, tuple(stacked) + (pk, pv))
+            xs = tuple(stacked) + (tuple(slabr) if n_lora else ()) + (pk, pv)
+            h2, (pk2, pv2) = jax.lax.scan(step, h, xs)
             return h2, pk2, pv2
 
         out, pk_new, pv_new = dispatch.apply(
-            raw, hidden, pos, page_tables, *plan, paged_cache.k,
+            raw, hidden, pos, page_tables, *plan, *lora_in, paged_cache.k,
             paged_cache.v, *self._stacked(),
             op_name="gpt_stacked_decoder_paged")
         paged_cache.k._set_value(pk_new._value)
@@ -992,7 +1063,7 @@ class GPTStackedDecoder(Layer):
     def forward(self, hidden: Tensor, n_micro: int = 1, kv_cache=None,
                 cache_index=None,
                 page_tables: Optional[Tensor] = None,
-                ragged_plan=None) -> Tensor:
+                ragged_plan=None, lora=None) -> Tensor:
         """hidden: [B, S, H]. With a pp axis > 1, splits B into n_micro
         microbatches and pipelines; else scans layers.  With ``kv_cache``
         (serving), runs the cached decode scan instead — the paged scan
@@ -1006,8 +1077,12 @@ class GPTStackedDecoder(Layer):
                     raise ValueError("a paged KV cache needs page_tables")
                 return self._forward_paged(hidden, kv_cache, page_tables,
                                            cache_index,
-                                           ragged_plan=ragged_plan)
+                                           ragged_plan=ragged_plan,
+                                           lora=lora)
             return self._forward_cached(hidden, kv_cache, cache_index)
+        if lora is not None:
+            raise ValueError("per-request LoRA adapters ride the paged "
+                             "serving step (kv_cache + page_tables)")
 
         cfg = self._cfg
         block, with_dropout = self._block_fn()
@@ -1086,7 +1161,8 @@ class GPTStackedForPretraining(Layer, GenerationMixin):
                 labels: Optional[Tensor] = None, kv_cache=None,
                 cache_index=None,
                 page_tables: Optional[Tensor] = None,
-                ragged_plan=None, out_rows: Optional[Tensor] = None) -> Tensor:
+                ragged_plan=None, out_rows: Optional[Tensor] = None,
+                lora=None) -> Tensor:
         """Without ``labels``: returns [B, S, V] logits.  With ``labels``:
         returns the scalar LM loss through the fused linear+cross-entropy
         head (chunked over tokens, logits never fully materialized — the
@@ -1100,7 +1176,7 @@ class GPTStackedForPretraining(Layer, GenerationMixin):
         h = self.embeddings(input_ids, position_ids)
         h = self.decoder(h, n_micro=self.n_micro, kv_cache=kv_cache,
                          cache_index=cache_index, page_tables=page_tables,
-                         ragged_plan=ragged_plan)
+                         ragged_plan=ragged_plan, lora=lora)
         h = self.final_ln(h)
         if out_rows is not None:
             # serving fused step: gather each slot's output row BEFORE the
@@ -1137,10 +1213,44 @@ class GPTStackedForPretraining(Layer, GenerationMixin):
                             stacked=True)
 
     def _paged_lm_logits(self, input_ids, paged_cache, page_tables,
-                         positions, ragged_plan=None, out_rows=None):
+                         positions, ragged_plan=None, out_rows=None,
+                         lora=None):
         return self.forward(input_ids, kv_cache=paged_cache,
                             cache_index=positions, page_tables=page_tables,
-                            ragged_plan=ragged_plan, out_rows=out_rows)
+                            ragged_plan=ragged_plan, out_rows=out_rows,
+                            lora=lora)
+
+
+def truncated_draft(model, num_layers: int = 1):
+    """A weight-sharing TRUNCATED draft for speculative serving
+    (serving/speculative.py): same class, same embeddings / final LN /
+    tied LM head, but only the first ``num_layers`` decoder blocks — a
+    cheap proposer whose logits track the target's direct embedding path.
+    Weights are copied from ``model`` (stacked parameters sliced on the
+    leading layer axis), so the draft follows the target at construction
+    time; it owns its own paged pool inside the engine."""
+    import dataclasses
+
+    cfg = model.config
+    n = int(num_layers)
+    if not 1 <= n <= cfg.num_layers:
+        raise ValueError(f"truncated_draft: num_layers={n} not in "
+                         f"[1, {cfg.num_layers}]")
+    dcfg = dataclasses.replace(cfg, num_layers=n)
+    draft = type(model)(dcfg)
+    src = model.state_dict()
+    out = {}
+    for k, dv in draft.state_dict().items():
+        sv = src.get(k)
+        if sv is None:
+            continue
+        a = np.asarray(sv.numpy())
+        if tuple(a.shape) != tuple(dv.shape):
+            a = a[: dv.shape[0]]             # stacked [L, ...] layer slice
+        out[k] = a
+    draft.set_state_dict(out)
+    draft.eval()
+    return draft
 
 
 class GPTPretrainingCriterion(Layer):

@@ -6,8 +6,9 @@ onto the Tensor type at import time).
 """
 from __future__ import annotations
 
-from . import attribute, creation, dispatch, linalg, logic, manipulation, math, random, reduction, search
+from . import attribute, creation, dispatch, linalg, logic, lora, manipulation, math, random, reduction, search
 from .dispatch import enable_grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .lora import gathered_lora_matmul  # noqa: F401
 
 from .attribute import *  # noqa: F401,F403
 from .creation import *  # noqa: F401,F403

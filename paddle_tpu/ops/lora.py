@@ -1,0 +1,55 @@
+"""Per-slot gathered low-rank (LoRA) matmul.
+
+The multi-tenant serving step (serving/lora.py) keeps every registered
+adapter's low-rank factors in paged SLABS — ``A`` of shape
+``[num_adapter_pages, in_dim, r]`` and ``B`` of
+``[num_adapter_pages, r, out_dim]`` per target matrix — and each token of
+the fused step carries the int32 adapter-page id of its tenant.  The
+delta each projection adds is then one GATHERED low-rank matmul
+
+    delta[t] = scaling * (x[t] @ A[ids[t]]) @ B[ids[t]]
+
+computed without materializing any per-tenant dense weight: two batched
+``[in, r]``/``[r, out]`` contractions per token row.  Page 0 is the null
+adapter (zero factors), so tokens of adapter-less requests flow through
+the very same compiled program with a zero delta — one program, many
+tenants, no retrace when adapters register or evict.
+
+``lora_delta_raw`` is the traced (jnp) body shared by the GPT block
+functions; :func:`gathered_lora_matmul` is the Tensor-level op for eager
+callers and tests.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import dispatch
+
+__all__ = ["lora_delta_raw", "gathered_lora_matmul"]
+
+
+def lora_delta_raw(x, a_slab, b_slab, ids, scaling):
+    """Traced LoRA delta.  x: ``[T, S, in]`` (T token rows, each row's S
+    positions share the row's adapter); a_slab: ``[P, in, r]``; b_slab:
+    ``[P, r, out]``; ids: ``[T]`` int32 adapter-page ids ->
+    ``[T, S, out]`` in x's dtype.  The contraction runs in the slab dtype
+    (the adapter precision), the result casts back to x's dtype — the
+    same cast discipline as the base projections (graph_lint GL001)."""
+    idx = ids.astype(jnp.int32)
+    ag = jnp.take(a_slab, idx, axis=0)            # [T, in, r]
+    bg = jnp.take(b_slab, idx, axis=0)            # [T, r, out]
+    u = jnp.einsum("tsi,tir->tsr", x.astype(a_slab.dtype), ag,
+                   preferred_element_type=jnp.float32)
+    d = jnp.einsum("tsr,tro->tso", u.astype(b_slab.dtype), bg,
+                   preferred_element_type=jnp.float32)
+    return (d * jnp.asarray(scaling, jnp.float32)).astype(x.dtype)
+
+
+def gathered_lora_matmul(x, a_slab, b_slab, ids, scaling: float = 1.0):
+    """Tensor-level :func:`lora_delta_raw` (see there for shapes)."""
+    s = float(scaling)
+
+    def raw(xr, ar, br, idr):
+        return lora_delta_raw(xr, ar, br, idr, s)
+
+    return dispatch.apply_nondiff(raw, x, a_slab, b_slab, ids)

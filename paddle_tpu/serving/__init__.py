@@ -26,7 +26,15 @@ request mix (PAPERS.md: "Ragged Paged Attention", arxiv 2604.15464).
   streaming token callbacks, per-step metrics;
 - :mod:`faults` — deterministic fault-injection harness (step crashes,
   stalls, NaN logits, pool exhaustion, callback errors) driving
-  tests/test_serving_faults.py and tools/serving_fault_gate.py.
+  tests/test_serving_faults.py and tools/serving_fault_gate.py;
+- :mod:`speculative` — ``SpeculativeEngine``: draft-model propose +
+  ONE fused verify dispatch with in-graph accept/reject (greedy
+  bit-identical to the plain engine; sampling preserves the target
+  distribution exactly), draft pages under the allocator's
+  speculative-reservation/rollback API;
+- :mod:`lora` — ``LoRAAdapterPool``: paged per-request adapter slabs
+  gathered per token inside the step — one compiled program serves
+  many fine-tuned tenants, register/evict at runtime without retraces.
 
 See docs/serving.md (incl. the "Failure model & SLOs" section).
 """
@@ -46,7 +54,15 @@ from .engine import (  # noqa: F401
     reset_serve_trace_counts,
 )
 from .faults import FaultInjector, FaultPlan, InjectedFault, random_schedule  # noqa: F401,E501
+from .lora import (  # noqa: F401
+    AdapterError,
+    AdapterInUse,
+    LoRAAdapterPool,
+    UnknownAdapter,
+    random_adapter,
+)
 from .paged_cache import NULL_PAGE, BlockAllocator, PagedKVCache  # noqa: F401
+from .speculative import SpeculativeEngine  # noqa: F401
 from .scheduler import (  # noqa: F401
     AdmissionScheduler,
     LeastLoadedPlacement,
@@ -59,7 +75,9 @@ from .sharded import ShardedServingEngine  # noqa: F401
 
 __all__ = [
     "Request", "RequestQueue", "RequestState", "SamplingParams",
-    "ServingEngine", "ShardedServingEngine",
+    "ServingEngine", "ShardedServingEngine", "SpeculativeEngine",
+    "LoRAAdapterPool", "AdapterError", "AdapterInUse", "UnknownAdapter",
+    "random_adapter",
     "serve_trace_counts", "reset_serve_trace_counts",
     "ServingError", "Overloaded", "DeadlineExceeded", "RequestCancelled",
     "StepStalledError", "NaNLogitsError",

@@ -70,25 +70,31 @@ class StepWork:
     """One slot's share of a fused step: ``count`` tokens starting at
     absolute position ``base`` — a prefill run (``kind='prefill'``,
     ``completes`` when it exhausts the slot's pending prompt, so the
-    step's sampled token is the request's FIRST generated token) or one
-    decode token (``kind='decode'``)."""
+    step's sampled token is the request's FIRST generated token), one
+    decode token (``kind='decode'``), or a speculative verification run
+    (``kind='verify'``: the slot's last sampled token plus the draft
+    model's k proposals — ``count = 1 + k`` — whose accepted prefix the
+    engine commits via ``advance(idx, n_accepted + 1)``; see
+    serving/speculative.py).  ``drafts`` carries the proposed token ids
+    on verify runs (None otherwise)."""
 
-    __slots__ = ("slot", "kind", "count", "base", "completes")
+    __slots__ = ("slot", "kind", "count", "base", "completes", "drafts")
 
     def __init__(self, slot: int, kind: str, count: int, base: int,
-                 completes: bool):
+                 completes: bool, drafts=None):
         self.slot = slot
         self.kind = kind
         self.count = count
         self.base = base
         self.completes = completes
+        self.drafts = drafts
 
     @property
     def has_output(self) -> bool:
-        """Whether this run samples a token (decode always; a prefill run
-        only when it completes the prompt — mid-prefill runs emit
-        nothing)."""
-        return self.kind == "decode" or self.completes
+        """Whether this run samples a token (decode/verify always; a
+        prefill run only when it completes the prompt — mid-prefill runs
+        emit nothing)."""
+        return self.kind in ("decode", "verify") or self.completes
 
     def __repr__(self) -> str:
         return (f"StepWork(slot={self.slot}, {self.kind}, count={self.count},"

@@ -187,6 +187,7 @@ class Request:
         self.on_token = on_token
         self.state = RequestState.SUBMITTED
         self.tokens: List[int] = []      # generated ids, in order
+        self.adapter: Optional[str] = None   # LoRA tenant (serving/lora.py)
         # fault-containment bookkeeping
         self.deadline_s = None if deadline_s is None else float(deadline_s)
         self.deadline: Optional[float] = None   # absolute monotonic; at submit
@@ -314,17 +315,24 @@ class RequestQueue:
 # python-body execution counters (same invariant as models/generation):
 # the step bodies run ONLY while tracing — frozen counters across N steps
 # of request churn == the retrace-freedom proof.  One key since the fused
-# step collapsed the prefill/decode phase pair.  Lock-guarded: a sharded
-# cluster traces its dp replicas' steps on concurrent threads, and an
-# interleaved `+=` losing an increment would let a genuinely-retracing
-# step slip under the <= 2-per-replica gates.
-_SERVE_TRACE_COUNTS = {"fused": 0}
+# step collapsed the prefill/decode phase pair ("draft" counts the
+# speculative engine's draft-model fused step separately — the CI bound
+# is <= 2 target + <= 2 draft programs, serving/speculative.py).
+# Lock-guarded: a sharded cluster traces its dp replicas' steps on
+# concurrent threads, and an interleaved `+=` losing an increment would
+# let a genuinely-retracing step slip under the <= 2-per-replica gates.
+_SERVE_TRACE_COUNTS = {"fused": 0, "draft": 0}
 _SERVE_TRACE_LOCK = threading.Lock()
 
 
 def _count_fused_trace():
     with _SERVE_TRACE_LOCK:
         _SERVE_TRACE_COUNTS["fused"] += 1
+
+
+def _count_draft_trace():
+    with _SERVE_TRACE_LOCK:
+        _SERVE_TRACE_COUNTS["draft"] += 1
 
 # registry label for each engine's counters/histograms (one process may
 # host many engines; tests create dozens — the label keeps them distinct)
@@ -337,6 +345,7 @@ def serve_trace_counts() -> dict:
 
 def reset_serve_trace_counts():
     _SERVE_TRACE_COUNTS["fused"] = 0
+    _SERVE_TRACE_COUNTS["draft"] = 0
 
 
 def _sample_per_slot(logits: Tensor, temperature: Tensor, top_p: Tensor,
@@ -513,8 +522,12 @@ class ServingEngine:
                  max_queue_wait_s: Optional[float] = None,
                  readmission_backoff_s: float = 0.05,
                  backoff_max_s: float = 5.0,
-                 mesh=None):
+                 mesh=None, lora=None):
         cfg = model.config
+        # multi-tenant LoRA (serving/lora.py): per-request adapter-page
+        # ids ride the packed step input; the pool's slab Tensors are
+        # captured step state (register/evict never retrace)
+        self.lora = lora
         # mesh-sharded replica (docs/serving.md "Sharded serving"): the
         # page pool is sharded per-head over the mesh's 'mp' axis, step
         # inputs land replicated on the replica mesh, and the fused step
@@ -591,15 +604,15 @@ class ServingEngine:
             # the fused step's abstract scout would read as trace-created
             # state and break the scout's creation-ordinal matching
             self._generator._state  # noqa: B018 — lazy-init side effect
-        self._t_max = self.num_slots + self.prefill_token_budget
         # blocks: a slot contributes ONE run per step — a decode token
         # (one block) or a prefill run of c tokens (1 + (c-1)//qb blocks).
         # With P prefill runs sharing the budget, total blocks <=
         # (D + P) + (budget - P)//qb <= num_slots + budget//qb — tight,
         # with no double count for decode-vs-prefill (a slot is never
-        # both in one step)
-        self._nb_max = (self.num_slots
-                        + self.prefill_token_budget // self.token_block)
+        # both in one step).  Subclasses override _step_geometry (the
+        # speculative engine's verify runs are k+1 tokens per decode
+        # slot).
+        self._t_max, self._nb_max = self._step_geometry()
         self._wl_max = self._nb_max * max_pages_per_slot
 
         # fault-containment state
@@ -623,6 +636,10 @@ class ServingEngine:
 
         # host mirrors shipped to the jitted step each call (fixed shapes)
         self._tokens = np.zeros((num_slots,), np.int64)
+        # per-slot adapter page (0 = null adapter) + the seated adapter
+        # NAME pinning the page's refcount until retirement
+        self._adapter = np.zeros((num_slots,), np.int32)
+        self._adapter_name: List[Optional[str]] = [None] * num_slots
         self._temp = np.ones((num_slots,), np.float32)
         self._top_p = np.ones((num_slots,), np.float32)
         self._top_k = np.zeros((num_slots,), np.int32)
@@ -648,6 +665,11 @@ class ServingEngine:
             ("wl_pageslot", (self._wl_max,)),
             ("n_items", (1,)),
         ]
+        if self.lora is not None:
+            # per-token adapter-page ids (0 = null adapter) — only when a
+            # pool is attached, so the lora-less step program is unchanged
+            self._pack_layout.append(("adapters", (self._t_max,)))
+        self._pack_layout.extend(self._extra_pack_fields())
         self._pack_slices = {}
         off = 0
         for name, shp in self._pack_layout:
@@ -719,6 +741,20 @@ class ServingEngine:
 
         self._build_steps()
 
+    def _step_geometry(self) -> Tuple[int, int]:
+        """(t_max, nb_max): the fixed flat-token-axis length and block
+        count of the fused step.  Overridden by the speculative engine,
+        whose decode slots run k+1-token verify runs."""
+        return (self.num_slots + self.prefill_token_budget,
+                self.num_slots
+                + self.prefill_token_budget // self.token_block)
+
+    def _extra_pack_fields(self) -> list:
+        """Extra (name, shape) int32 fields appended to the packed step
+        input (subclass hook; the speculative engine adds the draft
+        tokens and per-slot draft counts)."""
+        return []
+
     def _new_pool(self):
         """A fresh page pool, committed to the replica mesh (per-head
         sharded over 'mp') when this engine is mesh-sharded.  Used at init
@@ -763,13 +799,21 @@ class ServingEngine:
 
         mesh = self.mesh
         generator = self._generator
+        lora_pool = self.lora
+        n_plan = len(RAGGED_PLAN_FIELDS)
 
         def _mk_fused(with_sampling):
             def fused_step(ids, packed, temp, top_p, top_k, do_sample):
                 _count_fused_trace()
-                (token_tables, positions, out_rows, *plan) = \
+                (token_tables, positions, out_rows, *rest) = \
                     dispatch.apply_nondiff(_unpack, packed)
-                plan = tuple(plan)
+                plan = tuple(rest[:n_plan])
+                lora_in = None
+                if lora_pool is not None:
+                    # (pool, per-token adapter-page ids): the slab Tensors
+                    # are CAPTURED state — registration mutates them in
+                    # place, so tenants come and go with zero retraces
+                    lora_in = (lora_pool, rest[n_plan])
                 # the serving-mesh context is TRACE-time state: the paged
                 # attention path reads it to shard_map the scatter+attend
                 # per head shard over 'mp' (no-op for mesh=None)
@@ -777,7 +821,8 @@ class ServingEngine:
                     logits = model._paged_lm_logits(ids, cache,
                                                     token_tables, positions,
                                                     ragged_plan=plan,
-                                                    out_rows=out_rows)
+                                                    out_rows=out_rows,
+                                                    lora=lora_in)
                     rows = _drop_seq_axis(logits).astype("float32")
                     fin = _slotwise_finite(rows)
                     if with_sampling:
@@ -798,7 +843,8 @@ class ServingEngine:
                sampling: Optional[SamplingParams] = None,
                eos_token_id: Optional[int] = None,
                on_token: Optional[Callable] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               adapter: Optional[str] = None) -> Request:
         """Queue a request; returns immediately.  Validation happens here
         so the step loop can never hit an unseatable request.  A full
         bounded queue raises the typed ``Overloaded`` error (load shed);
@@ -822,9 +868,14 @@ class ServingEngine:
             raise ValueError(
                 f"request needs {self.scheduler.pages_needed(total)} pages "
                 f"but the pool holds only {self.allocator.capacity}")
+        if adapter is not None and self.lora is None:
+            raise ValueError(
+                f"request names adapter {adapter!r} but the engine has no "
+                "LoRA pool (pass lora=LoRAAdapterPool(...) at construction)")
         req = Request(prompt, max_new_tokens, sampling=sampling,
                       eos_token_id=eos_token_id, on_token=on_token,
                       deadline_s=deadline_s)
+        req.adapter = adapter
         now = time.monotonic()
         req.submit_t = now
         req.t_submitted = now
@@ -861,33 +912,40 @@ class ServingEngine:
                 sched = self.scheduler
                 work = sched.plan_step(self.prefill_token_budget)
             if work:
-                # the step's flat inputs are a pure function of the host
-                # mirrors, which only advance on success — a retry after a
-                # transient failure rebuilds the SAME idempotent scatter
-                with _ttrace.span("serve.pack"):
-                    inputs, stats = self._build_step_inputs(work)
-                try:
-                    # the nested jit.fused_step span carries the program's
-                    # CostReport digest (per compiled entry, so greedy and
-                    # sampling variants each report their own cost)
-                    with _ttrace.span("serve.dispatch"):
-                        out = self._run_fused(inputs)
-                except StepStalledError as e:
-                    self._recover(e, rebuild=True, stalled=True)
-                    out = None
-                except Exception as e:  # noqa: BLE001 — containment boundary
-                    self._recover(e, rebuild=not _state_intact(e))
-                    out = None
-                if out is not None:
-                    # exact count of fused program executions — bench.py's
-                    # serving roofline denominator (ticks with no seated
-                    # work / failed dispatches don't run one)
-                    self._totals["fused_steps"] += 1
-                    with _ttrace.span("serve.harvest"):
-                        self._harvest_fused(work, stats, *out)
-                    self._backoff_s = self.readmission_backoff_s
+                self._dispatch_step(work)
             with _ttrace.span("serve.commit"):
                 return self._commit_step_metrics(t0)
+
+    def _dispatch_step(self, work):
+        """Pack -> dispatch (supervised, retried once) -> harvest for one
+        tick's plan.  Overridden by the speculative engine (draft propose
+        phase + verify dispatch); the recovery semantics here are the
+        containment contract both share."""
+        # the step's flat inputs are a pure function of the host
+        # mirrors, which only advance on success — a retry after a
+        # transient failure rebuilds the SAME idempotent scatter
+        with _ttrace.span("serve.pack"):
+            inputs, stats = self._build_step_inputs(work)
+        try:
+            # the nested jit.fused_step span carries the program's
+            # CostReport digest (per compiled entry, so greedy and
+            # sampling variants each report their own cost)
+            with _ttrace.span("serve.dispatch"):
+                out = self._run_fused(inputs)
+        except StepStalledError as e:
+            self._recover(e, rebuild=True, stalled=True)
+            out = None
+        except Exception as e:  # noqa: BLE001 — containment boundary
+            self._recover(e, rebuild=not _state_intact(e))
+            out = None
+        if out is not None:
+            # exact count of fused program executions — bench.py's
+            # serving roofline denominator (ticks with no seated
+            # work / failed dispatches don't run one)
+            self._totals["fused_steps"] += 1
+            with _ttrace.span("serve.harvest"):
+                self._harvest_fused(work, stats, *out)
+            self._backoff_s = self.readmission_backoff_s
 
     def _commit_step_metrics(self, t0: float) -> dict:
         """Fold the step's tallies into totals + gauges and build the
@@ -981,18 +1039,26 @@ class ServingEngine:
         tables = view("tables")
         positions = view("positions")
         out_rows = view("out_rows")
+        adapters = view("adapters") if self.lora is not None else None
         runs = []
         t = 0
         for w in work:
             slot = sched.slots[w.slot]
             if w.kind == "prefill":
                 ids[t:t + w.count] = slot.pending[:w.count]
+            elif w.kind == "verify":
+                # speculative verification run: the slot's last sampled
+                # token followed by the draft model's proposals
+                ids[t] = self._tokens[w.slot]
+                ids[t + 1:t + w.count] = w.drafts[:w.count - 1]
             else:
                 ids[t] = self._tokens[w.slot]
             row = sched.tables[w.slot]
             tables[t:t + w.count] = row
             positions[t:t + w.count] = w.base + np.arange(w.count,
                                                           dtype=np.int32)
+            if adapters is not None:
+                adapters[t:t + w.count] = self._adapter[w.slot]
             if w.has_output:
                 out_rows[w.slot] = t + w.count - 1
             runs.append((w.base, w.count, row))
@@ -1004,15 +1070,21 @@ class ServingEngine:
             view(k)[...] = plan[k]
         return (ids[:, None], packed), stats
 
-    def _fused_thunk(self, fused, inputs, cancelled):
+    def _fused_thunk(self, fused, inputs, cancelled, extra_dev=()):
         # the span records on the CALLING thread — under a watchdog this
         # is the supervised _StepWorker, so the exported trace shows the
         # device-dispatch range on the worker's row, interleaved with the
         # dispatcher's serve.dispatch wait on its own row
         with _ttrace.span("serve.device_step"):
-            return self._fused_thunk_body(fused, inputs, cancelled)
+            return self._fused_thunk_body(fused, inputs, cancelled,
+                                          extra_dev)
 
-    def _fused_thunk_body(self, fused, inputs, cancelled):
+    def _fused_thunk_body(self, fused, inputs, cancelled, extra_dev=()):
+        """Dispatch one compiled step: host inputs -> device, the cached
+        sampling vectors appended, then ``extra_dev`` (already-on-device
+        Tensors — the speculative verify step's draft probability rows).
+        Returns the program outputs as numpy plus the sampling-cache
+        build (committed by the dispatching thread only)."""
         self._hook("before_decode")
         if cancelled():          # abandoned while the fault hook stalled:
             return None          # the result is discarded; skip dispatch
@@ -1030,11 +1102,13 @@ class ServingEngine:
                 self._host_to_dev(self._top_p.copy()),
                 self._host_to_dev(self._top_k.copy()),
                 self._host_to_dev(self._do_sample.copy()))
-        toks, fin = fused(
+        out = fused(
             *(self._host_to_dev(np.ascontiguousarray(a)) for a in inputs),
-            *cache)
+            *cache, *extra_dev)
+        toks, fin = out[0], out[-1]
+        mid = tuple(np.asarray(o.numpy()) for o in out[1:-1])
         return (np.asarray(toks.numpy()),
-                np.array(np.asarray(fin.numpy()), bool), built)
+                np.array(np.asarray(fin.numpy()), bool), built, *mid)
 
     def _harvest_fused(self, work, stats, toks_np: np.ndarray,
                        fin_np: np.ndarray):
@@ -1045,21 +1119,7 @@ class ServingEngine:
         ctx = {"tokens": toks_np, "finite": fin_np}
         self._hook("after_decode", ctx)
         sched = self.scheduler
-        self._totals["prefill_tokens"] += sum(
-            w.count for w in work if w.kind == "prefill")
-        self._totals["work_items"] += stats["n_items"]
-        self._totals["work_capacity"] += stats["wl_capacity"]
-        self._totals["block_rows"] += stats["n_tokens"]
-        self._totals["block_row_capacity"] += stats["row_capacity"]
-        waste = ragged_padding_waste(
-            stats["n_tokens"], stats["n_blocks"], stats["n_items"],
-            self.token_block, self.page_size, self.head_dim,
-            dtype=self.cache_dtype)
-        self._totals["padded_rows"] += waste["padded_rows"]
-        self._totals["padded_flops"] += waste["wasted_flops"]
-        self._last_occupancy = (
-            stats["n_items"] / stats["wl_capacity"],
-            stats["n_tokens"] / max(stats["row_capacity"], 1))
+        self._fold_plan_stats(work, stats)
         for w in work:
             slot = sched.slots[w.slot]
             if slot is None:
@@ -1090,6 +1150,26 @@ class ServingEngine:
             self._emit(req, tok)
             if self._is_finished(req, tok):
                 self._finish(w.slot)
+
+    def _fold_plan_stats(self, work, stats):
+        """Fold one dispatched plan's occupancy/padding tallies into the
+        totals (shared by the base harvest and the speculative verify
+        harvest)."""
+        self._totals["prefill_tokens"] += sum(
+            w.count for w in work if w.kind == "prefill")
+        self._totals["work_items"] += stats["n_items"]
+        self._totals["work_capacity"] += stats["wl_capacity"]
+        self._totals["block_rows"] += stats["n_tokens"]
+        self._totals["block_row_capacity"] += stats["row_capacity"]
+        waste = ragged_padding_waste(
+            stats["n_tokens"], stats["n_blocks"], stats["n_items"],
+            self.token_block, self.page_size, self.head_dim,
+            dtype=self.cache_dtype)
+        self._totals["padded_rows"] += waste["padded_rows"]
+        self._totals["padded_flops"] += waste["wasted_flops"]
+        self._last_occupancy = (
+            stats["n_items"] / stats["wl_capacity"],
+            stats["n_tokens"] / max(stats["row_capacity"], 1))
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> dict:
         """Step until queue and slots drain; returns cumulative metrics."""
@@ -1152,14 +1232,19 @@ class ServingEngine:
                 # leaks per stall recovery)
                 self._worker.shutdown()
             self._worker = _StepWorker(f"serving-step-{id(self):x}")
+        return self._worker.run(fn, budget, cleanup=self._zombie_cleanup())
+
+    def _zombie_cleanup(self) -> Callable[[], None]:
+        """Cleanup an abandoned (stalled) step runs when it finally
+        returns: its write-backs landed in the orphaned pool Tensors —
+        release their device memory.  The speculative engine widens this
+        to its draft pool."""
         cache = self.cache
 
         def cleanup():
-            # the zombie finally returned: its write-backs landed in the
-            # orphaned pool Tensors — release their device memory now
             cache.release()
 
-        return self._worker.run(fn, budget, cleanup=cleanup)
+        return cleanup
 
     # -- reaping: deadlines, cancellation, queue-wait shedding -------------
     def _reap(self, now: float):
@@ -1220,13 +1305,28 @@ class ServingEngine:
             req = self.queue.pop()
             if req is None:
                 return
+            page = 0
+            if req.adapter is not None:
+                try:
+                    # pin the tenant's adapter page for the seated life of
+                    # the request (evicting it now raises AdapterInUse)
+                    page = self.lora.acquire(req.adapter)
+                except ServingError as e:
+                    # evicted while queued: fail THIS request, typed — a
+                    # silent null-adapter decode would be a wrong answer
+                    self._terminalize(req, RequestState.FAILED, e)
+                    continue
             total = req.prompt.size + req.max_new_tokens
             idx = sched.try_admit(req, total)
             if idx is None:
                 # pool backpressure: requeue and stop admitting (FIFO —
                 # later smaller requests must not starve this one)
+                if req.adapter is not None:
+                    self.lora.release(req.adapter)
                 self.queue.push_front(req)
                 return
+            self._adapter[idx] = page
+            self._adapter_name[idx] = req.adapter
             self._totals["admitted"] += 1
             req.t_admitted = now
             if req.t_submitted is not None:
@@ -1288,6 +1388,10 @@ class ServingEngine:
         self._top_p[idx] = 1.0
         self._top_k[idx] = 0
         self._do_sample[idx] = False
+        if self._adapter_name[idx] is not None:
+            self.lora.release(self._adapter_name[idx])
+        self._adapter[idx] = 0
+        self._adapter_name[idx] = None
         self._sampling_cache = None
 
     def _terminalize(self, req: Request, state: str,
@@ -1325,10 +1429,16 @@ class ServingEngine:
     def _fail_slot(self, idx: int, error: BaseException):
         self._retire_slot(idx, RequestState.FAILED, error)
 
-    def _emit(self, req: Request, tok: int):
+    def _emit(self, req: Request, tok: int, now: Optional[float] = None):
+        """Emit one generated token.  ``now`` lets a multi-token step
+        (speculative acceptance) stamp EVERY token it emits with the ONE
+        step timestamp — the documented ITL convention: the step's first
+        token observes the true inter-arrival gap, the rest observe 0
+        (they arrived in the same dispatch; docs/serving.md)."""
         req.tokens.append(tok)
         self._step_emitted += 1
-        now = time.monotonic()
+        if now is None:
+            now = time.monotonic()
         if req.t_first_token is None:
             req.t_first_token = now
             if req.t_submitted is not None:
@@ -1405,6 +1515,10 @@ class ServingEngine:
         # p50/p95/p99 per histogram — TTFT, inter-token latency, queue
         # wait, end-to-end (docs/observability.md "SLO definitions")
         out["slo"] = {k: h.summary() for k, h in self._slo.items()}
+        if self.lora is not None:
+            out["lora_adapters"] = len(self.lora.adapters())
+            out["lora_pages_used"] = self.lora.allocator.used_pages
+            out["lora_slab_bytes"] = self.lora.nbytes
         return out
 
     @property

@@ -99,6 +99,7 @@ class BlockAllocator:
         self.num_pages = num_pages
         self._free: deque = deque(range(1, num_pages))
         self._allocated: set = set()
+        self._spec: set = set()
         # test-only fault injection: fn("alloc", ctx) may set
         # ctx["force_none"] to simulate pool exhaustion (serving/faults.py;
         # same discipline as checkpoint/manager.py's _fault_hook)
@@ -116,6 +117,13 @@ class BlockAllocator:
     @property
     def used_pages(self) -> int:
         return len(self._allocated)
+
+    @property
+    def spec_pages(self) -> int:
+        """Pages held under a speculative reservation: taken from the free
+        list but not yet committed — a rejected speculation rolls them
+        straight back (docs/serving.md "Speculative decoding")."""
+        return len(self._spec)
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """n pages, or None (state unchanged) when fewer than n are free."""
@@ -141,4 +149,53 @@ class BlockAllocator:
                     f"free({p}): page is not currently allocated "
                     "(double free or foreign id)")
             self._allocated.discard(p)
+            self._free.append(p)
+
+    # -- speculative reservations ------------------------------------------
+    # The propose/verify loop (serving/speculative.py) writes K/V for
+    # tokens the target model may REJECT.  Pages backing only-speculative
+    # positions are reserved through this API instead of ``alloc`` so the
+    # accounting invariant stays exact through partial acceptance, faults,
+    # and retirement: every page is in exactly one of {free, allocated,
+    # speculative}, and free + used + spec == capacity at all times.
+
+    def reserve_spec(self, n: int) -> Optional[List[int]]:
+        """Reserve ``n`` pages speculatively (all-or-nothing, like
+        ``alloc``).  None when fewer than ``n`` are free — the caller
+        degrades (proposes fewer tokens) instead of corrupting state."""
+        if n < 0:
+            raise ValueError(f"reserve_spec({n})")
+        if self._fault_hook is not None:
+            ctx = {"force_none": False, "n": n, "spec": True}
+            self._fault_hook("alloc", ctx)
+            if ctx["force_none"]:
+                return None
+        if n > len(self._free):
+            return None
+        pages = [self._free.popleft() for _ in range(n)]
+        self._spec.update(pages)
+        return pages
+
+    def commit_spec(self, pages: List[int]):
+        """Promote speculatively reserved pages to regular allocations
+        (their positions were ACCEPTED — from here they free through the
+        normal ``free`` path at retirement).  Non-speculative ids raise."""
+        for p in pages:
+            if p not in self._spec:
+                raise ValueError(
+                    f"commit_spec({p}): page holds no speculative "
+                    "reservation (double commit or foreign id)")
+            self._spec.discard(p)
+            self._allocated.add(p)
+
+    def rollback_spec(self, pages: List[int]):
+        """Return speculatively reserved pages to the free list (their
+        positions were REJECTED, or the step they backed failed).
+        Non-speculative ids raise — exactly like ``free``."""
+        for p in pages:
+            if p not in self._spec:
+                raise ValueError(
+                    f"rollback_spec({p}): page holds no speculative "
+                    "reservation (double rollback or foreign id)")
+            self._spec.discard(p)
             self._free.append(p)

@@ -56,7 +56,8 @@ class ShardedServingEngine:
 
     def __init__(self, model, *, dp: int = 1, mp: int = 1,
                  devices=None, model_factory: Optional[Callable] = None,
-                 placement=None, **engine_kw):
+                 placement=None, engine_factory: Optional[Callable] = None,
+                 **engine_kw):
         dp, mp = int(dp), int(mp)
         if mp > 1:
             # hard shard precondition, typed at construction (GL002
@@ -69,7 +70,16 @@ class ShardedServingEngine:
             rm = model if i == 0 else _srv_mesh.clone_model(
                 model, model_factory)
             _srv_mesh.shard_model_for_serving(rm, mesh)
-            self.replicas.append(ServingEngine(rm, mesh=mesh, **engine_kw))
+            if engine_factory is not None:
+                # replica-level composition hook: a speculative replica
+                # (SpeculativeEngine + its own draft model clone) or a
+                # LoRA-pooled replica (per-replica slab Tensors) —
+                # docs/serving.md "Speculative decoding & multi-tenant
+                # LoRA".  Signature: (model, mesh, index, **engine_kw).
+                eng = engine_factory(rm, mesh, i, **engine_kw)
+            else:
+                eng = ServingEngine(rm, mesh=mesh, **engine_kw)
+            self.replicas.append(eng)
         self.placement = PlacementScheduler(
             self.replicas, policy=placement or LeastLoadedPlacement())
         # per-tick replica stepping runs on one thread per replica (dp>1)

@@ -14,11 +14,17 @@
 #
 # A serving gate runs third (tools/serving_bench.py --gate — continuous
 # batching must stay retrace-free, match single-shot generate(), and keep
-# block accounting sound under pool backpressure; on this 4+-device host
-# it also runs the sharded scenario: a (dp=2, mp=2) ShardedServingEngine
+# block accounting sound under pool backpressure; it also runs the
+# speculative scenario: greedy speculative output token-for-token equal
+# to the non-speculative engine and generate(), a same-model draft at
+# acceptance rate 1.0, randomized fault schedules draining BOTH pools —
+# incl. the speculative-reservation ledger — to zero, and fused trace
+# counts bounded at <= 2 target + <= 2 draft; on this 4+-device host it
+# also runs the sharded scenario: a (dp=2, mp=2) ShardedServingEngine
 # must reproduce generate() token-for-token through the placement layer
 # with exact page accounting on every replica; see docs/serving.md
-# "Sharded serving").  PADDLE_TPU_SKIP_SERVING_GATE=1 skips it.
+# "Sharded serving" and "Speculative decoding & multi-tenant LoRA").
+# PADDLE_TPU_SKIP_SERVING_GATE=1 skips it.
 #
 # A serving fault-containment gate runs fourth (tools/serving_fault_gate.py
 # — injected step crashes/stalls/NaN logits/pool exhaustion must fail only

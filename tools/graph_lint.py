@@ -159,6 +159,31 @@ def _lint_serve(pt, np):
         eng.run_until_idle()
     finally:
         eng.close()
+    # speculative + multi-tenant LoRA step variants (ISSUE-15): the
+    # verify program (in-graph accept/reject over gathered k+1 rows) and
+    # the draft program lint alongside a LoRA-pooled step whose gathered
+    # low-rank deltas must stay GL001-clean on a pure-bf16 model
+    from paddle_tpu.serving import (
+        LoRAAdapterPool, SpeculativeEngine, random_adapter,
+    )
+
+    model2 = _build_model(pt, cfg)
+    model2.eval()
+    pool = LoRAAdapterPool(cfg, num_adapter_pages=2, rank=4,
+                           dtype="bfloat16",
+                           stacked=hasattr(model2, "decoder"))
+    pool.register("tenant", random_adapter(cfg, 4, rng))
+    eng = SpeculativeEngine(model2, model2, spec_k=2,
+                            num_slots=_SRV_SLOTS, page_size=_SRV_PAGE,
+                            max_context=_SRV_CTX, cache_dtype="bfloat16",
+                            lora=pool)
+    try:
+        for i, plen in enumerate(_SRV_PROMPTS):
+            eng.submit(rng.randint(0, cfg.vocab_size, (plen,)), _SRV_NEW,
+                       adapter="tenant" if i % 2 == 0 else None)
+        eng.run_until_idle()
+    finally:
+        eng.close()
     if len(jax.devices()) >= 2:
         from paddle_tpu.serving import ShardedServingEngine
 

@@ -129,8 +129,23 @@ def _prompt_lengths(dist: str, n: int, fixed_cycle, max_prompt: int,
     raise ValueError(f"unknown --lengths {dist!r} (fixed|zipf)")
 
 
+def _make_draft(model, spec: str):
+    """Build the draft model a ``--speculate DRAFT,K`` run proposes with:
+    ``same`` (the target itself — acceptance 1.0, the pure dispatch-
+    amortization measurement) or ``<n>layer`` (a weight-sharing truncated
+    prefix, e.g. ``1layer`` — the cheap-draft regime)."""
+    if spec == "same":
+        return model
+    if spec.endswith("layer"):
+        from paddle_tpu.models import truncated_draft
+
+        return truncated_draft(model, int(spec[:-len("layer")]))
+    raise ValueError(f"unknown draft spec {spec!r} (same|<n>layer)")
+
+
 def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24,
-          lengths: str = "fixed", mesh=(1, 1)) -> int:
+          lengths: str = "fixed", mesh=(1, 1), speculate=None,
+          lora=None) -> int:
     import jax
 
     from paddle_tpu.serving import ServingEngine, ShardedServingEngine
@@ -149,13 +164,36 @@ def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24,
                             rng)
     prompts = [rng.randint(0, cfg.vocab_size, (plens[i],))
                for i in range(n_requests)]
+    draft = spec_k = pool = tenants = None
+    if speculate is not None:
+        from paddle_tpu.serving import SpeculativeEngine  # noqa: F401
+
+        draft_spec, spec_k = speculate
+        spec_k = int(spec_k)
+        draft = _make_draft(model, draft_spec)
+    if lora is not None:
+        from paddle_tpu.serving import LoRAAdapterPool, random_adapter
+
+        n_tenants, rank = int(lora[0]), int(lora[1])
+        pool = LoRAAdapterPool(cfg, num_adapter_pages=max(n_tenants, 1),
+                               rank=rank, dtype=kw["cache_dtype"],
+                               stacked=hasattr(model, "decoder"))
+        arng = np.random.RandomState(42)
+        tenants = [f"tenant{i}" for i in range(n_tenants)]
+        for t in tenants:
+            pool.register(t, random_adapter(cfg, rank, arng))
     for load in loads:
         if sharded:
             # fresh replica models per level would re-clone weights; the
             # engine re-places the ONE model each time (same mesh) — cheap
             eng = ShardedServingEngine(model, dp=dp, mp=mp, **kw)
+        elif speculate is not None:
+            from paddle_tpu.serving import SpeculativeEngine
+
+            eng = SpeculativeEngine(model, draft, spec_k=spec_k,
+                                    lora=pool, **kw)
         else:
-            eng = ServingEngine(model, **kw)
+            eng = ServingEngine(model, lora=pool, **kw)
         # warmup: compile EVERY replica's fused step outside the timed
         # region (one request per replica — least-loaded placement seats
         # the k-th warmup on the k-th replica while the others queue)
@@ -170,7 +208,10 @@ def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24,
             # inject `load` requests per step (fractional loads carry over)
             injected += load
             while len(reqs) < min(int(injected), n_requests):
-                reqs.append(eng.submit(prompts[len(reqs)], max_new))
+                ad = (tenants[len(reqs) % len(tenants)]
+                      if tenants else None)
+                reqs.append(eng.submit(prompts[len(reqs)], max_new,
+                                       adapter=ad))
             met = eng.step()
             steps += 1
             occ.append(met["occupancy"])
@@ -223,6 +264,21 @@ def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24,
             })
         else:
             line.update(_slo_keys(mets))
+        if speculate is not None:
+            # tokens/s above already counts ACCEPTED+bonus tokens only;
+            # acceptance rate is the efficiency of the draft
+            line.update({
+                "spec_draft": speculate[0], "spec_k": spec_k,
+                "accept_rate": round(mets.get("spec_acceptance_rate", 0.0),
+                                     4),
+                "spec_proposed": mets.get("spec_proposed_tokens", 0),
+                "draft_steps": mets.get("spec_draft_steps", 0),
+            })
+        if pool is not None:
+            line.update({
+                "lora_tenants": len(tenants), "lora_rank": pool.rank,
+                "adapter_slab_bytes": pool.nbytes,
+            })
         print(json.dumps(line))
         sys.stdout.flush()
         eng.close()
@@ -304,7 +360,79 @@ def gate() -> int:
     print(f"serving_gate: OK ({len(reqs)} requests, {steps} steps, "
           f"traces={tc}, peak_pages={peak}/{eng.allocator.capacity})")
     eng.close()
+    rc = _gate_speculative(pt, serving, m, prompts, new_toks, refs)
+    if rc:
+        return rc
     return _gate_sharded(pt, serving, m, prompts, new_toks, refs)
+
+
+def _gate_speculative(pt, serving, model, prompts, new_toks, refs) -> int:
+    """The speculative half of the serving gate (ISSUE-15): (a) greedy
+    speculative output token-for-token equal to the non-speculative
+    engine and to generate(), (b) a same-model draft accepts EVERYTHING
+    (rate 1.0), (c) page accounting — target AND draft pools, incl. the
+    speculative-reservation ledger — drains to zero under randomized
+    fault schedules with speculation on, (d) fused trace counts stay
+    bounded: <= 2 target + <= 2 draft programs."""
+    import numpy as _np
+
+    from paddle_tpu.serving import SpeculativeEngine
+    from paddle_tpu.serving.faults import random_schedule
+
+    serving.reset_serve_trace_counts()
+    eng = SpeculativeEngine(model, model, spec_k=3, num_slots=3,
+                            page_size=16, max_context=64,
+                            cache_dtype="float32")
+    try:
+        reqs = [eng.submit(p, n) for p, n in zip(prompts, new_toks)]
+        eng.run_until_idle(max_steps=2000)
+        bad = sum(1 for r, ref in zip(reqs, refs)
+                  if not (r.finished and _np.array_equal(r.output_ids(),
+                                                         ref)))
+        if bad:
+            print(f"serving_gate: FAIL speculative: {bad}/{len(reqs)} "
+                  "requests diverged from generate()/the non-speculative "
+                  "engine")
+            return 1
+        mets = eng.metrics()
+        if mets["spec_acceptance_rate"] != 1.0:
+            print("serving_gate: FAIL same-model draft acceptance "
+                  f"{mets['spec_acceptance_rate']} != 1.0")
+            return 1
+        tc = serving.serve_trace_counts()
+        if tc["fused"] > 2 or tc["draft"] > 2:
+            print(f"serving_gate: FAIL speculative step retraced: {tc}")
+            return 1
+    finally:
+        eng.close()
+    # (c): randomized fault schedules with speculation on
+    for seed in (0, 1, 2):
+        srng = _np.random.RandomState(seed)
+        eng = SpeculativeEngine(model, model, spec_k=3, num_slots=3,
+                                page_size=16, max_context=64,
+                                cache_dtype="float32")
+        try:
+            random_schedule(srng, horizon=25, n_faults=4,
+                            num_slots=3).install(eng)
+            sreqs = [eng.submit(p, n) for p, n in zip(prompts, new_toks)]
+            eng.run_until_idle(max_steps=3000)
+            if not all(r.terminal for r in sreqs):
+                print(f"serving_gate: FAIL spec-faults seed {seed}: "
+                      "non-terminal request after drain")
+                return 1
+            for alloc, tag in ((eng.allocator, "target"),
+                               (eng.draft.allocator, "draft")):
+                if alloc.used_pages or alloc.spec_pages \
+                        or alloc.free_pages != alloc.capacity:
+                    print(f"serving_gate: FAIL spec-faults seed {seed}: "
+                          f"{tag} pool did not drain (used="
+                          f"{alloc.used_pages} spec={alloc.spec_pages})")
+                    return 1
+        finally:
+            eng.close()
+    print(f"serving_gate: speculative OK (accept_rate=1.0, traces={tc}, "
+          "3 randomized fault schedules drained exactly)")
+    return 0
 
 
 def _gate_sharded(pt, serving, model, prompts, new_toks, refs) -> int:
@@ -480,6 +608,19 @@ def main() -> int:
                     help="prompt-length distribution: the historical fixed "
                          "cycle, or a bounded Zipf long-tail (the skewed "
                          "regime the ragged fused step targets)")
+    ap.add_argument("--speculate", type=str, default=None,
+                    metavar="DRAFT,K",
+                    help="sweep with speculative decoding: DRAFT is "
+                         "'same' (the target itself, acceptance 1.0) or "
+                         "'<n>layer' (weight-sharing truncated prefix, "
+                         "e.g. 1layer); K proposals per slot per tick. "
+                         "Lines gain spec_k/accept_rate/draft_steps")
+    ap.add_argument("--lora", type=str, default=None,
+                    metavar="N_TENANTS,RANK",
+                    help="sweep with a multi-tenant LoRA pool: N random "
+                         "adapters registered, requests round-robin over "
+                         "them. Lines gain lora_tenants/lora_rank/"
+                         "adapter_slab_bytes")
     ap.add_argument("--mesh", type=str, default="1,1", metavar="DP,MP",
                     help="serving mesh geometry dp,mp (sweep mode): dp "
                          "replica engines x mp tensor-parallel chips "
@@ -497,8 +638,24 @@ def main() -> int:
         assert len(mesh) == 2 and mesh[0] >= 1 and mesh[1] >= 1
     except Exception:
         ap.error(f"--mesh {args.mesh!r}: expected DP,MP (two ints >= 1)")
+    speculate = lora = None
+    if args.speculate:
+        parts = args.speculate.split(",")
+        if len(parts) != 2:
+            ap.error(f"--speculate {args.speculate!r}: expected DRAFT,K")
+        speculate = (parts[0], int(parts[1]))
+    if args.lora:
+        parts = args.lora.split(",")
+        if len(parts) != 2:
+            ap.error(f"--lora {args.lora!r}: expected N_TENANTS,RANK")
+        lora = (int(parts[0]), int(parts[1]))
+    if (speculate or lora) and mesh != (1, 1):
+        ap.error("--speculate/--lora compose with --mesh at the replica "
+                 "level via ShardedServingEngine(engine_factory=...); the "
+                 "bench sweeps them single-replica")
     return sweep(tuple(float(x) for x in args.loads.split(",")),
-                 args.requests, lengths=args.lengths, mesh=mesh)
+                 args.requests, lengths=args.lengths, mesh=mesh,
+                 speculate=speculate, lora=lora)
 
 
 if __name__ == "__main__":

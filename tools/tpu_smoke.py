@@ -369,6 +369,53 @@ def main() -> int:
               f"pool_per_chip={mets['cache_bytes_per_chip']}B")
         eng.close()
 
+    # -- speculative serving: on-chip draft propose + ONE fused verify
+    # dispatch with SHUFFLED pool pages in both pools; greedy output must
+    # match the unspeculated oracle token-for-token, both allocators must
+    # drain exactly (incl. the speculative-reservation ledger), and the
+    # trace budget must hold (<= 2 target + <= 2 draft) -------------------
+    def speculative_serving():
+        import paddle_tpu as pt
+        from paddle_tpu import serving
+        from paddle_tpu.models import GPTForPretraining, gpt_tiny
+        from paddle_tpu.serving import ServingEngine, SpeculativeEngine
+
+        pt.seed(0)
+        cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+        m = GPTForPretraining(cfg)
+        m.eval()
+        srng = np.random.RandomState(13)
+        prompts = [srng.randint(0, cfg.vocab_size, (s,))
+                   for s in (6, 17, 9, 23)]
+        oracle = ServingEngine(m, num_slots=2, page_size=128,
+                               max_context=128, cache_dtype="bfloat16")
+        refs = oracle.generate_batch(prompts, 5)
+        oracle.close()
+        serving.reset_serve_trace_counts()
+        eng = SpeculativeEngine(m, m, spec_k=3, num_slots=2, page_size=128,
+                                max_context=128, cache_dtype="bfloat16")
+        # fragment BOTH free lists: the verify and draft kernels must
+        # translate shuffled page tables via scalar prefetch
+        for alloc in (eng.allocator, eng.draft.allocator):
+            held = [alloc.alloc(1) for _ in range(3)]
+            alloc.free(held[0])
+            alloc.free(held[2])
+            alloc.free(held[1])
+        outs = eng.generate_batch(prompts, 5)
+        for got, ref in zip(outs, refs):
+            assert np.array_equal(got, ref), \
+                "speculative output diverged from the unspeculated oracle"
+        tc = serving.serve_trace_counts()
+        assert tc["fused"] <= 2 and tc["draft"] <= 2, tc
+        mets = eng.metrics()
+        for alloc, tag in ((eng.allocator, "target"),
+                           (eng.draft.allocator, "draft")):
+            assert alloc.used_pages == 0 and alloc.spec_pages == 0, \
+                f"{tag} pool did not drain"
+        print(f"tpu_smoke: speculative_serving accept_rate="
+              f"{mets['spec_acceptance_rate']:.3f} traces={tc}")
+        eng.close()
+
     # -- autotune: ONE real measured candidate sweep on-chip (decode
     # kernel, small cache), winner must be legal, parity must hold with
     # the winner forced, and the table must round-trip through replay
@@ -558,6 +605,7 @@ def main() -> int:
     check("checkpoint", checkpoint)
     check("serving_faults", serving_faults)
     check("sharded_serving", sharded_serving)
+    check("speculative_serving", speculative_serving)
     check("autotune_sweep", autotune_sweep)
     check("telemetry", telemetry)
     check("dist_fault", dist_fault)
