@@ -697,13 +697,17 @@ def main():
             ServingEngine, reset_serve_trace_counts, serve_trace_counts,
         )
 
+        # prefix_cache on: random prompts share no prefixes so the hit
+        # rate prints ~0 here (serving_bench --prefix-dist is the shared-
+        # prefix traffic bench) — the bench line pins the cache-enabled
+        # hot path's throughput trajectory
         if on_tpu:
             s_kw = dict(num_slots=8, page_size=128, max_context=512,
-                        cache_dtype="bfloat16")
+                        cache_dtype="bfloat16", prefix_cache=True)
             s_new, n_req, plens = 32, 16, (64, 200, 120, 380)
         else:
             s_kw = dict(num_slots=2, page_size=16, max_context=64,
-                        cache_dtype="bfloat16")
+                        cache_dtype="bfloat16", prefix_cache=True)
             s_new, n_req, plens = 4, 4, (8, 20, 12, 16)
         reset_serve_trace_counts()
         analysis.clear_cost_reports()  # this phase's programs only
@@ -813,6 +817,7 @@ def main():
             f"completed={mets['completed']} "
             f"grid_occ={grid_occ:.3f} "
             f"q_row_occ={q_row_occ:.3f} "
+            f"prefix_hit_rate={mets.get('prefix_hit_rate', 0.0):.3f} "
             f"mem_delta={(mem_after - mem_before) / 2**20:.1f}MiB "
             + (f"spec={s_spec[0]},k={s_spec[1]} "
                f"accept_rate={mets.get('spec_acceptance_rate', 0.0):.3f} "

@@ -592,7 +592,10 @@ class GPTDecoderLayer(Layer):
         x = x + self.attn(self.ln1(x), attn_mask, layer_kv=layer_kv,
                           cache_index=cache_index, page_tables=page_tables,
                           ragged_plan=ragged_plan, lora=lora)
-        x = x + self.mlp(self.ln2(x), lora=lora)
+        # pass lora only when active: subclasses swap self.mlp for layers
+        # with plain forward(x) signatures (ernie_moe's MoELayer)
+        h = self.ln2(x)
+        x = x + (self.mlp(h, lora=lora) if lora is not None else self.mlp(h))
         return _seq_shard(x, self._cfg)
 
 

@@ -10,6 +10,11 @@ request mix (PAPERS.md: "Ragged Paged Attention", arxiv 2604.15464).
   admission with up-front page reservation (out-of-pages admission
   backpressures into the queue), immediate page free on retirement
   (:mod:`scheduler` remains the compatibility facade);
+- :mod:`prefix_cache` — the global prefix cache (``PrefixCache``):
+  completed full pages radix-indexed by token-id chunks, spliced
+  copy-on-write into later admissions' page tables so only the uncached
+  tail prefills; LRU eviction of refcount-0 pages under pool pressure —
+  docs/serving.md "Prefix cache";
 - :mod:`placement` — the cluster-level scheduler: which ``dp`` replica
   seats a request (least-loaded, queue-depth backpressure signal; typed
   shed only when ALL replicas backpressure);
@@ -61,12 +66,19 @@ from .lora import (  # noqa: F401
     UnknownAdapter,
     random_adapter,
 )
-from .paged_cache import NULL_PAGE, BlockAllocator, PagedKVCache  # noqa: F401
+from .paged_cache import (  # noqa: F401
+    NULL_PAGE,
+    BlockAllocator,
+    PagedKVCache,
+    pages_for_tokens,
+)
+from .prefix_cache import PrefixCache  # noqa: F401
 from .speculative import SpeculativeEngine  # noqa: F401
 from .scheduler import (  # noqa: F401
     AdmissionScheduler,
     LeastLoadedPlacement,
     PlacementScheduler,
+    PrefixLocalityPlacement,
     Scheduler,
     Slot,
     replica_load,
@@ -82,7 +94,9 @@ __all__ = [
     "ServingError", "Overloaded", "DeadlineExceeded", "RequestCancelled",
     "StepStalledError", "NaNLogitsError",
     "FaultInjector", "FaultPlan", "InjectedFault", "random_schedule",
-    "NULL_PAGE", "BlockAllocator", "PagedKVCache",
+    "NULL_PAGE", "BlockAllocator", "PagedKVCache", "pages_for_tokens",
+    "PrefixCache",
     "AdmissionScheduler", "Scheduler", "Slot",
-    "PlacementScheduler", "LeastLoadedPlacement", "replica_load",
+    "PlacementScheduler", "LeastLoadedPlacement",
+    "PrefixLocalityPlacement", "replica_load",
 ]

@@ -40,7 +40,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from .paged_cache import NULL_PAGE, BlockAllocator
+from .paged_cache import NULL_PAGE, BlockAllocator, pages_for_tokens
 
 __all__ = ["Slot", "AdmissionScheduler", "Scheduler", "StepWork"]
 
@@ -53,17 +53,29 @@ class Slot:
     empty/None pending means the slot is decoding.  ``seq`` is the
     admission sequence number — ``plan_step`` drains the prefill budget
     oldest-admission-first, so slot INDEX (which admission reuses as soon
-    as a slot frees) never decides who prefills."""
+    as a slot frees) never decides who prefills.
 
-    __slots__ = ("request", "pages", "pos", "pending", "seq")
+    ``shared`` counts the slot's LEADING pages that live in the prefix
+    cache (spliced in at admission on a hit, or registered at harvest
+    once completed — pages complete strictly in order, so shared pages
+    are always a prefix of ``pages``); ``nodes`` holds the cache nodes
+    the slot keeps reader references on, released at retirement.  A slot
+    never writes its first ``shared`` pages — that is the COW ownership
+    rule (serving/prefix_cache.py)."""
+
+    __slots__ = ("request", "pages", "pos", "pending", "seq",
+                 "shared", "nodes")
 
     def __init__(self, request, pages: List[int], pos: int = 0,
-                 pending: Optional[np.ndarray] = None, seq: int = 0):
+                 pending: Optional[np.ndarray] = None, seq: int = 0,
+                 shared: int = 0, nodes: Optional[list] = None):
         self.request = request
         self.pages = pages
         self.pos = pos       # tokens written into the slot's pages so far
         self.pending = pending
         self.seq = seq
+        self.shared = shared
+        self.nodes = nodes if nodes is not None else []
 
 
 class StepWork:
@@ -115,6 +127,9 @@ class AdmissionScheduler:
                               np.int32)
         self.positions = np.zeros((num_slots,), np.int32)
         self._admit_seq = 0          # monotonic admission counter (fairness)
+        # optional global prefix cache (serving/prefix_cache.py) — the
+        # engine installs it; retirement releases slot references here
+        self.prefix_cache = None
 
     # -- queries -----------------------------------------------------------
     @property
@@ -139,13 +154,23 @@ class AdmissionScheduler:
         return self.allocator.used_pages / cap if cap else 0.0
 
     def pages_needed(self, total_tokens: int) -> int:
-        return -(-int(total_tokens) // self.page_size)
+        return pages_for_tokens(total_tokens, self.page_size)
 
     # -- admission / retirement --------------------------------------------
-    def try_admit(self, request, total_tokens: int) -> Optional[int]:
+    def try_admit(self, request, total_tokens: int, cached_pages=(),
+                  cached_nodes=(), n_cached: int = 0) -> Optional[int]:
         """Seat ``request`` in a free slot with pages reserved for
         ``total_tokens``; None (nothing changed) when no slot is free, the
-        request cannot fit a slot's table, or the pool lacks pages."""
+        request cannot fit a slot's table, or the pool lacks pages.
+
+        A prefix-cache hit passes the matched ``cached_pages`` (reader
+        references already taken on ``cached_nodes``) and ``n_cached``
+        tokens they hold: the TAIL-ONLY reservation allocates just
+        ``pages_needed(total) - len(cached_pages)`` fresh pages, the
+        cached pages are spliced into the front of the table row, and the
+        slot seats at position ``n_cached`` so prefill starts at the
+        first uncached token.  On None the caller still owns the
+        references (release them before requeueing)."""
         free = self.free_slot_indices()
         if not free:
             return None
@@ -155,25 +180,33 @@ class AdmissionScheduler:
                 f"request needs {n} pages but a slot holds at most "
                 f"{self.max_pages_per_slot} (max_context "
                 f"{self.max_pages_per_slot * self.page_size})")
-        pages = self.allocator.alloc(n)
-        if pages is None:
+        n_shared = len(cached_pages)
+        tail = self.allocator.alloc(n - n_shared)
+        if tail is None:
             return None          # pool backpressure: stays queued
+        pages = list(cached_pages) + tail
         idx = free[0]
-        self.slots[idx] = Slot(request, pages, seq=self._admit_seq)
+        self.slots[idx] = Slot(request, pages, pos=int(n_cached),
+                               seq=self._admit_seq, shared=n_shared,
+                               nodes=list(cached_nodes))
         self._admit_seq += 1
         row = np.full((self.max_pages_per_slot,), NULL_PAGE, np.int32)
         row[:n] = pages
         self.tables[idx] = row
-        self.positions[idx] = 0
+        self.positions[idx] = int(n_cached)
         return idx
 
     def retire(self, idx: int):
-        """Release slot ``idx``: pages back to the pool NOW, table row to
-        the null page, position to 0 (the inactive-slot encoding)."""
+        """Release slot ``idx``: private pages back to the pool NOW,
+        reader references on shared (prefix-cache) pages dropped, table
+        row to the null page, position to 0 (the inactive-slot
+        encoding)."""
         slot = self.slots[idx]
         if slot is None:
             raise ValueError(f"retire({idx}): slot is already free")
-        self.allocator.free(slot.pages)
+        if slot.nodes:
+            self.prefix_cache.release(slot.nodes)
+        self.allocator.free(slot.pages[slot.shared:])
         self.slots[idx] = None
         self.tables[idx] = NULL_PAGE
         self.positions[idx] = 0

@@ -96,6 +96,7 @@ from ..ops.pallas_kernels.ragged_paged_attention import (
 from ..tensor import Tensor, to_tensor
 from .admission import AdmissionScheduler, StepWork
 from .paged_cache import BlockAllocator
+from .prefix_cache import PrefixCache
 
 __all__ = [
     "RequestState", "SamplingParams", "Request", "RequestQueue",
@@ -522,7 +523,7 @@ class ServingEngine:
                  max_queue_wait_s: Optional[float] = None,
                  readmission_backoff_s: float = 0.05,
                  backoff_max_s: float = 5.0,
-                 mesh=None, lora=None):
+                 mesh=None, lora=None, prefix_cache: bool = False):
         cfg = model.config
         # multi-tenant LoRA (serving/lora.py): per-request adapter-page
         # ids ride the packed step input; the pool's slab Tensors are
@@ -576,6 +577,16 @@ class ServingEngine:
         self.allocator = BlockAllocator(num_pages)
         self.scheduler = AdmissionScheduler(num_slots, max_pages_per_slot,
                                             page_size, self.allocator)
+        # global prefix cache (serving/prefix_cache.py, opt-in): completed
+        # full pages are radix-indexed by their token-id chunks so a later
+        # admission splices the longest cached prefix into its page table
+        # and prefills only the uncached tail.  Installing it also hooks
+        # the allocator's pressure reclaimer (LRU eviction of refcount-0
+        # cache pages BEFORE admission backpressures).
+        self.prefix_cache = None
+        if prefix_cache:
+            self.prefix_cache = PrefixCache(self.allocator, self.page_size)
+            self.scheduler.prefix_cache = self.prefix_cache
         self.queue = RequestQueue(max_depth=max_queue_depth)
         self._lock = threading.RLock()
         self._closed = False
@@ -735,6 +746,21 @@ class ServingEngine:
             name: reg.gauge(f"serving_{name}").labels(**self._engine_label)
             for name in ("queue_depth", "active_slots", "pages_used",
                          "pool_occupancy")}
+        # prefix-cache counters (docs/serving.md "Prefix cache"): hit /
+        # partial-hit / miss classified per successful admission, eviction
+        # synced from the cache's own ledger (evictions fire inside the
+        # allocator's pressure reclaimer, outside any engine code path).
+        # Created even with the cache disabled so metrics() keys — and the
+        # sharded engine's cross-replica sums — are unconditionally present
+        self._prefix_totals = _tmetrics.CounterSet(
+            "serving_prefix", {"hits_total": 0, "misses_total": 0,
+                               "partial_hits_total": 0,
+                               "evictions_total": 0},
+            labels=self._engine_label)
+        self._prefix_hist = reg.histogram(
+            "serving_prefix_cached_tokens",
+            "prompt tokens served from the prefix cache per admission",
+        ).labels(**self._engine_label)
         self._step_emitted = 0           # tokens emitted in the current step
         self._last_metrics: dict = {}
         self._last_occupancy = (0.0, 0.0)   # (grid, q-row) of the last step
@@ -977,6 +1003,7 @@ class ServingEngine:
             "shed": self._totals["shed"],
             "recoveries": self._totals["recoveries"],
         }
+        self._sync_prefix_counters()
         g = self._gauges
         g["queue_depth"].set(self._last_metrics["queue_depth"])
         g["active_slots"].set(self._last_metrics["active_slots"])
@@ -1137,6 +1164,7 @@ class ServingEngine:
                 continue
             # the step wrote this run's K/V at positions base..base+count-1
             sched.advance(w.slot, w.count)
+            self._register_shared(w.slot)
             if not w.has_output:
                 continue                 # mid-prefill: nothing sampled yet
             req = slot.request
@@ -1170,6 +1198,52 @@ class ServingEngine:
         self._last_occupancy = (
             stats["n_items"] / stats["wl_capacity"],
             stats["n_tokens"] / max(stats["row_capacity"], 1))
+
+    def _register_shared(self, idx: int):
+        """Register slot ``idx``'s newly COMPLETED full pages in the
+        prefix cache (called at harvest, right after ``advance`` commits
+        the step's writes).  A page is complete once ``pos`` has advanced
+        past its last position — from then on the slot only writes
+        strictly later pages (COW by construction), so the page is
+        immutable and safe to share.  Pages complete in order, so the
+        shared pages always form a prefix of ``slot.pages``.
+
+        When another slot already registered an identical chunk (same
+        token path), the existing node's page is ADOPTED: it replaces the
+        slot's own page in its table row (deterministic KV — identical
+        token prefixes produce bitwise-identical pages) and the private
+        duplicate goes straight back to the pool."""
+        cache = self.prefix_cache
+        if cache is None:
+            return
+        sched = self.scheduler
+        slot = sched.slots[idx]
+        req = slot.request
+        if req.adapter is not None:
+            # LoRA'd KV depends on the adapter, not just the token ids —
+            # a cross-tenant hit would splice in the WRONG values.  Keyed
+            # per-adapter caching is future work; bypass for now.
+            return
+        ps = self.page_size
+        full = slot.pos // ps
+        if full <= slot.shared:
+            return
+        # written token ids at positions [0, pos): the prompt plus the
+        # emitted continuation (writes trail emissions by one token)
+        seq = np.concatenate(
+            [np.asarray(req.prompt, np.int64),
+             np.asarray(req.tokens, np.int64)])[:slot.pos]
+        while slot.shared < full:
+            i = slot.shared
+            parent = slot.nodes[-1] if slot.nodes else None
+            node, owned = cache.extend(parent, seq[i * ps:(i + 1) * ps],
+                                       slot.pages[i])
+            if not owned:
+                self.allocator.free([slot.pages[i]])
+                slot.pages[i] = node.page
+                sched.tables[idx, i] = node.page
+            slot.nodes.append(node)
+            slot.shared += 1
 
     def run_until_idle(self, max_steps: Optional[int] = None) -> dict:
         """Step until queue and slots drain; returns cumulative metrics."""
@@ -1317,10 +1391,20 @@ class ServingEngine:
                     self._terminalize(req, RequestState.FAILED, e)
                     continue
             total = req.prompt.size + req.max_new_tokens
-            idx = sched.try_admit(req, total)
+            # longest cached prefix: reader references taken NOW so the
+            # tail-only reservation below can never evict the hit pages
+            # (the allocator's pressure reclaimer skips referenced nodes)
+            c_nodes, c_pages, n_cached = (), (), 0
+            if self.prefix_cache is not None and req.adapter is None:
+                c_nodes, c_pages, n_cached = \
+                    self.prefix_cache.acquire(req.prompt)
+            idx = sched.try_admit(req, total, cached_pages=c_pages,
+                                  cached_nodes=c_nodes, n_cached=n_cached)
             if idx is None:
                 # pool backpressure: requeue and stop admitting (FIFO —
                 # later smaller requests must not starve this one)
+                if c_nodes:
+                    self.prefix_cache.release(c_nodes)
                 if req.adapter is not None:
                     self.lora.release(req.adapter)
                 self.queue.push_front(req)
@@ -1328,6 +1412,16 @@ class ServingEngine:
             self._adapter[idx] = page
             self._adapter_name[idx] = req.adapter
             self._totals["admitted"] += 1
+            if self.prefix_cache is not None and req.adapter is None:
+                cacheable = self.prefix_cache._cacheable_chunks(
+                    req.prompt.size) * self.page_size
+                if n_cached and n_cached >= cacheable:
+                    self._prefix_totals["hits_total"] += 1
+                elif n_cached:
+                    self._prefix_totals["partial_hits_total"] += 1
+                else:
+                    self._prefix_totals["misses_total"] += 1
+                self._prefix_hist.observe(float(n_cached))
             req.t_admitted = now
             if req.t_submitted is not None:
                 self._slo["queue_wait"].observe(now - req.t_submitted)
@@ -1337,7 +1431,11 @@ class ServingEngine:
             self._top_k[idx] = np.int32(sp.top_k)
             self._do_sample[idx] = bool(sp.do_sample)
             self._sampling_cache = None
-            sched.slots[idx].pending = np.asarray(req.prompt, np.int64)
+            # only the uncached tail still needs prefilling: the slot is
+            # seated at position n_cached and the fused step's first run
+            # for it starts there (traced per-slot positions — no retrace)
+            sched.slots[idx].pending = np.asarray(req.prompt[n_cached:],
+                                                  np.int64)
             req.state = RequestState.PREFILL
 
     # -- recovery ----------------------------------------------------------
@@ -1370,8 +1468,16 @@ class ServingEngine:
         land in orphaned Tensors, never in the new pool."""
         assert self.scheduler.active_slots == 0, \
             "rebuild with seated requests would strand their K/V"
+        if self.prefix_cache is not None:
+            # the fresh pool's content is zeroed: every cached KV page is
+            # invalid.  All readers retired above (refcounts 0), so the
+            # flush reclaims the whole shared ledger back to the free
+            # list — accounting stays exact through the rebuild.
+            self.prefix_cache.flush()
         assert self.allocator.used_pages == 0, \
             f"rebuild leaked {self.allocator.used_pages} pages"
+        assert self.allocator.shared_pages == 0, \
+            f"rebuild leaked {self.allocator.shared_pages} shared pages"
         with _ttrace.span("serve.rebuild"):
             old = self.cache
             self.cache = self._new_pool()
@@ -1515,11 +1621,43 @@ class ServingEngine:
         # p50/p95/p99 per histogram — TTFT, inter-token latency, queue
         # wait, end-to-end (docs/observability.md "SLO definitions")
         out["slo"] = {k: h.summary() for k, h in self._slo.items()}
+        # prefix-cache accounting (docs/serving.md "Prefix cache") — keys
+        # present unconditionally (zeros when disabled) so the sharded
+        # engine's cross-replica sums never miss a replica
+        self._sync_prefix_counters()
+        hits = self._prefix_totals["hits_total"]
+        partial = self._prefix_totals["partial_hits_total"]
+        misses = self._prefix_totals["misses_total"]
+        cached = int(self._prefix_hist.summary()["sum"])
+        out["prefix_hits"] = hits
+        out["prefix_partial_hits"] = partial
+        out["prefix_misses"] = misses
+        out["prefix_evictions"] = self._prefix_totals["evictions_total"]
+        out["prefix_cached_tokens"] = cached
+        looked = hits + partial + misses
+        out["prefix_hit_rate"] = (hits + partial) / looked if looked else 0.0
+        written = cached + self._totals["prefill_tokens"]
+        out["cached_tokens_share"] = cached / written if written else 0.0
+        out["prefix_cache_pages"] = (self.prefix_cache.pages
+                                     if self.prefix_cache else 0)
+        out["prefix_cache_nodes"] = (self.prefix_cache.nodes
+                                     if self.prefix_cache else 0)
+        out["shared_pages"] = self.allocator.shared_pages
         if self.lora is not None:
             out["lora_adapters"] = len(self.lora.adapters())
             out["lora_pages_used"] = self.lora.allocator.used_pages
             out["lora_slab_bytes"] = self.lora.nbytes
         return out
+
+    def _sync_prefix_counters(self):
+        """Mirror the cache's eviction tally onto the registry counter —
+        evictions fire inside the allocator's pressure reclaimer (mid
+        ``alloc``), where no engine code runs."""
+        if self.prefix_cache is None:
+            return
+        ev = self.prefix_cache.stats["evictions"]
+        if ev > self._prefix_totals["evictions_total"]:
+            self._prefix_totals["evictions_total"] = ev
 
     @property
     def _static_fns(self):

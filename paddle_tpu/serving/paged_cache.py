@@ -30,10 +30,29 @@ from ..core.dtype import to_jax_dtype
 from ..models.generation import _KVBuffers
 from ..tensor import Tensor
 
-__all__ = ["NULL_PAGE", "PagedKVCache", "BlockAllocator"]
+__all__ = ["NULL_PAGE", "PagedKVCache", "BlockAllocator",
+           "pages_for_tokens"]
 
 # pool page 0: reserved sink for inactive-slot / padding writes
 NULL_PAGE = 0
+
+
+def pages_for_tokens(tokens: int, page_size: int) -> int:
+    """Pages needed to hold ``tokens`` positions: ``ceil(tokens /
+    page_size)``.
+
+    THE page-math helper — admission sizing, speculative draft
+    reservations, and the prefix cache's tail-only reservation all route
+    through this one function so a rounding change can never diverge the
+    ledgers (the all-or-nothing reservation discipline only keeps
+    accounting exact while everyone agrees on the ceiling)."""
+    tokens = int(tokens)
+    page_size = int(page_size)
+    if tokens < 0:
+        raise ValueError(f"pages_for_tokens(tokens={tokens})")
+    if page_size < 1:
+        raise ValueError(f"pages_for_tokens(page_size={page_size})")
+    return -(-tokens // page_size)
 
 
 class PagedKVCache(_KVBuffers):
@@ -100,6 +119,15 @@ class BlockAllocator:
         self._free: deque = deque(range(1, num_pages))
         self._allocated: set = set()
         self._spec: set = set()
+        # shared (prefix-cache) pages: page id -> reader refcount.  A page
+        # at refcount 0 is cache-held: not free (its KV is live and
+        # indexed) but reclaimable under pool pressure via ``reclaimer``.
+        self._shared: dict = {}
+        # pool-pressure escape hatch: fn(deficit) -> pages reclaimed.  The
+        # prefix cache installs its LRU evictor here so cache-held pages
+        # are reclaimed BEFORE admission backpressures (never while
+        # referenced — ``reclaim`` refuses refcount > 0).
+        self.reclaimer = None
         # test-only fault injection: fn("alloc", ctx) may set
         # ctx["force_none"] to simulate pool exhaustion (serving/faults.py;
         # same discipline as checkpoint/manager.py's _fault_hook)
@@ -125,6 +153,20 @@ class BlockAllocator:
         straight back (docs/serving.md "Speculative decoding")."""
         return len(self._spec)
 
+    @property
+    def shared_pages(self) -> int:
+        """Pages owned by the prefix cache (any refcount, including the
+        evictable refcount-0 ones).  Every page is in exactly one of
+        {free, allocated, speculative, shared}:
+        ``free + used + spec + shared == capacity`` at all times."""
+        return len(self._shared)
+
+    def _reclaim_for(self, n: int):
+        """Ask the installed reclaimer to evict cache-held pages when the
+        free list cannot cover ``n`` — eviction before backpressure."""
+        if n > len(self._free) and self.reclaimer is not None:
+            self.reclaimer(n - len(self._free))
+
     def alloc(self, n: int) -> Optional[List[int]]:
         """n pages, or None (state unchanged) when fewer than n are free."""
         if n < 0:
@@ -134,6 +176,7 @@ class BlockAllocator:
             self._fault_hook("alloc", ctx)
             if ctx["force_none"]:
                 return None          # injected exhaustion: state unchanged
+        self._reclaim_for(n)
         if n > len(self._free):
             return None
         pages = [self._free.popleft() for _ in range(n)]
@@ -157,7 +200,8 @@ class BlockAllocator:
     # positions are reserved through this API instead of ``alloc`` so the
     # accounting invariant stays exact through partial acceptance, faults,
     # and retirement: every page is in exactly one of {free, allocated,
-    # speculative}, and free + used + spec == capacity at all times.
+    # speculative, shared}, and free + used + spec + shared == capacity at
+    # all times.
 
     def reserve_spec(self, n: int) -> Optional[List[int]]:
         """Reserve ``n`` pages speculatively (all-or-nothing, like
@@ -170,6 +214,7 @@ class BlockAllocator:
             self._fault_hook("alloc", ctx)
             if ctx["force_none"]:
                 return None
+        self._reclaim_for(n)
         if n > len(self._free):
             return None
         pages = [self._free.popleft() for _ in range(n)]
@@ -199,3 +244,62 @@ class BlockAllocator:
                     "reservation (double rollback or foreign id)")
             self._spec.discard(p)
             self._free.append(p)
+
+    # -- shared (prefix-cache) pages ----------------------------------------
+    # The prefix cache (serving/prefix_cache.py) indexes COMPLETED,
+    # immutable full pages so later admissions splice them into their page
+    # tables instead of re-prefilling.  Such pages move out of the
+    # ``allocated`` ledger into ``shared`` with a reader refcount: the
+    # registering slot keeps one reference, every admission that splices
+    # the page takes another, retirement drops it.  Refcount 0 leaves the
+    # page CACHE-HELD (evictable LRU), not free — ``reclaim`` is the only
+    # path back to the free list and it refuses referenced pages, so a
+    # page one slot still reads can never be handed to another.
+
+    def share(self, page: int):
+        """Move an allocated page into the shared ledger with refcount 1
+        (the registering slot's own reference).  Non-allocated ids raise —
+        only a page some slot exclusively owned (and therefore finished
+        writing) can become shared."""
+        if page not in self._allocated:
+            raise ValueError(
+                f"share({page}): page is not currently allocated "
+                "(already shared, free, or foreign id)")
+        self._allocated.discard(page)
+        self._shared[page] = 1
+
+    def ref(self, page: int):
+        """Take a reader reference on a shared page (a cache hit splices
+        it into another slot's page table)."""
+        if page not in self._shared:
+            raise ValueError(f"ref({page}): page is not shared")
+        self._shared[page] += 1
+
+    def unref(self, page: int):
+        """Drop a reader reference (slot retirement).  The page stays
+        shared at refcount 0 — cache-held and evictable.  Over-release
+        raises, exactly like a double ``free``."""
+        rc = self._shared.get(page)
+        if rc is None:
+            raise ValueError(f"unref({page}): page is not shared")
+        if rc <= 0:
+            raise ValueError(
+                f"unref({page}): refcount already 0 (over-release)")
+        self._shared[page] = rc - 1
+
+    def refcount(self, page: int) -> Optional[int]:
+        """Current reader refcount of a shared page (None if not shared)."""
+        return self._shared.get(page)
+
+    def reclaim(self, page: int):
+        """Return a refcount-0 shared page to the free list (prefix-cache
+        eviction).  Referenced pages raise — eviction must never race a
+        live reader."""
+        rc = self._shared.get(page)
+        if rc is None:
+            raise ValueError(f"reclaim({page}): page is not shared")
+        if rc != 0:
+            raise ValueError(
+                f"reclaim({page}): page still has {rc} reader(s)")
+        del self._shared[page]
+        self._free.append(page)

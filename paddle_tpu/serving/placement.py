@@ -25,7 +25,8 @@ from typing import List, Optional, Sequence, Tuple
 
 from .engine import Overloaded, Request
 
-__all__ = ["LeastLoadedPlacement", "PlacementScheduler", "replica_load"]
+__all__ = ["LeastLoadedPlacement", "PrefixLocalityPlacement",
+           "PlacementScheduler", "replica_load"]
 
 
 def replica_load(engine) -> Tuple[int, float, int]:
@@ -44,6 +45,28 @@ class LeastLoadedPlacement:
     def rank(self, engines: Sequence) -> List[int]:
         return sorted(range(len(engines)),
                       key=lambda i: (replica_load(engines[i]), i))
+
+
+class PrefixLocalityPlacement(LeastLoadedPlacement):
+    """Prefix-locality signal hook: prefer the replica whose prefix cache
+    already holds the longest prefix of THIS prompt (per-replica caches
+    never share pages, so routing siblings of a prompt family to the same
+    replica is what makes their prefixes hit), break ties least-loaded.
+
+    Deliberately a stub-grade heuristic (docs/serving.md "Prefix cache"):
+    the lookup is the cache's read-only ``match_len`` walk, load is only
+    a tiebreak — a saturated replica with a warm cache still wins over an
+    idle cold one.  Production policies would blend match length against
+    load; the ``rank_for`` hook is the seam they implement."""
+
+    def rank_for(self, engines: Sequence, prompt) -> List[int]:
+        def match(e) -> int:
+            cache = getattr(e, "prefix_cache", None)
+            return cache.match_len(prompt) if cache is not None else 0
+
+        return sorted(range(len(engines)),
+                      key=lambda i: (-match(engines[i]),
+                                     replica_load(engines[i]), i))
 
 
 class PlacementScheduler:
@@ -95,7 +118,13 @@ class PlacementScheduler:
         on (that replica's counter recorded a genuine full-queue event).
         """
         last: Optional[Overloaded] = None
-        for i in self.policy.rank(self.engines):
+        # prefix-locality hook: a policy exposing rank_for ranks with the
+        # PROMPT in hand (cache-affinity routing); plain policies keep the
+        # load-only rank() signature
+        ranker = getattr(self.policy, "rank_for", None)
+        order = (ranker(self.engines, prompt) if ranker is not None
+                 else self.policy.rank(self.engines))
+        for i in order:
             if not self._has_queue_room(self.engines[i]):
                 continue
             try:

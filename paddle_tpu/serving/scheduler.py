@@ -19,13 +19,17 @@ from .admission import (  # noqa: F401
     Slot,
     StepWork,
 )
+from .paged_cache import pages_for_tokens  # noqa: F401
 from .placement import (  # noqa: F401
     LeastLoadedPlacement,
     PlacementScheduler,
+    PrefixLocalityPlacement,
     replica_load,
 )
+from .prefix_cache import PrefixCache  # noqa: F401
 
 __all__ = [
     "AdmissionScheduler", "Scheduler", "Slot", "StepWork",
-    "LeastLoadedPlacement", "PlacementScheduler", "replica_load",
+    "LeastLoadedPlacement", "PlacementScheduler", "PrefixLocalityPlacement",
+    "PrefixCache", "pages_for_tokens", "replica_load",
 ]

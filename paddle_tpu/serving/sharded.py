@@ -166,8 +166,20 @@ class ShardedServingEngine:
                     "rebuilds", "pages_used", "pages_capacity",
                     "active_slots", "queue_depth", "cache_bytes",
                     "work_items", "work_capacity", "block_rows",
-                    "block_row_capacity", "padded_rows", "padded_flops")
+                    "block_row_capacity", "padded_rows", "padded_flops",
+                    # per-replica prefix caches (docs/serving.md "Prefix
+                    # cache"): hits/misses sum exactly; hit RATE is
+                    # re-derived from the sums below
+                    "prefix_hits", "prefix_partial_hits", "prefix_misses",
+                    "prefix_evictions", "prefix_cached_tokens",
+                    "prefix_cache_pages", "prefix_cache_nodes",
+                    "shared_pages")
         out = {k: sum(int(m.get(k, 0)) for m in per) for k in sum_keys}
+        looked = (out["prefix_hits"] + out["prefix_partial_hits"]
+                  + out["prefix_misses"])
+        out["prefix_hit_rate"] = ((out["prefix_hits"]
+                                   + out["prefix_partial_hits"]) / looked
+                                  if looked else 0.0)
         # cluster-level sheds (all replicas backpressured) on top of the
         # replicas' own shed counters (queue-wait shedding etc.) — the
         # placement layer skips full replicas instead of probing their

@@ -78,7 +78,7 @@ from .engine import (
     _drop_seq_axis,
     _state_intact,
 )
-from .paged_cache import NULL_PAGE, BlockAllocator
+from .paged_cache import NULL_PAGE, BlockAllocator, pages_for_tokens
 
 __all__ = ["SpeculativeEngine"]
 
@@ -371,7 +371,7 @@ class _DraftShadow:
         ``[0, total_tokens)`` need beyond the slot's current reservation.
         False (nothing changed) when the draft pool cannot serve them —
         the caller degrades instead of corrupting state."""
-        need = -(-int(total_tokens) // self.page_size)
+        need = pages_for_tokens(total_tokens, self.page_size)
         have = len(self.committed[idx]) + len(self.spec[idx])
         if need <= have:
             return True
@@ -387,7 +387,7 @@ class _DraftShadow:
         """Promote the speculative reservation covering the committed
         position, roll back the rest (partial-acceptance page rollback —
         rejected speculative pages return to the free list NOW)."""
-        need = -(-int(new_pos) // self.page_size)
+        need = pages_for_tokens(new_pos, self.page_size)
         n_commit = max(need - len(self.committed[idx]), 0)
         sp = self.spec[idx]
         keep, drop = sp[:n_commit], sp[n_commit:]
@@ -577,9 +577,17 @@ class SpeculativeEngine(ServingEngine):
     def _admit(self, now):
         before = {i for i, _s in self.scheduler.seated()}
         super()._admit(now)
-        for i, _slot in self.scheduler.seated():
+        for i, slot in self.scheduler.seated():
             if i not in before:
                 self.draft.seat(i)
+                if slot.pos:
+                    # prefix-cache hit on the TARGET: the draft's own pool
+                    # holds none of those positions, so the skipped prompt
+                    # tokens join its catch-up backlog — the propose loop
+                    # drains them through the normal lag path and resumes
+                    # proposing once the draft context is rebuilt
+                    self.draft.pending[i] = [
+                        int(t) for t in slot.request.prompt[:slot.pos]]
 
     def _clear_slot_mirrors(self, idx: int):
         super()._clear_slot_mirrors(idx)
@@ -875,6 +883,7 @@ class SpeculativeEngine(ServingEngine):
                     self._fail_slot(w.slot, _nan_err(slot, w))
                     continue
                 sched.advance(w.slot, w.count)
+                self._register_shared(w.slot)
                 if not w.completes:
                     continue
                 req = slot.request
@@ -909,6 +918,10 @@ class SpeculativeEngine(ServingEngine):
                     break
             old_pos = slot.pos
             sched.advance(w.slot, n_emit)
+            # pages the commit just completed become shareable — verify
+            # writes only ever land at positions >= the committed pos, so
+            # a completed page is immutable even under rejected drafts
+            self._register_shared(w.slot)
             # draft shadow bookkeeping: which of the committed inputs
             # ([t0, d1..d_{n_emit-1}]) did the draft write this tick?
             seq = ([int(self._tokens[w.slot])]

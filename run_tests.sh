@@ -62,7 +62,12 @@ print(os.pathsep.join([p for p in os.environ.get('PYTHONPATH','').split(os.paths
 PY
 )
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
-export JAX_COMPILATION_CACHE_DIR=/tmp/paddle_tpu_jax_cache
+# NOTE: the persistent XLA compilation cache is deliberately NOT
+# exported.  On jaxlib 0.4.36 executables deserialized from the disk
+# cache mis-handle donation aliasing on the forced 8-device CPU host
+# (garbage outputs / segfaults — see tests/conftest.py); conftest
+# force-disables it for the pytest suite, and the gates run without it.
+unset JAX_COMPILATION_CACHE_DIR
 
 if [ -z "$PADDLE_TPU_SKIP_LINT_GATE" ]; then
     echo "run_tests: graph-lint gate (tools/graph_lint.py --baseline)"

@@ -447,6 +447,55 @@ def test_randomized_fault_schedule_accounting_property(served, seed):
     _check_done_parity(reqs, refs)
 
 
+@pytest.mark.parametrize("seed", [11,
+                                  pytest.param(29, marks=pytest.mark.slow),
+                                  pytest.param(57, marks=pytest.mark.slow)])
+def test_randomized_fault_schedule_with_prefix_cache(served, seed):
+    """The accounting property EXTENDED to shared pages (docs/serving.md
+    "Prefix cache"): under randomized fault schedules with shared-prefix
+    traffic through a prefix-cache-enabled engine, the 4-term allocator
+    invariant ``free + used + spec + shared == capacity`` holds at every
+    step boundary — through admission splicing, retirement unref, LRU
+    eviction, and watchdog recovery (the rebuild flush) — every shared
+    page ends unreferenced, and survivors match the unfaulted run."""
+    m, cfg, prompts, refs = served
+    rng = np.random.RandomState(seed)
+    # siblings share one full page (page_size 16) so hits actually occur
+    prefix = rng.randint(0, cfg.vocab_size, (16,))
+    sprompts = [np.concatenate([prefix, p]) for p in prompts]
+    ref_eng = _engine(m, num_slots=3)
+    srefs = ref_eng.generate_batch(sprompts, N_NEW)
+    ref_eng.close()
+    eng = _engine(m, num_slots=3, prefix_cache=True)
+    random_schedule(rng, horizon=25, n_faults=4, num_slots=3).install(eng)
+    reqs = [eng.submit(p, N_NEW) for p in sprompts]
+    steps = 0
+    while eng.queue.depth or eng.scheduler.active_slots:
+        met = eng.step()
+        steps += 1
+        a = eng.allocator
+        assert (a.used_pages + a.spec_pages + a.free_pages
+                + a.shared_pages == a.capacity)
+        assert met["pages_used"] <= a.capacity
+        assert steps < 2000, "engine stopped making progress under faults"
+        if not met["active_slots"] and not met["tokens_this_step"]:
+            time.sleep(0.001)
+    a = eng.allocator
+    assert a.used_pages == 0 and a.spec_pages == 0
+    assert a.free_pages + a.shared_pages == a.capacity
+    assert all(c == 0 for c in a._shared.values()), (
+        "shared page still referenced after drain")
+    for r in reqs:
+        assert r.terminal, r.state
+        if r.state != RequestState.DONE:
+            assert r.error is not None, f"{r.state} without a typed error"
+    for r, ref in zip(reqs, srefs):
+        if r.state == RequestState.DONE:
+            assert np.array_equal(r.output_ids(), ref), (
+                f"request {r.id} diverged from the unfaulted run")
+    eng.close()
+
+
 def test_generate_batch_raises_on_failed_requests(served):
     m, cfg, prompts, refs = served
     eng = _engine(m)

@@ -285,6 +285,127 @@ def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24,
     return 0
 
 
+def prefix_sweep(prefix_spec: str, n_requests: int = 24,
+                 families: int = 2) -> int:
+    """``--prefix-dist``: shared-prefix traffic through the prefix cache
+    (docs/serving.md "Prefix cache") — ONE JSON line per system-prompt
+    length:
+
+      {"metric": "serving_prefix_sweep", "prefix_len": ...,
+       "prefix_hit_rate": ..., "cached_tokens_share": ...,
+       "prefill_tokens_per_req": ..., "ttft_ms_p50/p95": ..., ...}
+
+    Traffic model: ``families`` system prompts of the level's length, each
+    request = family prefix + a unique bounded-Zipf tail.  TOTAL prompt
+    length per request index is FIXED across levels (longest prefix +
+    tail) — only the shared/unique split moves, so a falling
+    ``prefill_tokens_per_req`` and TTFT are attributable to the cache,
+    not to shorter prompts.  Each level runs a fresh ``prefix_cache=True``
+    engine; the cache is primed per family (one request of exactly the
+    shared prefix) in the untimed warmup window, so the measured window
+    is the warm-cache steady state production system prompts live in.
+    TTFT percentiles come from the measured requests' own timestamps
+    (``t_first_token - t_submitted``) — warmup/priming excluded."""
+    import jax
+
+    from paddle_tpu.serving import ServingEngine
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    model, cfg, kw, _plens, max_new = _build(on_tpu)
+    ps = kw["page_size"]
+    max_prompt = kw["max_context"] - max_new
+    if prefix_spec == "auto":
+        # page-size multiples up to 3 pages — the whole-page granularity
+        # the radix index caches at
+        prefix_lens = [0, ps, 2 * ps, 3 * ps]
+    else:
+        prefix_lens = [int(x) for x in prefix_spec.split(",")]
+    longest = max(prefix_lens)
+    if longest + 1 > max_prompt:
+        print(f"serving_bench: --prefix-dist {prefix_spec!r}: longest "
+              f"prefix {longest} leaves no room for a tail (max prompt "
+              f"{max_prompt} at max_context {kw['max_context']})",
+              file=sys.stderr)
+        return 1
+    rng = np.random.RandomState(7)
+    fam_base = [rng.randint(0, cfg.vocab_size, (longest,))
+                for _ in range(families)]
+    tail_cap = max(max_prompt - longest, 1)
+    tails = np.minimum(rng.zipf(1.6, size=n_requests),
+                       tail_cap).astype(int)
+    totals = longest + tails                     # same at every level
+    uniq = [rng.randint(0, cfg.vocab_size, (int(t),)) for t in totals]
+    for plen in prefix_lens:
+        eng = ServingEngine(model, prefix_cache=True, **kw)
+        eng.submit(uniq[0][:2], 2)               # warmup: compile
+        eng.run_until_idle()
+        if plen:
+            # prime each family's prefix into the cache (registration
+            # happens at page completion during this request's decode)
+            for f in range(families):
+                eng.submit(np.concatenate(
+                    [fam_base[f][:plen], uniq[f][:1]]), 2)
+            eng.run_until_idle()
+        base = eng.metrics()
+        prompts = [np.concatenate([fam_base[i % families][:plen],
+                                   uniq[i][:int(totals[i]) - plen]])
+                   for i in range(n_requests)]
+        reqs, steps = [], 0
+        t0 = time.perf_counter()
+        while True:
+            injected = min(len(reqs) + 2, n_requests)
+            while len(reqs) < injected:
+                reqs.append(eng.submit(prompts[len(reqs)], max_new))
+            eng.step()
+            steps += 1
+            pending = eng.queue.depth + eng.scheduler.active_slots
+            if (len(reqs) >= n_requests and not pending) or steps > 100000:
+                break
+        dt = time.perf_counter() - t0
+        mets = eng.metrics()
+        ttft = np.asarray([r.t_first_token - r.t_submitted
+                           for r in reqs if r.t_first_token is not None])
+        d_prefill = mets["prefill_tokens"] - base["prefill_tokens"]
+        d_hits = mets["prefix_hits"] - base["prefix_hits"]
+        d_partial = (mets["prefix_partial_hits"]
+                     - base["prefix_partial_hits"])
+        d_miss = mets["prefix_misses"] - base["prefix_misses"]
+        d_cached = (mets["prefix_cached_tokens"]
+                    - base["prefix_cached_tokens"])
+        looked = d_hits + d_partial + d_miss
+        print(json.dumps({
+            "metric": "serving_prefix_sweep",
+            "prefix_len": plen,
+            "families": families,
+            "requests": n_requests,
+            "completed": sum(r.finished for r in reqs),
+            "prefix_hit_rate": round((d_hits + d_partial) / looked, 4)
+            if looked else 0.0,
+            "cached_tokens_share": round(
+                d_cached / (d_cached + d_prefill), 4)
+            if (d_cached + d_prefill) else 0.0,
+            "prefill_tokens_per_req": round(d_prefill / n_requests, 2),
+            "cached_tokens_per_req": round(d_cached / n_requests, 2),
+            "tokens_per_sec": round(
+                sum(len(r.tokens) for r in reqs) / dt, 1),
+            "ttft_ms_p50": round(
+                float(np.percentile(ttft, 50)) * 1000.0, 2),
+            "ttft_ms_p95": round(
+                float(np.percentile(ttft, 95)) * 1000.0, 2),
+            "evictions": mets["prefix_evictions"],
+            "shared_pages": mets["shared_pages"],
+            "steps": steps,
+            "platform": "tpu" if on_tpu else "cpu",
+        }))
+        sys.stdout.flush()
+        if eng.allocator.used_pages != 0:
+            print(f"serving_bench: FAIL prefix sweep leaked "
+                  f"{eng.allocator.used_pages} pages at prefix_len={plen}")
+            return 1
+        eng.close()
+    return 0
+
+
 def gate() -> int:
     import paddle_tpu as pt
     from paddle_tpu import serving
@@ -608,6 +729,17 @@ def main() -> int:
                     help="prompt-length distribution: the historical fixed "
                          "cycle, or a bounded Zipf long-tail (the skewed "
                          "regime the ragged fused step targets)")
+    ap.add_argument("--prefix-dist", type=str, default=None,
+                    metavar="L0,L1,...",
+                    help="shared-prefix sweep through the prefix cache: "
+                         "one line per system-prompt length (comma list "
+                         "of token counts, or 'auto' for page-size "
+                         "multiples 0..3), requests = family prefix + "
+                         "bounded-Zipf unique tail with total length "
+                         "fixed across levels. Lines report "
+                         "prefix_hit_rate, cached_tokens_share, "
+                         "prefill_tokens_per_req, and TTFT percentiles "
+                         "— all must fall as the cached share rises")
     ap.add_argument("--speculate", type=str, default=None,
                     metavar="DRAFT,K",
                     help="sweep with speculative decoding: DRAFT is "
@@ -633,6 +765,8 @@ def main() -> int:
     if args.chaos:
         return chaos(max(args.requests, 36) if args.requests != 24
                      else 36, lengths=args.lengths)
+    if args.prefix_dist:
+        return prefix_sweep(args.prefix_dist, args.requests)
     try:
         mesh = tuple(int(x) for x in args.mesh.split(","))
         assert len(mesh) == 2 and mesh[0] >= 1 and mesh[1] >= 1

@@ -3,7 +3,7 @@
 PADDLE_TPU_SKIP_FAULT_GATE=1).
 
 In the crash/lint/serving-gate mold: a fast, deterministic proof that the
-engine CONTAINS faults instead of dying or corrupting state.  Five
+engine CONTAINS faults instead of dying or corrupting state.  Six
 scenarios on a tiny CPU model, each asserting the PR's acceptance
 criteria:
 
@@ -19,6 +19,13 @@ criteria:
                               exactly the poisoned slot;
   5. pool exhaustion       -> injected allocator exhaustion backpressures
                               (never fails or corrupts), then drains;
+  6. shared-prefix kill    -> two requests share a prefix-cache page; the
+                              hitting one is killed mid-decode (stall ->
+                              rebuild).  The rebuild flushes the cache
+                              (its pages lived in the discarded pool),
+                              the queued survivor completes token-for-
+                              token against the rebuilt pool, and shared-
+                              page refcounts stay exact throughout;
 
 plus a RANDOMIZED fault schedule sweep (several seeds): under any mix of
 crashes/NaN/exhaustion/callback faults, page accounting must close
@@ -88,10 +95,22 @@ def _drain(eng, max_steps=2000):
 
 
 def _accounting_closed(eng, label):
+    """Exact page accounting at drain: no slot holds pages, the 4-term
+    ledger closes (free + used + spec + shared == capacity is the
+    allocator invariant; at drain used == spec == 0), and every page the
+    prefix cache retained is at refcount 0 (no slot is referencing it)."""
     a = eng.allocator
-    if a.used_pages != 0 or a.free_pages != a.capacity:
+    if a.used_pages != 0 or a.spec_pages != 0 \
+            or a.free_pages + a.shared_pages != a.capacity:
         print(f"serving_fault_gate: FAIL [{label}] page accounting leaked "
-              f"(used={a.used_pages}, free={a.free_pages}/{a.capacity})")
+              f"(used={a.used_pages}, spec={a.spec_pages}, "
+              f"free={a.free_pages}, shared={a.shared_pages}, "
+              f"capacity={a.capacity})")
+        return False
+    held = {p: c for p, c in getattr(a, "_shared", {}).items() if c}
+    if held:
+        print(f"serving_fault_gate: FAIL [{label}] shared pages still "
+              f"referenced at drain: {held}")
         return False
     return True
 
@@ -213,7 +232,61 @@ def gate() -> int:
     ok &= _accounting_closed(eng, "exhaustion")
     eng.close()
 
-    # -- 6. randomized schedules: the accounting property ----------------
+    # -- 6. shared prefix killed mid-decode: survivor + refcounts exact --
+    # Two requests share a cached prefix through the prefix cache
+    # (docs/serving.md "Prefix cache"); the one that hit is killed
+    # mid-decode by a stall.  The rebuild flushes the cache (its pages
+    # lived in the discarded pool), the survivor — queued behind it on
+    # the single slot — is admitted against the rebuilt pool and must
+    # come out token-for-token; shared-page refcounts must be exact at
+    # every stage (held while seated, zero after the flush and at drain).
+    from paddle_tpu.serving import ServingEngine
+
+    prng = np.random.RandomState(9)
+    vocab = m.config.vocab_size
+    shared = prng.randint(0, vocab, (20,))       # 1 full page + tail
+    tail_b = prng.randint(0, vocab, (5,))
+    eng = ServingEngine(m, num_slots=1, page_size=16, max_context=64,
+                        cache_dtype="float32", stall_budget_s=0.5,
+                        prefix_cache=True)
+    warm = eng.submit(prompts[0], 2)
+    _drain(eng)                                  # compile under the big budget
+    assert warm.finished
+    ra = eng.submit(shared, N_NEW)               # registers the prefix page
+    _drain(eng)
+    ref_a = ra.output_ids()
+    if not (ra.state == RequestState.DONE
+            and eng.allocator.shared_pages >= 1):
+        print("serving_fault_gate: FAIL [prefix] seeding request did not "
+              f"register a shared page (state={ra.state}, "
+              f"shared={eng.allocator.shared_pages})")
+        ok = False
+    FaultInjector().inject("before_decode", at=0, kind="step_stall",
+                           duration=1.5).install(eng)
+    rb = eng.submit(np.concatenate([shared, tail_b]), N_NEW)  # cache hit
+    rc = eng.submit(shared, N_NEW)               # queued survivor (1 slot)
+    _drain(eng)
+    mt = eng.metrics()
+    if not (isinstance(rb.error, StepStalledError)
+            and rb.state == RequestState.FAILED
+            and mt["rebuilds"] == 1
+            and mt["prefix_hits"] >= 1
+            and mt["prefix_evictions"] >= 1       # the rebuild flush
+            and rc.state == RequestState.DONE
+            and np.array_equal(rc.output_ids(), ref_a)):
+        print(f"serving_fault_gate: FAIL [prefix] {mt} "
+              f"states={[rb.state, rc.state]} err={rb.error!r}")
+        ok = False
+    # the survivor completed AFTER the flush, so it re-registered the
+    # prefix into the rebuilt pool: the cache is warm again, refcount 0
+    if eng.allocator.shared_pages < 1:
+        print("serving_fault_gate: FAIL [prefix] survivor did not "
+              "re-register the prefix after the rebuild flush")
+        ok = False
+    ok &= _accounting_closed(eng, "prefix")
+    eng.close()
+
+    # -- 7. randomized schedules: the accounting property ----------------
     for seed in (3, 17, 42):
         rng = np.random.RandomState(seed)
         eng = _engine(m, num_slots=3)
@@ -243,7 +316,8 @@ def gate() -> int:
         return 1
     print("serving_fault_gate: OK (transient-retry, persistent-crash, "
           "stall-rebuild, nan-quarantine, exhaustion-backpressure, "
-          "3 randomized schedules — containment + exact page accounting)")
+          "shared-prefix-kill, 3 randomized schedules — containment + "
+          "exact page accounting incl. shared pages)")
     return 0
 
 

@@ -416,6 +416,58 @@ def main() -> int:
               f"{mets['spec_acceptance_rate']:.3f} traces={tc}")
         eng.close()
 
+    # -- prefix cache: shared-prefix admission on-chip — a completed
+    # request registers its full pages in the radix index, later siblings
+    # splice those pool pages into their tables copy-on-write and prefill
+    # only the uncached tail; parity vs the cache-disabled oracle proves
+    # the HIT PAGES hold bitwise-correct KV (docs/serving.md "Prefix
+    # cache") --------------------------------------------------------------
+    def prefix_cache():
+        import paddle_tpu as pt
+        from paddle_tpu.models import GPTForPretraining, gpt_tiny
+        from paddle_tpu.serving import RequestState, ServingEngine
+
+        pt.seed(0)
+        # 256 positions: the shared prefix must fill a WHOLE 128-token
+        # page (the TPU-native page size) and still leave decode room
+        cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0,
+                       max_position_embeddings=256)
+        m = GPTForPretraining(cfg)
+        m.eval()
+        srng = np.random.RandomState(17)
+        sys_prompt = srng.randint(0, cfg.vocab_size, (128,))  # 1 full page
+        prompts = [np.concatenate([sys_prompt,
+                                   srng.randint(0, cfg.vocab_size, (s,))])
+                   for s in (5, 9, 13)]
+        oracle = ServingEngine(m, num_slots=2, page_size=128,
+                               max_context=256, cache_dtype="bfloat16")
+        refs = oracle.generate_batch(prompts, 4)
+        oracle.close()
+        eng = ServingEngine(m, num_slots=2, page_size=128, max_context=256,
+                            cache_dtype="bfloat16", prefix_cache=True)
+        seed_req = eng.submit(prompts[0], 4)    # registers the shared page
+        eng.run_until_idle(max_steps=500)
+        assert seed_req.state == RequestState.DONE
+        assert eng.allocator.shared_pages >= 1, "prefix never registered"
+        sibs = [eng.submit(p, 4) for p in prompts[1:]]  # concurrent hits
+        eng.run_until_idle(max_steps=500)
+        for r, ref in zip([seed_req] + sibs, refs):
+            assert r.state == RequestState.DONE and np.array_equal(
+                r.output_ids(), ref), \
+                f"request {r.id} diverged with the prefix cache on"
+        mets = eng.metrics()
+        assert mets["prefix_hits"] + mets["prefix_partial_hits"] >= 2, mets
+        assert mets["prefix_cached_tokens"] >= 256, mets
+        a = eng.allocator
+        assert a.used_pages == 0, "pages leaked on-chip"
+        assert a.free_pages + a.shared_pages == a.capacity, \
+            "shared-page ledger did not close"
+        print(f"tpu_smoke: prefix_cache hit_rate="
+              f"{mets['prefix_hit_rate']:.3f} "
+              f"cached_tokens={mets['prefix_cached_tokens']} "
+              f"shared_pages={mets['shared_pages']}")
+        eng.close()
+
     # -- autotune: ONE real measured candidate sweep on-chip (decode
     # kernel, small cache), winner must be legal, parity must hold with
     # the winner forced, and the table must round-trip through replay
@@ -606,6 +658,7 @@ def main() -> int:
     check("serving_faults", serving_faults)
     check("sharded_serving", sharded_serving)
     check("speculative_serving", speculative_serving)
+    check("prefix_cache", prefix_cache)
     check("autotune_sweep", autotune_sweep)
     check("telemetry", telemetry)
     check("dist_fault", dist_fault)
