@@ -274,6 +274,12 @@ def _probe_backend_adaptive():
     flaky-init case resolves in the first short attempt.  A definitive
     verdict (any platform string) is cached for the round.
 
+    PADDLE_TPU_BENCH_PROBE_TOTAL (default 600s) caps the CUMULATIVE
+    wall-clock the whole ladder may burn — attempts are clamped to the
+    remaining budget and the ladder stops early once it is spent, so a
+    wedged backend costs a bounded slice of the bench round no matter
+    how the per-attempt knobs are tuned.
+
     Returns (platform_or_None, source) where source is 'cache' or
     'probe#N'."""
     cached = _read_probe_cache()
@@ -286,19 +292,35 @@ def _probe_backend_adaptive():
                  or os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
     retries = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
     backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", "15"))
+    total = float(os.environ.get("PADDLE_TPU_BENCH_PROBE_TOTAL", "600"))
+    t0 = time.monotonic()
     timeout = base
+    tried = 0
     for attempt in range(1 + retries):
-        platform = _probe_backend(timeout=timeout)
+        remaining = total - (time.monotonic() - t0)
+        if remaining <= 1.0:
+            sys.stderr.write(
+                f"bench: probe budget exhausted ({total:.0f}s total) "
+                f"after {tried} attempts\n")
+            break
+        tried += 1
+        platform = _probe_backend(timeout=min(timeout, remaining))
         if platform is not None:
             _write_probe_cache(platform)
             return platform, f"probe#{attempt + 1}"
         if attempt < retries:
+            remaining = total - (time.monotonic() - t0)
+            if remaining <= backoff + 1.0:
+                sys.stderr.write(
+                    f"bench: probe budget exhausted ({total:.0f}s total) "
+                    f"after {tried} attempts\n")
+                break
             sys.stderr.write(
                 f"bench: probe attempt {attempt + 1} failed; retrying in "
                 f"{backoff:.0f}s with timeout {min(timeout * 2, 480):.0f}s\n")
             time.sleep(backoff)
             timeout = min(timeout * 2, 480.0)
-    return None, f"probe#{1 + retries}"
+    return None, f"probe#{max(tried, 1)}"
 
 
 def _run_child(env, timeout):
@@ -756,24 +778,47 @@ def main():
                                  "BENCH_SERVING_MESH>1,1 (speculation is "
                                  "per-replica; use engine_factory)\n")
                 s_spec = None
+        # quantized serving (docs/serving.md "Quantized serving"):
+        # BENCH_KV_DTYPE=float32|bfloat16|int8 flips the paged pool
+        # regime, BENCH_WEIGHT_DTYPE=int8 PTQs the decode projections.
+        # Off by default so the trajectory stays comparable; the weight
+        # PTQ runs on a CLONE because quantize_for_serving mutates.
+        s_model = model
+        s_kvd = os.environ.get("BENCH_KV_DTYPE", "")
+        if s_kvd:
+            if s_kvd in ("float32", "bfloat16", "int8"):
+                s_kw["kv_dtype"] = s_kvd
+            else:
+                sys.stderr.write(f"bench: BENCH_KV_DTYPE={s_kvd!r} unknown "
+                                 "(want float32|bfloat16|int8); ignoring\n")
+        s_wd = os.environ.get("BENCH_WEIGHT_DTYPE", "")
+        if s_wd:
+            if s_wd == "int8":
+                from paddle_tpu.distributed.serving_mesh import clone_model
+
+                s_model = clone_model(model)
+                s_kw["weight_dtype"] = "int8"
+            else:
+                sys.stderr.write(f"bench: BENCH_WEIGHT_DTYPE={s_wd!r} "
+                                 "unknown (want int8); ignoring\n")
         if s_dp * s_mp > 1:
             from paddle_tpu.serving import ShardedServingEngine
 
-            eng = ShardedServingEngine(model, dp=s_dp, mp=s_mp, **s_kw)
+            eng = ShardedServingEngine(s_model, dp=s_dp, mp=s_mp, **s_kw)
         elif s_spec is not None:
             from paddle_tpu.serving import SpeculativeEngine
 
             if s_spec[0] == "same":
-                s_draft = model
+                s_draft = s_model
             else:
                 from paddle_tpu.models import truncated_draft
 
-                s_draft = truncated_draft(model,
+                s_draft = truncated_draft(s_model,
                                           int(s_spec[0][:-len("layer")]))
-            eng = SpeculativeEngine(model, s_draft, spec_k=s_spec[1],
+            eng = SpeculativeEngine(s_model, s_draft, spec_k=s_spec[1],
                                     **s_kw)
         else:
-            eng = ServingEngine(model, **s_kw)
+            eng = ServingEngine(s_model, **s_kw)
         # warmup compiles the fused greedy step — one request per dp
         # replica (least-loaded placement seats each on its own replica)
         # so NO replica's SPMD compile lands in the timed window
@@ -812,6 +857,8 @@ def main():
             f"reqs={n_req} "
             f"page={s_kw['page_size']} ctx={s_kw['max_context']} "
             f"new={s_new} pool={mets['pages_capacity']}pages "
+            f"kv_dtype={s_kw.get('kv_dtype') or s_kw['cache_dtype']} "
+            f"weight_dtype={s_kw.get('weight_dtype') or 'native'} "
             f"pool_per_chip={pool_per_chip_mib:.2f}MiB "
             f"aggregate_tps={s_tokens / s_dt:.1f} "
             f"completed={mets['completed']} "

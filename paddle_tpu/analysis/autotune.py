@@ -97,7 +97,7 @@ _TOKEN_BLOCK_CHOICES = (8, 16, 32)
 
 def _itemsize(dtype: str) -> int:
     return {"bfloat16": 2, "float16": 2, "float32": 4, "bf16": 2,
-            "f32": 4, "fp16": 2}.get(str(dtype), 4)
+            "f32": 4, "fp16": 2, "int8": 1}.get(str(dtype), 4)
 
 
 def _dtype_key(dtype) -> str:

@@ -68,6 +68,7 @@ __all__ = [
     "cost", "cost_jaxpr", "cost_static_program",
     "cost_reports", "clear_cost_reports",
     "dot_flops", "eqn_flops", "ragged_padding_waste",
+    "paged_pool_bytes", "decode_step_kv_bytes",
 ]
 
 
@@ -268,11 +269,59 @@ def ragged_padding_waste(n_tokens: int, n_blocks: int, n_items: int,
         itemsize = np.dtype(dtype).itemsize
     except TypeError:
         itemsize = 2
+    if str(dtype) == "int8":
+        # int8 KV pools: only the PAGES are int8 — the padded q rows ride
+        # fp32 (the public kernel API casts q up so the dequant epilogue
+        # and softmax accumulate in fp32)
+        itemsize = 4
     return {
         "padded_rows": padded_rows,
         "wasted_flops": wasted_flops,
         "wasted_q_bytes": padded_rows * int(head_dim) * itemsize,
     }
+
+
+def paged_pool_bytes(num_pages: int, num_heads: int, page_size: int,
+                     head_dim: int, num_layers: int = 1,
+                     dtype="bfloat16") -> int:
+    """Total HBM bytes of one paged KV pool (K + V across layers) —
+    the admission-capacity denominator serving_bench's fixed-byte sweeps
+    compare precision regimes against.  In the int8 regime this counts
+    the int8 pages PLUS the per-(page, head) fp32 absmax scale buffers
+    (serving/paged_cache.py), not a fp32-equivalent."""
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        itemsize = 2
+    page = int(num_heads) * int(page_size) * int(head_dim) * itemsize
+    total = 2 * int(num_layers) * int(num_pages) * page          # K + V
+    if str(dtype) == "int8":
+        # fp32 [P, H] scale buffer per pool, per layer, for K and V
+        total += 2 * int(num_layers) * int(num_pages) * int(num_heads) * 4
+    return total
+
+
+def decode_step_kv_bytes(context_tokens: int, num_heads: int,
+                         head_dim: int, page_size: int,
+                         num_layers: int = 1, dtype="bfloat16") -> int:
+    """HBM-upper bound on KV bytes streamed for ONE decode token over a
+    ``context_tokens``-position context: the ragged/paged kernels read
+    each valid K and V row exactly once per layer (scalar-prefetched
+    index maps elide everything past the clamped tail), plus — in the
+    int8 regime — one fp32 scale per touched (page, head).  The decode
+    step is memory-bound, so this bound tracks its wall-clock; int8
+    pages halve it twice over vs fp32 (the cost-model golden pins
+    int8 <= fp32 / 2)."""
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        itemsize = 2
+    total = (2 * int(num_layers) * int(context_tokens) * int(num_heads)
+             * int(head_dim) * itemsize)
+    if str(dtype) == "int8":
+        pages = -(-int(context_tokens) // int(page_size))    # ceil
+        total += 2 * int(num_layers) * pages * int(num_heads) * 4
+    return total
 
 
 # ---------------------------------------------------------------------------

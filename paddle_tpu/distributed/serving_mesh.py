@@ -221,6 +221,16 @@ def shard_paged_cache(cache, mesh):
     buffers = [cache.k, cache.v] if cache.stacked else [*cache.k, *cache.v]
     for t in buffers:
         _put(t, mesh, tuple(spec))
+    if getattr(cache, "quantized", False):
+        # int8 pool: the per-(page, head) scale buffers shard on the SAME
+        # head axis ([L, P, H] stacked / [P, H] layered)
+        sspec = [None] * (3 if cache.stacked else 2)
+        if mp > 1:
+            sspec[-1] = "mp"
+        sbuffers = ([cache.k_scale, cache.v_scale] if cache.stacked
+                    else [*cache.k_scale, *cache.v_scale])
+        for t in sbuffers:
+            _put(t, mesh, tuple(sspec))
     cache.mesh_shards = mp
     return cache
 

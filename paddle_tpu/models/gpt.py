@@ -294,7 +294,7 @@ def _attend_with_cache(q: Tensor, k: Tensor, v: Tensor, ck_t: Tensor,
 
 
 def _raw_attend_paged(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
-                      page_size, ragged_plan=None):
+                      page_size, ragged_plan=None, ksr=None, vsr=None):
     """Raw (traced) paged cache write + attend for continuous batching —
     dispatching between the single-pool body and, under an active serving
     mesh with ``mp > 1`` (``distributed/serving_mesh.py``), the SAME body
@@ -302,10 +302,15 @@ def _raw_attend_paged(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
     attends over its own ``[P, H/mp, page_size, D]`` pool shard, with the
     page tables / positions / ragged plan replicated.  The head-parallel
     path is psum-free; the first cross-chip reduce is the row-parallel
-    post-attention projection GSPMD inserts outside this function.  See
-    :func:`_attend_paged_shard` for the shapes and semantics."""
+    post-attention projection GSPMD inserts outside this function.
+    ``ksr``/``vsr`` ([P, H] fp32) enable the int8-pool regime: the
+    per-(page, head) scale buffers shard on the SAME head axis as the
+    pools and are threaded through (updated at write time), so the
+    function then returns a 5-tuple.  See :func:`_attend_paged_shard`
+    for the shapes and semantics."""
     from ..distributed import serving_mesh as _srv_mesh
 
+    quantized = ksr is not None
     mesh = _srv_mesh.active_mesh()
     if mesh is not None and _srv_mesh.mp_size(mesh) > 1:
         from jax.sharding import PartitionSpec as _P
@@ -314,28 +319,38 @@ def _raw_attend_paged(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
 
         n_plan = len(ragged_plan) if ragged_plan is not None else 0
 
-        def body(qh_, kh_, vh_, pkr_, pvr_, tbl_, posr_, *planr):
+        def body(qh_, kh_, vh_, pkr_, pvr_, tbl_, posr_, *rest):
+            if quantized:
+                ksr_, vsr_ = rest[:2]
+                planr = rest[2:]
+            else:
+                ksr_ = vsr_ = None
+                planr = rest
             return _attend_paged_shard(
                 qh_, kh_, vh_, pkr_, pvr_, tbl_, posr_,
                 head_dim=head_dim, page_size=page_size,
-                ragged_plan=planr if n_plan else None)
+                ragged_plan=planr if n_plan else None,
+                ksr=ksr_, vsr=vsr_)
 
         hs = _P(None, "mp", None, None)     # head axis of q/k/v and pools
+        ss = _P(None, "mp")                 # head axis of the scale bufs
         rep = _P()
         sm = _shard_map(
             body, mesh,
-            in_specs=(hs, hs, hs, hs, hs, rep, rep) + (rep,) * n_plan,
-            out_specs=(hs, hs, hs),
+            in_specs=(hs, hs, hs, hs, hs, rep, rep)
+            + ((ss, ss) if quantized else ()) + (rep,) * n_plan,
+            out_specs=(hs, hs, hs) + ((ss, ss) if quantized else ()),
             check_vma=False)
         return sm(qh, kh, vh, pkr, pvr, tables, posr,
+                  *((ksr, vsr) if quantized else ()),
                   *(tuple(ragged_plan) if n_plan else ()))
     return _attend_paged_shard(qh, kh, vh, pkr, pvr, tables, posr,
                                head_dim=head_dim, page_size=page_size,
-                               ragged_plan=ragged_plan)
+                               ragged_plan=ragged_plan, ksr=ksr, vsr=vsr)
 
 
 def _attend_paged_shard(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
-                        page_size, ragged_plan=None):
+                        page_size, ragged_plan=None, ksr=None, vsr=None):
     """Raw (traced) paged cache write + attend for continuous batching.
 
     qh/kh/vh: [S, N, C, D] head-major fresh projections (S decode slots —
@@ -344,6 +359,14 @@ def _attend_paged_shard(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
     int32 page tables (per-token rows on the ragged path); posr: [S]
     traced per-slot/per-token positions.  Returns
     (out [S, N, C, D], new_k_pool, new_v_pool).
+
+    ``ksr``/``vsr`` ([P, N] fp32) switch on the int8-pool regime: the
+    fresh K/V rows are quantized in-graph at scatter time
+    (quantization/kv.quantize_kv_write — fresh-page step-absmax, stale-
+    page clip) and every attention route dequantizes at read (inside the
+    kernel body for the ragged/paged kernels, at gather for the chunked
+    path).  The return grows to (out, new_k_pool, new_v_pool,
+    new_k_scale, new_v_scale).
 
     Every write translates an absolute position through the page table:
     position p of slot s lands at ``pool[tables[s, p//page_size], :,
@@ -368,6 +391,7 @@ def _attend_paged_shard(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
     )
 
     s_, nh, c, d = qh.shape
+    quantized = ksr is not None
     max_pages = tables.shape[1]
     scale = float(1.0 / np.sqrt(head_dim))
     pos = posr.astype(jnp.int32)
@@ -379,24 +403,35 @@ def _attend_paged_shard(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
     page_slot = jnp.clip(abs_pos // page_size, 0, max_pages - 1)
     page_ids = jnp.take_along_axis(tbl, page_slot, axis=1)   # [S, C]
     offs = abs_pos % page_size
+    kq = jnp.transpose(kh, (0, 2, 1, 3))                     # [S, C, N, D]
+    vq = jnp.transpose(vh, (0, 2, 1, 3))
+    if quantized:
+        # int8 pools: quantize the fresh rows in-graph and update the
+        # per-(page, head) scale buffers before the scatter
+        from ..quantization.kv import quantize_kv_write
+
+        kq, ks2 = quantize_kv_write(kq, page_ids, offs, ksr)
+        vq, vs2 = quantize_kv_write(vq, page_ids, offs, vsr)
+    else:
+        ks2 = vs2 = None
     # advanced indices split by the head slice: result dims [S, C, N, D]
-    pk2 = pkr.at[page_ids, :, offs, :].set(
-        jnp.transpose(kh, (0, 2, 1, 3)).astype(pkr.dtype))
-    pv2 = pvr.at[page_ids, :, offs, :].set(
-        jnp.transpose(vh, (0, 2, 1, 3)).astype(pvr.dtype))
+    pk2 = pkr.at[page_ids, :, offs, :].set(kq.astype(pkr.dtype))
+    pv2 = pvr.at[page_ids, :, offs, :].set(vq.astype(pvr.dtype))
     if c == 1 and ragged_plan is not None:
         out = ragged_paged_attention(qh[:, :, 0, :], pk2, pv2, tbl,
-                                     pos + 1, ragged_plan, sm_scale=scale)
+                                     pos + 1, ragged_plan, sm_scale=scale,
+                                     k_scale=ks2, v_scale=vs2)
         out = out[:, :, None, :].astype(qh.dtype)
     elif c == 1:
         out = paged_attention(qh[:, :, 0, :], pk2, pv2, tbl, pos + 1,
-                              sm_scale=scale)
+                              sm_scale=scale, k_scale=ks2, v_scale=vs2)
         out = out[:, :, None, :].astype(qh.dtype)
     else:
         # chunked prefill: queries at absolute positions p..p+C-1 attend to
         # every written position <= their own across the gathered pages
-        ck = gather_pages(pk2, tbl)                          # [S, N, ctx, D]
-        cv = gather_pages(pv2, tbl)
+        # (int8 pools dequantize at gather — ck/cv come back fp32)
+        ck = gather_pages(pk2, tbl, ks2)                     # [S, N, ctx, D]
+        cv = gather_pages(pv2, tbl, vs2)
         scores = jnp.einsum("snqd,snkd->snqk", qh.astype(ck.dtype), ck,
                             preferred_element_type=jnp.float32) * scale
         cols = jax.lax.broadcasted_iota(
@@ -407,31 +442,48 @@ def _attend_paged_shard(qh, kh, vh, pkr, pvr, tables, posr, *, head_dim,
         att = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("snqk,snkd->snqd", att.astype(cv.dtype),
                          cv).astype(qh.dtype)
+    if quantized:
+        return out, pk2, pv2, ks2, vs2
     return out, pk2, pv2
 
 
 def _attend_paged(q: Tensor, k: Tensor, v: Tensor, pk_t: Tensor,
                   pv_t: Tensor, tables: Tensor, pos: Tensor,
-                  cfg: GPTConfig, ragged_plan=None) -> Tensor:
+                  cfg: GPTConfig, ragged_plan=None, scales=None) -> Tensor:
     """Tensor-level paged attention for the layered decoder.  q/k/v:
     [S, C, nh, hd]; mutates the pool Tensors in place (mutation-logged, so
     jit.to_static donates them to the compiled serving step).
     ``ragged_plan`` (a tuple of RAGGED_PLAN_FIELDS Tensors) routes the
-    C == 1 flat-token path through the ragged work-list kernel."""
+    C == 1 flat-token path through the ragged work-list kernel.
+    ``scales`` — the (k_scale, v_scale) [P, H] fp32 Tensors of an int8
+    pool — ride the same dispatch and are mutated in place alongside it."""
     page_size = int(pk_t.shape[-2])
     plan = tuple(ragged_plan) if ragged_plan is not None else ()
+    n_plan = len(plan)
+    sc = tuple(scales) if scales is not None else ()
 
-    def raw(qr, kr, vr, pkr, pvr, tbl, posr, *planr):
+    def raw(qr, kr, vr, pkr, pvr, tbl, posr, *rest):
+        planr = rest[:n_plan]
+        scr = rest[n_plan:]
         qh, kh, vh = (jnp.swapaxes(t, 1, 2) for t in (qr, kr, vr))
-        out, pk2, pv2 = _raw_attend_paged(
+        res = _raw_attend_paged(
             qh, kh, vh, pkr, pvr, tbl, posr,
             head_dim=cfg.head_dim, page_size=page_size,
-            ragged_plan=planr if planr else None)
-        return jnp.swapaxes(out, 1, 2), pk2, pv2
+            ragged_plan=planr if planr else None,
+            ksr=scr[0] if scr else None,
+            vsr=scr[1] if scr else None)
+        out = jnp.swapaxes(res[0], 1, 2)
+        return (out,) + tuple(res[1:])
 
-    out, pk_new, pv_new = ops.dispatch.apply(
-        raw, q, k, v, pk_t, pv_t, tables, pos, *plan,
+    results = ops.dispatch.apply(
+        raw, q, k, v, pk_t, pv_t, tables, pos, *plan, *sc,
         op_name="paged_attention")
+    if sc:
+        out, pk_new, pv_new, ks_new, vs_new = results
+        sc[0]._set_value(ks_new._value)
+        sc[1]._set_value(vs_new._value)
+    else:
+        out, pk_new, pv_new = results
     pk_t._set_value(pk_new._value)
     pv_t._set_value(pv_new._value)
     return out
@@ -506,14 +558,21 @@ class GPTAttention(Layer):
                     "is causal+length-masked); left-padded batches would "
                     "write pad positions into the cache — right-pad or "
                     "serve per-sequence")
-            ck_t, cv_t = layer_kv
+            if len(layer_kv) == 4:
+                # int8 paged pool: (k, v, k_scale, v_scale) — the scale
+                # Tensors thread through the same dispatched op
+                ck_t, cv_t, ks_t, vs_t = layer_kv
+                scales = (ks_t, vs_t)
+            else:
+                ck_t, cv_t = layer_kv
+                scales = None
             if page_tables is not None:
                 # continuous-batching path: page-table-translated write
                 # into the global pool, paged decode-attention kernel (or
                 # the ragged work-list kernel on the fused mixed step)
                 out = _attend_paged(q, k, v, ck_t, cv_t, page_tables,
                                     _as_pos(cache_index), cfg,
-                                    ragged_plan=ragged_plan)
+                                    ragged_plan=ragged_plan, scales=scales)
             elif lora is not None:
                 raise ValueError(
                     "per-request LoRA adapters ride the paged serving "
@@ -640,7 +699,11 @@ class GPTModel(Layer):
                 pool_, ids_ = lora
                 lr = (pool_.layer_slabs(i), ids_, pool_.scaling)
             if kv_cache is not None:
-                h = layer(h, attn_mask, layer_kv=kv_cache.layer(i),
+                lkv = tuple(kv_cache.layer(i))
+                if paged and getattr(kv_cache, "quantized", False):
+                    # int8 pool: ride the per-layer scale buffers along
+                    lkv = lkv + tuple(kv_cache.layer_scales(i))
+                h = layer(h, attn_mask, layer_kv=lkv,
                           cache_index=pos,
                           page_tables=page_tables if paged else None,
                           ragged_plan=ragged_plan if paged else None,
@@ -680,6 +743,13 @@ class GPTForPretraining(Layer, GenerationMixin):
             # vocab projection, so the LM head projects [S] rows instead of
             # the whole padded flat-token axis
             h = ops.gather(h, out_rows, axis=0)
+        if getattr(self, "_weight_int8", False):
+            # quantize_for_serving stored the tied LM head transposed as
+            # int8 [H, V] with per-vocab-row scales — one int8 MXU matmul
+            from ..quantization.int8 import quantized_matmul
+
+            return quantized_matmul(h, self.lm_head_int8,
+                                    self.lm_head_scale)
         w = self.gpt.embeddings.word_embeddings.weight  # [V, H]
         logits = ops.matmul(h, w, transpose_y=True)     # [B, S, V]
         return logits
@@ -779,9 +849,42 @@ class GPTStackedDecoder(Layer):
 
     _PARAM_NAMES = ("ln1_g", "ln1_b", "qkv_w", "qkv_b", "proj_w", "proj_b",
                     "ln2_g", "ln2_b", "fc1_w", "fc1_b", "fc2_w", "fc2_b")
+    # post-quantize_weights() scan layout: each projection weight becomes
+    # (int8 weight, per-(layer, out-channel) fp32 scale)
+    _PARAM_NAMES_INT8 = (
+        "ln1_g", "ln1_b", "qkv_w_int8", "qkv_w_s", "qkv_b",
+        "proj_w_int8", "proj_w_s", "proj_b", "ln2_g", "ln2_b",
+        "fc1_w_int8", "fc1_w_s", "fc1_b", "fc2_w_int8", "fc2_w_s", "fc2_b")
 
     def _stacked(self):
+        if getattr(self, "_weight_int8", False):
+            return [getattr(self, n) for n in self._PARAM_NAMES_INT8]
         return [getattr(self, n) for n in self._PARAM_NAMES]
+
+    def quantize_weights(self):
+        """PTQ the stacked projection weights to int8 for serving
+        (quantization.quantize_for_serving): per-(layer, out-channel)
+        absmax scales, weights stored AS int8 buffers — the serving scan
+        streams 1/4 the fp32 weight bytes per decode step and the MXU
+        multiplies int8 natively.  Inference-only and idempotent; the
+        training/cached block bodies refuse a quantized decoder."""
+        if getattr(self, "_weight_int8", False):
+            return
+        if _mesh.has_mesh() and _mesh.axis_size("mp") > 1:
+            raise ValueError(
+                "quantize_weights: the stacked projection weights are "
+                "mp-sharded; per-channel PTQ over gathered shards is not "
+                "supported — serve tensor-parallel models with fp weights")
+        for name in ("qkv_w", "proj_w", "fc1_w", "fc2_w"):
+            w = np.asarray(getattr(self, name)._value,
+                           np.float32)                     # [L, in, out]
+            s = np.abs(w).max(axis=1) / 127.0 + 1e-12      # [L, out]
+            q = np.clip(np.round(w / s[:, None, :]),
+                        -127, 127).astype(np.int8)
+            self.register_buffer(name + "_int8", Tensor(jnp.asarray(q)))
+            self.register_buffer(
+                name + "_s", Tensor(jnp.asarray(s.astype(np.float32))))
+        self._weight_int8 = True
 
     def _shard_params(self):
         """Leading (layer) dim over 'pp'; TP dims over 'mp'."""
@@ -802,6 +905,10 @@ class GPTStackedDecoder(Layer):
             shard_param(p, *spec)
 
     def _block_fn(self):
+        if getattr(self, "_weight_int8", False):
+            raise ValueError(
+                "decoder was quantized for serving (quantize_weights); "
+                "the training block body needs the fp weights")
         cfg = self._cfg
         nh, hd = cfg.num_heads, cfg.head_dim
         eps = cfg.layer_norm_eps
@@ -887,6 +994,11 @@ class GPTStackedDecoder(Layer):
         -> (h, k_cache, v_cache).  Inference-only: no dropout; AMP casts
         follow _block_fn's discipline (matmuls in amp dtype, LayerNorm
         fp32)."""
+        if getattr(self, "_weight_int8", False):
+            raise ValueError(
+                "decoder was quantized for serving (quantize_weights); "
+                "the contiguous-cache block body needs the fp weights — "
+                "serve through the paged engine")
         cfg = self._cfg
         nh, hd = cfg.num_heads, cfg.head_dim
         eps = cfg.layer_norm_eps
@@ -929,7 +1041,14 @@ class GPTStackedDecoder(Layer):
         global page pool + page tables — (params, h, k_pool, v_pool,
         tables, pos) -> (h, k_pool, v_pool).  Inference-only; AMP casts
         follow _block_fn's discipline (matmuls in amp dtype, LayerNorm
-        fp32, fp32 LN output cast back to the weight dtype)."""
+        fp32, fp32 LN output cast back to the weight dtype).
+
+        Two quantized-serving regimes compose here: ``kv_scales`` threads
+        an int8 pool's per-(page, head) scale buffers through the attend
+        (the return grows by the updated scales), and after
+        ``quantize_weights()`` the params tuple is the 16-entry int8
+        variant — each projection runs as an int8xint8 MXU matmul with a
+        fp32 dequant epilogue (quantization/int8.quantized_matmul_raw)."""
         cfg = self._cfg
         nh, hd = cfg.num_heads, cfg.head_dim
         eps = cfg.layer_norm_eps
@@ -937,40 +1056,73 @@ class GPTStackedDecoder(Layer):
 
         cdt = _amp_state.dtype if (_amp_state.enabled
                                    and _amp_state.level == "O1") else None
+        wq = bool(getattr(self, "_weight_int8", False))
+        if wq:
+            from ..quantization.int8 import quantized_matmul_raw
+
+            def proj(x_, w_, s_, b_):
+                return quantized_matmul_raw(x_, w_, s_, b_)
+        else:
+            def proj(x_, w_, s_, b_):
+                return x_ @ w_ + b_
 
         def ln(x, g, b):
             return _ln_f32(x, g, b, eps)
 
-        def block(p, h, kc, vc, tbl, pos, ragged_plan=None, lora=None):
-            (l1g, l1b, qkvw, qkvb, pw, pb, l2g, l2b, f1w, f1b, f2w, f2b) = p
-            if cdt is not None:
-                qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b = (
-                    a.astype(cdt) for a in (qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b)
-                )
+        def block(p, h, kc, vc, tbl, pos, ragged_plan=None, lora=None,
+                  kv_scales=None):
+            if wq:
+                (l1g, l1b, qkvw, qkvs, qkvb, pw, pws, pb, l2g, l2b,
+                 f1w, f1s, f1b, f2w, f2s, f2b) = p
+            else:
+                (l1g, l1b, qkvw, qkvb, pw, pb, l2g, l2b,
+                 f1w, f1b, f2w, f2b) = p
+                qkvs = pws = f1s = f2s = None
+                if cdt is not None:
+                    qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b = (
+                        a.astype(cdt) for a in (qkvw, qkvb, pw, pb, f1w, f1b, f2w, f2b)
+                    )
+            # int8 weights: projections take fp32 activations (the dynamic
+            # absmax quantizer + dequant epilogue live inside proj)
+            pdt = jnp.float32 if wq else qkvw.dtype
             if lora is not None:
                 # per-token gathered low-rank deltas on the SAME inputs
                 # as the base projections (serving/lora.py slab layout)
                 (qa, qb, pa, pb2, f1a, f1b2, f2a, f2b2), ids, lsc = lora
-                ldelta = lambda x_, a_, b_: lora_delta_raw(x_, a_, b_, ids, lsc)  # noqa: E731,E501
+                if wq:
+                    ldelta = lambda x_, a_, b_: lora_delta_raw(x_.astype(a_.dtype), a_, b_, ids, lsc).astype(jnp.float32)  # noqa: E731,E501
+                else:
+                    ldelta = lambda x_, a_, b_: lora_delta_raw(x_, a_, b_, ids, lsc)  # noqa: E731,E501
             else:
                 ldelta = lambda x_, a_, b_: jnp.zeros((), x_.dtype)  # noqa: E731,E501
                 qa = qb = pa = pb2 = f1a = f1b2 = f2a = f2b2 = None
             b, s, hidden = h.shape
-            x = ln(h, l1g, l1b).astype(qkvw.dtype)
-            qkv = (x @ qkvw + qkvb + ldelta(x, qa, qb)).reshape(
+            x = ln(h, l1g, l1b).astype(pdt)
+            qkv = (proj(x, qkvw, qkvs, qkvb) + ldelta(x, qa, qb)).reshape(
                 b, s, 3, nh, hd)
             q, k, v = (jnp.swapaxes(qkv[:, :, i], 1, 2) for i in range(3))
-            out, kc, vc = _raw_attend_paged(
-                q, k, v, kc, vc, tbl, pos, head_dim=hd, page_size=page_size,
-                ragged_plan=ragged_plan)
+            if kv_scales is not None:
+                kss, vss = kv_scales
+                out, kc, vc, kss, vss = _raw_attend_paged(
+                    q, k, v, kc, vc, tbl, pos, head_dim=hd,
+                    page_size=page_size, ragged_plan=ragged_plan,
+                    ksr=kss, vsr=vss)
+            else:
+                out, kc, vc = _raw_attend_paged(
+                    q, k, v, kc, vc, tbl, pos, head_dim=hd,
+                    page_size=page_size, ragged_plan=ragged_plan)
             out = jnp.swapaxes(out, 1, 2).reshape(b, s, hidden)
-            oin = out.astype(pw.dtype)
-            h = h + (oin @ pw + pb + ldelta(oin, pa, pb2)).astype(h.dtype)
-            y = ln(h, l2g, l2b).astype(f1w.dtype)
-            g = jax.nn.gelu(y @ f1w + f1b + ldelta(y, f1a, f1b2),
+            oin = out.astype(pdt)
+            h = h + (proj(oin, pw, pws, pb)
+                     + ldelta(oin, pa, pb2)).astype(h.dtype)
+            y = ln(h, l2g, l2b).astype(pdt)
+            g = jax.nn.gelu(proj(y, f1w, f1s, f1b) + ldelta(y, f1a, f1b2),
                             approximate=True)
-            y = g @ f2w + f2b + ldelta(g, f2a, f2b2)
-            return h + y.astype(h.dtype), kc, vc
+            y = proj(g, f2w, f2s, f2b) + ldelta(g, f2a, f2b2)
+            h = h + y.astype(h.dtype)
+            if kv_scales is not None:
+                return h, kc, vc, kss, vss
+            return h, kc, vc
 
         return block
 
@@ -1000,6 +1152,10 @@ class GPTStackedDecoder(Layer):
         else:
             lora_in, lscale = (), 0.0
         n_lora = len(lora_in)
+        # int8 pool: the stacked [L, P, H] scale buffers scan alongside
+        # the pools — the per-layer tail of xs grows from 2 to 4 entries
+        quantized = bool(getattr(paged_cache, "quantized", False))
+        nt = 4 if quantized else 2
 
         def raw(h, posr, tbl, *rest):
             planr = rest[:n_plan] if n_plan else None
@@ -1007,29 +1163,36 @@ class GPTStackedDecoder(Layer):
             if n_lora:
                 idsr, *slabr = rest[:n_lora]
                 rest = rest[n_lora:]
-            pk, pv, *stacked = rest
+            pools, stacked = rest[:nt], rest[nt:]
 
             def step(carry, xs):
                 if n_lora:
-                    params, sl = xs[:-10], xs[-10:-2]
+                    params, sl = xs[:-(8 + nt)], xs[-(8 + nt):-nt]
                     lr = (tuple(sl), idsr, lscale)
                 else:
-                    params, lr = xs[:-2], None
-                kc, vc = xs[-2], xs[-1]
-                h2, kc2, vc2 = block(params, carry, kc, vc,
-                                     tbl.astype(jnp.int32),
-                                     posr.astype(jnp.int32),
-                                     ragged_plan=planr, lora=lr)
-                return h2, (kc2, vc2)
+                    params, lr = xs[:-nt], None
+                kc, vc = xs[-nt], xs[-nt + 1]
+                kvs = (xs[-2], xs[-1]) if quantized else None
+                res = block(params, carry, kc, vc,
+                            tbl.astype(jnp.int32),
+                            posr.astype(jnp.int32),
+                            ragged_plan=planr, lora=lr, kv_scales=kvs)
+                return res[0], tuple(res[1:])
 
-            xs = tuple(stacked) + (tuple(slabr) if n_lora else ()) + (pk, pv)
-            h2, (pk2, pv2) = jax.lax.scan(step, h, xs)
-            return h2, pk2, pv2
+            xs = tuple(stacked) + (tuple(slabr) if n_lora else ()) + pools
+            h2, new_pools = jax.lax.scan(step, h, xs)
+            return (h2,) + tuple(new_pools)
 
-        out, pk_new, pv_new = dispatch.apply(
-            raw, hidden, pos, page_tables, *plan, *lora_in, paged_cache.k,
-            paged_cache.v, *self._stacked(),
-            op_name="gpt_stacked_decoder_paged")
+        pool_in = (paged_cache.k, paged_cache.v)
+        if quantized:
+            pool_in = pool_in + (paged_cache.k_scale, paged_cache.v_scale)
+        results = dispatch.apply(
+            raw, hidden, pos, page_tables, *plan, *lora_in, *pool_in,
+            *self._stacked(), op_name="gpt_stacked_decoder_paged")
+        out, pk_new, pv_new = results[:3]
+        if quantized:
+            paged_cache.k_scale._set_value(results[3]._value)
+            paged_cache.v_scale._set_value(results[4]._value)
         paged_cache.k._set_value(pk_new._value)
         paged_cache.v._set_value(pv_new._value)
         return out
@@ -1186,6 +1349,13 @@ class GPTStackedForPretraining(Layer, GenerationMixin):
             # vocab projection, so the LM head projects [S] rows instead of
             # the whole padded flat-token axis
             h = ops.gather(h, out_rows, axis=0)
+        if labels is None and getattr(self, "_weight_int8", False):
+            # quantize_for_serving stored the tied LM head transposed as
+            # int8 [H, V] with per-vocab-row scales — one int8 MXU matmul
+            from ..quantization.int8 import quantized_matmul
+
+            return quantized_matmul(h, self.lm_head_int8,
+                                    self.lm_head_scale)
         w = self.embeddings.word_embeddings.weight
         if labels is not None:
             from ..amp.auto_cast import _amp_state

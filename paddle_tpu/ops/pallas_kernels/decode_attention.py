@@ -83,8 +83,12 @@ def _dot(a, b, dims):
 # kernel
 # ---------------------------------------------------------------------------
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
-                   *, scale, block_kv, n_kv):
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, *rest,
+                   scale, block_kv, n_kv, quantized=False):
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_sc, m_sc, l_sc = rest
+    else:
+        o_ref, acc_sc, m_sc, l_sc = rest
     kv_i = pl.program_id(1)
     length = len_ref[0]
 
@@ -99,8 +103,14 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc,
     @pl.when(kv_i * block_kv < length)
     def _body():
         q = q_ref[0]                                # [8, D] (row-broadcast)
-        k = k_ref[0]                                # [block_kv, D]
-        v = v_ref[0]
+        if quantized:
+            # dequantize right after the DMA: the int8 block becomes fp32
+            # in VMEM only — no HBM round-trip for dequantized cache
+            k = k_ref[0].astype(jnp.float32) * ks_ref[0, 0]
+            v = v_ref[0].astype(jnp.float32) * vs_ref[0, 0]
+        else:
+            k = k_ref[0]                            # [block_kv, D]
+            v = v_ref[0]
         s = _dot(q, k, ((1,), (1,))) * np.float32(scale)   # [8, block_kv]
         cols = kv_i * block_kv + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
@@ -150,7 +160,8 @@ def _pick_params(s: int, d: int, dtype):
     return _pick_block_kv(s), 8
 
 
-def _decode_pallas(q, k, v, length, scale, interpret=False, block_kv=None):
+def _decode_pallas(q, k, v, length, scale, interpret=False, block_kv=None,
+                   k_scale=None, v_scale=None):
     """q: [BH, q_rows, D] (row-broadcast query; q_rows is the tunable
     sublane layout, 8 by default), k/v: [BH, S, D], length: scalar int32
     -> [BH, q_rows, D].  ``interpret=True`` runs the kernel through the
@@ -167,22 +178,32 @@ def _decode_pallas(q, k, v, length, scale, interpret=False, block_kv=None):
     qr = int(q.shape[1])
     block_kv = int(block_kv or _pick_block_kv(s))
     n_kv = s // block_kv
+    quantized = k_scale is not None
     kernel = functools.partial(_decode_kernel, scale=scale,
-                               block_kv=block_kv, n_kv=n_kv)
+                               block_kv=block_kv, n_kv=n_kv,
+                               quantized=quantized)
     len_arr = jnp.reshape(length, (1,)).astype(jnp.int32)
 
     def kv_index(b, ki, len_ref):
         last = jnp.maximum((len_ref[0] - 1) // block_kv, 0)
         return (b, jnp.minimum(ki, last), 0)
 
+    in_specs = [
+        pl.BlockSpec((1, qr, d), lambda b, ki, len_ref: (b, 0, 0)),
+        pl.BlockSpec((1, block_kv, d), kv_index),
+        pl.BlockSpec((1, block_kv, d), kv_index),
+    ]
+    operands = [q, k, v]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, 1),
+                                  lambda b, ki, len_ref: (b, 0))] * 2
+        operands += [k_scale.reshape(bh, 1).astype(jnp.float32),
+                     v_scale.reshape(bh, 1).astype(jnp.float32)]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(bh, n_kv),
-        in_specs=[
-            pl.BlockSpec((1, qr, d), lambda b, ki, len_ref: (b, 0, 0)),
-            pl.BlockSpec((1, block_kv, d), kv_index),
-            pl.BlockSpec((1, block_kv, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, qr, d), lambda b, ki, len_ref: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((qr, d), jnp.float32),
@@ -198,7 +219,7 @@ def _decode_pallas(q, k, v, length, scale, interpret=False, block_kv=None):
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(len_arr, q, k, v)
+    )(len_arr, *operands)
     return out
 
 
@@ -206,13 +227,17 @@ def _decode_pallas(q, k, v, length, scale, interpret=False, block_kv=None):
 # public API
 # ---------------------------------------------------------------------------
 
-def decode_attention(q, k_cache, v_cache, length, *, sm_scale=None):
+def decode_attention(q, k_cache, v_cache, length, *, sm_scale=None,
+                     k_scale=None, v_scale=None):
     """Single-query attention over a preallocated KV cache.
 
     q:        [B, H, D]   — the ONE new query per (batch, head)
     k_cache:  [B, H, S, D] (S = max_seq, preallocated)
     v_cache:  [B, H, S, D]
     length:   scalar int — number of valid cache positions (traced OK)
+    k_scale/v_scale: [B, H] fp32 per-(batch, head) dequant scales when
+              the cache is int8 — dequant happens inside the kernel body
+              right after each KV-block DMA, and the output is fp32
     returns   [B, H, D]
 
     Routes to the Pallas flash-decode kernel on TPU when the cache shape
@@ -220,7 +245,10 @@ def decode_attention(q, k_cache, v_cache, length, *, sm_scale=None):
     """
     b, h, s, d = k_cache.shape
     scale = float(sm_scale if sm_scale is not None else 1.0 / (d ** 0.5))
-    q = q.astype(k_cache.dtype)
+    if k_scale is not None:
+        q = q.astype(jnp.float32)
+    else:
+        q = q.astype(k_cache.dtype)
     if _on_tpu() and decode_shape_supported(s, d):
         # sublane-broadcast the query row so blocks are tile-legal; the
         # row count and KV blocking come from the autotune table when a
@@ -229,14 +257,20 @@ def decode_attention(q, k_cache, v_cache, length, *, sm_scale=None):
         q8 = jnp.broadcast_to(q.reshape(b * h, 1, d), (b * h, qr, d))
         out = _decode_pallas(q8, k_cache.reshape(b * h, s, d),
                              v_cache.reshape(b * h, s, d),
-                             length, scale, block_kv=block_kv)
+                             length, scale, block_kv=block_kv,
+                             k_scale=k_scale, v_scale=v_scale)
         return out[:, 0, :].reshape(b, h, d)
-    return _xla_decode_reference(q, k_cache, v_cache, length, scale)
+    return _xla_decode_reference(q, k_cache, v_cache, length, scale,
+                                 k_scale=k_scale, v_scale=v_scale)
 
 
-def _xla_decode_reference(q, k_cache, v_cache, length, scale):
+def _xla_decode_reference(q, k_cache, v_cache, length, scale,
+                          k_scale=None, v_scale=None):
     """jnp-composed reference: masked single-query attention, fp32
     softmax (the fallback AND the parity oracle for tpu_smoke)."""
+    if k_scale is not None:
+        k_cache = k_cache.astype(jnp.float32) * k_scale[:, :, None, None]
+        v_cache = v_cache.astype(jnp.float32) * v_scale[:, :, None, None]
     s = jnp.einsum("bhd,bhsd->bhs", q, k_cache,
                    preferred_element_type=jnp.float32) * np.float32(scale)
     valid = jnp.arange(k_cache.shape[2]) < length

@@ -80,9 +80,16 @@ def paged_shape_supported(page_size: int, head_dim: int) -> bool:
 # kernel
 # ---------------------------------------------------------------------------
 
-def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc_sc, m_sc, l_sc, *, scale, page_size, max_pages,
-                  num_heads):
+def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, *rest,
+                  scale, page_size, max_pages, num_heads,
+                  quantized=False):
+    # quantized pools add two (1, 1) per-(page, head) scale inputs whose
+    # index map mirrors the KV page translation — dequant happens right
+    # after the page DMA (docs/serving.md "Quantized serving")
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_sc, m_sc, l_sc = rest
+    else:
+        o_ref, acc_sc, m_sc, l_sc = rest
     sh = pl.program_id(0)
     pi = pl.program_id(1)
     length = len_ref[sh // num_heads]
@@ -99,8 +106,12 @@ def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(pi * page_size < length)
     def _body():
         q = q_ref[0]                                # [8, D] (row-broadcast)
-        k = k_ref[0, 0]                             # [page_size, D]
-        v = v_ref[0, 0]
+        if quantized:
+            k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]
+            v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]
+        else:
+            k = k_ref[0, 0]                         # [page_size, D]
+            v = v_ref[0, 0]
         s = _dot(q, k, ((1,), (1,))) * np.float32(scale)  # [8, page_size]
         cols = pi * page_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
@@ -126,7 +137,7 @@ def _paged_kernel(pt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _paged_pallas(q, k_pool, v_pool, page_tables, lengths, scale,
-                  interpret=False):
+                  interpret=False, k_scale=None, v_scale=None):
     """q: [S*H, 8, D] (row-broadcast queries), k/v pool:
     [P, H, page_size, D], page_tables: [S, max_pages] int32, lengths:
     [S] int32 -> [S*H, 8, D].  ``interpret=True`` runs the Pallas
@@ -140,9 +151,10 @@ def _paged_pallas(q, k_pool, v_pool, page_tables, lengths, scale,
     p_, h, page_size, d = k_pool.shape
     s, max_pages = page_tables.shape
     qr = int(q.shape[1])  # tunable query sublane rows (8 by default)
+    quantized = k_scale is not None
     kernel = functools.partial(_paged_kernel, scale=scale,
                                page_size=page_size, max_pages=max_pages,
-                               num_heads=h)
+                               num_heads=h, quantized=quantized)
     pt_flat = jnp.reshape(page_tables, (-1,)).astype(jnp.int32)
     len_arr = jnp.reshape(lengths, (-1,)).astype(jnp.int32)
 
@@ -152,14 +164,26 @@ def _paged_pallas(q, k_pool, v_pool, page_tables, lengths, scale,
         page = pt_ref[slot * max_pages + jnp.minimum(pi, last)]
         return (page, sh % h, 0, 0)
 
+    def scale_index(sh, pi, pt_ref, len_ref):
+        slot = sh // h
+        last = jnp.maximum((len_ref[slot] - 1) // page_size, 0)
+        page = pt_ref[slot * max_pages + jnp.minimum(pi, last)]
+        return (page, sh % h)
+
+    in_specs = [
+        pl.BlockSpec((1, qr, d), lambda sh, pi, pt_ref, len_ref: (sh, 0, 0)),
+        pl.BlockSpec((1, 1, page_size, d), kv_index),
+        pl.BlockSpec((1, 1, page_size, d), kv_index),
+    ]
+    operands = [q, k_pool, v_pool]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, 1), scale_index),
+                     pl.BlockSpec((1, 1), scale_index)]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(s * h, max_pages),
-        in_specs=[
-            pl.BlockSpec((1, qr, d), lambda sh, pi, pt_ref, len_ref: (sh, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d), kv_index),
-            pl.BlockSpec((1, 1, page_size, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, qr, d),
                                lambda sh, pi, pt_ref, len_ref: (sh, 0, 0)),
         scratch_shapes=[
@@ -176,7 +200,7 @@ def _paged_pallas(q, k_pool, v_pool, page_tables, lengths, scale,
             dimension_semantics=("parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(pt_flat, len_arr, q, k_pool, v_pool)
+    )(pt_flat, len_arr, *operands)
     return out
 
 
@@ -206,21 +230,25 @@ def _pick_q_rows(page_size: int, d: int, dtype,
     return 8
 
 
-def gather_pages(pool, page_tables):
+def gather_pages(pool, page_tables, scale=None):
     """Materialize each slot's paged context as a contiguous view.
 
     pool: [P, H, page_size, D], page_tables: [S, max_pages] int32
     -> [S, H, max_pages*page_size, D].  Position p of slot s lives at
     ``pool[page_tables[s, p // page_size], :, p % page_size]``.  Used by
     the chunked-prefill path (attention over the whole updated context)
-    and the XLA decode fallback."""
+    and the XLA decode fallback.  ``scale`` ([P, H] fp32, quantized
+    pools) dequantizes each gathered page — the result is then fp32."""
     g = jnp.take(pool, page_tables, axis=0)     # [S, MP, H, ps, D]
     s, mp, h, ps, d = g.shape
+    if scale is not None:
+        sg = jnp.take(scale, page_tables, axis=0)    # [S, MP, H]
+        g = g.astype(jnp.float32) * sg[..., None, None]
     return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(s, h, mp * ps, d)
 
 
 def paged_attention(q, k_pool, v_pool, page_tables, lengths, *,
-                    sm_scale=None):
+                    sm_scale=None, k_scale=None, v_scale=None):
     """Single-query attention over a paged KV block pool.
 
     q:           [S, H, D]    — the ONE new query per (slot, head)
@@ -229,6 +257,9 @@ def paged_attention(q, k_pool, v_pool, page_tables, lengths, *,
     page_tables: [S, max_pages] int32 — per-slot page ids, table order
     lengths:     [S] int32 — valid positions per slot (0 = inactive slot,
                  defined to return zeros)
+    k_scale/v_scale: [P, H] fp32 per-(page, head) dequant scales when the
+                 pools are int8 — dequant happens inside the kernel body
+                 right after each page DMA, and the output is fp32
     returns      [S, H, D]
 
     Routes to the Pallas paged flash-decode kernel on TPU when the pool
@@ -236,7 +267,10 @@ def paged_attention(q, k_pool, v_pool, page_tables, lengths, *,
     """
     p_, h, page_size, d = k_pool.shape
     scale = float(sm_scale if sm_scale is not None else 1.0 / (d ** 0.5))
-    q = q.astype(k_pool.dtype)
+    if k_scale is not None:
+        q = q.astype(jnp.float32)
+    else:
+        q = q.astype(k_pool.dtype)
     s = q.shape[0]
     if _on_tpu() and paged_shape_supported(page_size, d):
         # under an active serving-mesh shard the pool's head axis is
@@ -249,20 +283,22 @@ def paged_attention(q, k_pool, v_pool, page_tables, lengths, *,
         qr = _pick_q_rows(page_size, d, k_pool.dtype,
                           local_heads=h if sharded else None)
         q8 = jnp.broadcast_to(q.reshape(s * h, 1, d), (s * h, qr, d))
-        out = _paged_pallas(q8, k_pool, v_pool, page_tables, lengths, scale)
+        out = _paged_pallas(q8, k_pool, v_pool, page_tables, lengths, scale,
+                            k_scale=k_scale, v_scale=v_scale)
         return out[:, 0, :].reshape(s, h, d)
     return _xla_paged_reference(q, k_pool, v_pool, page_tables, lengths,
-                                scale)
+                                scale, k_scale=k_scale, v_scale=v_scale)
 
 
-def _xla_paged_reference(q, k_pool, v_pool, page_tables, lengths, scale):
+def _xla_paged_reference(q, k_pool, v_pool, page_tables, lengths, scale,
+                         k_scale=None, v_scale=None):
     """jnp-composed reference: gather each slot's pages into a contiguous
     view, masked single-query attention, fp32 softmax (the fallback AND
     the parity oracle for tpu_smoke).  Matches
     ``decode_attention._xla_decode_reference`` on contiguous layouts;
     length-0 slots return zeros (the kernel's inactive-slot semantics)."""
-    k = gather_pages(k_pool, page_tables)
-    v = gather_pages(v_pool, page_tables)
+    k = gather_pages(k_pool, page_tables, k_scale)
+    v = gather_pages(v_pool, page_tables, v_scale)
     s = jnp.einsum("shd,shkd->shk", q, k,
                    preferred_element_type=jnp.float32) * np.float32(scale)
     lengths = lengths.astype(jnp.int32)

@@ -235,8 +235,16 @@ def build_ragged_plan(runs: Sequence[Tuple[int, int, np.ndarray]], *,
 # ---------------------------------------------------------------------------
 
 def _ragged_kernel(blk_ref, page_ref, ps_ref, ni_ref, base_ref, rows_ref,
-                   q_ref, k_ref, v_ref, o_ref, acc_sc, m_sc, l_sc, *,
-                   scale, page_size, wl_max):
+                   q_ref, k_ref, v_ref, *rest, scale, page_size, wl_max,
+                   quantized=False):
+    # quantized pools carry two extra (1, 1) scale inputs whose index map
+    # mirrors the KV page index — each page's per-head absmax scale rides
+    # the same scalar-prefetched translation, so the dequant multiply
+    # happens right after the page DMA with no extra HBM round-trip
+    if quantized:
+        ks_ref, vs_ref, o_ref, acc_sc, m_sc, l_sc = rest
+    else:
+        o_ref, acc_sc, m_sc, l_sc = rest
     w = pl.program_id(1)
     n = ni_ref[0]
     blk = blk_ref[w]
@@ -258,8 +266,15 @@ def _ragged_kernel(blk_ref, page_ref, ps_ref, ni_ref, base_ref, rows_ref,
     @pl.when(live)
     def _body():
         q = q_ref[0, 0]                             # [QB, D]
-        k = k_ref[0, 0]                             # [page_size, D]
-        v = v_ref[0, 0]
+        if quantized:
+            # in-kernel dequant: int8 page x its (page, head) scale ->
+            # fp32 operands (q arrives fp32 on this path; the online-
+            # softmax accumulation below is fp32 regardless)
+            k = k_ref[0, 0].astype(jnp.float32) * ks_ref[0, 0]
+            v = v_ref[0, 0].astype(jnp.float32) * vs_ref[0, 0]
+        else:
+            k = k_ref[0, 0]                         # [page_size, D]
+            v = v_ref[0, 0]
         s = _dot(q, k, ((1,), (1,))) * np.float32(scale)   # [QB, page_size]
         rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
         cols = ps_ref[w] * page_size + jax.lax.broadcasted_iota(
@@ -292,7 +307,8 @@ def _ragged_kernel(blk_ref, page_ref, ps_ref, ni_ref, base_ref, rows_ref,
 
 
 def _ragged_pallas(q_blocks, k_pool, v_pool, wl_blk, wl_page, wl_ps,
-                   n_items, blk_base, blk_rows, scale, interpret=False):
+                   n_items, blk_base, blk_rows, scale, interpret=False,
+                   k_scale=None, v_scale=None):
     """q_blocks: [NB, H, QB, D] host-packed token blocks; k/v pool:
     [P, H, page_size, D]; work-list + per-block arrays as documented on
     :data:`RAGGED_PLAN_FIELDS` -> [NB, H, QB, D].  ``interpret=True`` runs
@@ -308,8 +324,10 @@ def _ragged_pallas(q_blocks, k_pool, v_pool, wl_blk, wl_page, wl_ps,
     nb, h, qb, d = q_blocks.shape
     page_size = k_pool.shape[2]
     wl_max = wl_blk.shape[0]
+    quantized = k_scale is not None
     kernel = functools.partial(_ragged_kernel, scale=scale,
-                               page_size=page_size, wl_max=wl_max)
+                               page_size=page_size, wl_max=wl_max,
+                               quantized=quantized)
 
     def q_index(hh, w, blk_ref, page_ref, ps_ref, ni_ref, base_ref,
                 rows_ref):
@@ -319,14 +337,24 @@ def _ragged_pallas(q_blocks, k_pool, v_pool, wl_blk, wl_page, wl_ps,
                  rows_ref):
         return (page_ref[w], hh, 0, 0)
 
+    def scale_index(hh, w, blk_ref, page_ref, ps_ref, ni_ref, base_ref,
+                    rows_ref):
+        return (page_ref[w], hh)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, qb, d), q_index),
+        pl.BlockSpec((1, 1, page_size, d), kv_index),
+        pl.BlockSpec((1, 1, page_size, d), kv_index),
+    ]
+    operands = [q_blocks, k_pool, v_pool]
+    if quantized:
+        in_specs += [pl.BlockSpec((1, 1), scale_index),
+                     pl.BlockSpec((1, 1), scale_index)]
+        operands += [k_scale, v_scale]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=6,
         grid=(h, wl_max),
-        in_specs=[
-            pl.BlockSpec((1, 1, qb, d), q_index),
-            pl.BlockSpec((1, 1, page_size, d), kv_index),
-            pl.BlockSpec((1, 1, page_size, d), kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, qb, d), q_index),
         scratch_shapes=[
             pltpu.VMEM((qb, d), jnp.float32),
@@ -345,7 +373,7 @@ def _ragged_pallas(q_blocks, k_pool, v_pool, wl_blk, wl_page, wl_ps,
     )(wl_blk.astype(jnp.int32), wl_page.astype(jnp.int32),
       wl_ps.astype(jnp.int32), jnp.reshape(n_items, (1,)).astype(jnp.int32),
       blk_base.astype(jnp.int32), blk_rows.astype(jnp.int32),
-      q_blocks, k_pool, v_pool)
+      *operands)
     return out
 
 
@@ -354,7 +382,8 @@ def _ragged_pallas(q_blocks, k_pool, v_pool, wl_blk, wl_page, wl_ps,
 # ---------------------------------------------------------------------------
 
 def ragged_paged_attention(q, k_pool, v_pool, token_tables, lengths, plan,
-                           *, sm_scale=None, interpret=False):
+                           *, sm_scale=None, interpret=False,
+                           k_scale=None, v_scale=None):
     """Token-granular attention over the paged KV pool for one fused
     mixed prefill/decode step.
 
@@ -368,6 +397,10 @@ def ragged_paged_attention(q, k_pool, v_pool, token_tables, lengths, plan,
     lengths:      [T] int32 — valid context per token (position + 1)
     plan:         the :data:`RAGGED_PLAN_FIELDS` arrays from
                   :func:`build_ragged_plan`
+    k_scale/v_scale: [P, H] fp32 per-(page, head) absmax scales when the
+                  pool is int8 (docs/serving.md "Quantized serving") —
+                  dequant happens INSIDE the kernel right after each
+                  page DMA; the output is then fp32
     returns       [T, H, D]
 
     Routes to the Pallas ragged kernel on TPU when the layout is eligible,
@@ -375,7 +408,13 @@ def ragged_paged_attention(q, k_pool, v_pool, token_tables, lengths, plan,
     serving path)."""
     p_, h, page_size, d = k_pool.shape
     scale = float(sm_scale if sm_scale is not None else 1.0 / (d ** 0.5))
-    q = q.astype(k_pool.dtype)
+    if k_scale is not None:
+        # int8 pool: q joins the fp32 dequant epilogue, NOT the pool
+        # dtype — an int8 q would destroy the query values outright, and
+        # an implicit promotion would trip GL001
+        q = q.astype(jnp.float32)
+    else:
+        q = q.astype(k_pool.dtype)
     (blk_tok, tok_blk, tok_row, blk_base, blk_rows,
      wl_blk, wl_page, wl_ps, n_items) = plan
     qb = int(blk_tok.shape[1])
@@ -387,15 +426,17 @@ def ragged_paged_attention(q, k_pool, v_pool, token_tables, lengths, plan,
         qg = jnp.transpose(qg.reshape(nb, qb, h, d), (0, 2, 1, 3))
         out = _ragged_pallas(qg, k_pool, v_pool, wl_blk, wl_page, wl_ps,
                              n_items, blk_base, blk_rows, scale,
-                             interpret=interpret)
+                             interpret=interpret,
+                             k_scale=k_scale, v_scale=v_scale)
         flat = jnp.transpose(out, (0, 2, 1, 3)).reshape(nb * qb, h, d)
         idx = tok_blk.astype(jnp.int32) * qb + tok_row.astype(jnp.int32)
         return jnp.take(flat, idx, axis=0)
     return _xla_ragged_reference(q, k_pool, v_pool, token_tables, lengths,
-                                 scale)
+                                 scale, k_scale=k_scale, v_scale=v_scale)
 
 
-def _xla_ragged_reference(q, k_pool, v_pool, token_tables, lengths, scale):
+def _xla_ragged_reference(q, k_pool, v_pool, token_tables, lengths, scale,
+                          k_scale=None, v_scale=None):
     """jnp-composed reference: the paged gather oracle applied per TOKEN —
     each flat query token gathers its slot's pages and runs masked
     single-query attention over its own ``length`` positions (fp32
@@ -403,8 +444,10 @@ def _xla_ragged_reference(q, k_pool, v_pool, token_tables, lengths, scale):
     per-token tables/lengths, which makes the old per-slot decode
     semantics a strict special case (T == num_slots, one token per slot).
     The fallback AND the parity oracle for tpu_smoke's ragged case;
-    length-0 tokens return zeros."""
+    length-0 tokens return zeros.  Quantized pools (``k_scale`` given)
+    dequantize per gathered page inside the oracle — same contract as
+    the kernel's in-body dequant."""
     from .paged_attention import _xla_paged_reference
 
     return _xla_paged_reference(q, k_pool, v_pool, token_tables, lengths,
-                                scale)
+                                scale, k_scale=k_scale, v_scale=v_scale)

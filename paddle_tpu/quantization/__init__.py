@@ -12,6 +12,10 @@ int8 simulated quantization only (TPU int8 matmuls arrive via XLA when
 the pattern matches).
 """
 from .config import QuantConfig  # noqa: F401
+from .int8 import (  # noqa: F401
+    Int8Linear, quantize_for_serving, quantized_matmul,
+)
+from .kv import TINY_SCALE, dequant_pages, quantize_kv_write  # noqa: F401
 from .observers import AbsmaxObserver, AVGObserver, BaseObserver  # noqa: F401
 from .ptq import PTQ  # noqa: F401
 from .qat import QAT  # noqa: F401
@@ -21,4 +25,6 @@ __all__ = [
     "QuantConfig", "QAT", "PTQ",
     "BaseObserver", "AbsmaxObserver", "AVGObserver",
     "BaseQuanter", "FakeQuanterWithAbsMaxObserver",
+    "quantized_matmul", "Int8Linear", "quantize_for_serving",
+    "quantize_kv_write", "dequant_pages", "TINY_SCALE",
 ]

@@ -1,0 +1,81 @@
+"""Int8 KV-page quantization: write-quantize / read-dequant helpers.
+
+The serving engine's paged KV pool can store pages as int8 with ONE
+fp32 absmax scale per (page, head) in a parallel ``[num_pages, H]``
+buffer (serving/paged_cache.py ``kv_dtype="int8"``).  This module owns
+the in-graph write-side quantizer; the read side lives INSIDE the
+attention kernels (ops/pallas_kernels/*_attention.py dequantize each
+page right after its DMA, so dequantized values never round-trip HBM).
+
+Scale update contract ("fresh-page step-absmax, stale-page clip" —
+docs/serving.md "Quantized serving"):
+
+- A page is FRESH in a step when the step writes its offset-0 row (a
+  page's first write always lands at offset 0: admission hands out
+  whole pages, the prefix cache splices only FULL pages, so every
+  owner starts writing at its page boundary), or when its scale is
+  still the zero-initialized sentinel.  A fresh page's scale becomes
+  the per-head absmax/127 over ALL tokens the step writes into it —
+  for whole-page prefill that is the exact page absmax.
+- A STALE page (later decode tokens trickling into a partially filled
+  page) keeps its existing scale; new tokens clip into ±127.
+
+The update is built from commutative scatter ops (``mul`` by {0,1} to
+reset fresh rows, then scatter-``max`` of the step contributions), so
+it is deterministic under duplicate indices and identical token
+sequences produce bitwise-identical pages AND scales — the property
+the prefix cache's copy-on-write page adoption relies on.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["TINY_SCALE", "quantize_kv_write", "dequant_pages"]
+
+# floor for effective scales: an all-zero page dequantizes to zeros
+# instead of dividing by zero, and real absmax contributions stay
+# strictly positive so the freshness sentinel (scale == 0.0) is
+# unambiguous
+TINY_SCALE = 1e-8
+
+
+def quantize_kv_write(x, page_ids, offs, scale):
+    """Quantize one step's KV scatter values; update per-page scales.
+
+    x: ``[S, C, H, D]`` float values about to be scattered to
+    ``pool[page_ids, :, offs, :]``; ``page_ids`` / ``offs``:
+    ``[S, C]`` int32 (padding rows point at the null page — its scale
+    row absorbs their updates and is never read validly); ``scale``:
+    ``[P, H]`` fp32 per-(page, head) scales.
+
+    Returns ``(q, new_scale)`` where ``q`` is the int8 payload for the
+    same scatter and ``new_scale`` the updated ``[P, H]`` buffer.
+    """
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)               # [S, C, H]
+    contrib = absmax / jnp.float32(127.0) + jnp.float32(TINY_SCALE)
+    fresh = (offs == 0)                                  # [S, C]
+    # reset fresh pages' scale rows (stale entries multiply the null
+    # page's row by 1.0 — a no-op)
+    tgt = jnp.where(fresh, page_ids, 0)
+    keep = jnp.where(fresh, jnp.float32(0.0), jnp.float32(1.0))
+    s1 = scale.at[tgt].mul(keep[..., None])
+    # freshness per (token, head) AFTER the reset: covers both the
+    # offset-0 writers and never-written pages (zero-init sentinel)
+    is_fresh = jnp.take(s1, page_ids, axis=0) == jnp.float32(0.0)
+    s2 = s1.at[page_ids].max(
+        jnp.where(is_fresh, contrib, jnp.float32(0.0)))
+    s_eff = jnp.maximum(jnp.take(s2, page_ids, axis=0),
+                        jnp.float32(TINY_SCALE))         # [S, C, H]
+    q = jnp.clip(jnp.round(xf / s_eff[..., None]),
+                 -127.0, 127.0).astype(jnp.int8)
+    return q, s2
+
+
+def dequant_pages(pool, scale):
+    """``[P, H, ps, D]`` int8 pages x ``[P, H]`` scales -> fp32.
+
+    The XLA oracle path (and tests) — the Pallas kernels do the same
+    multiply per page INSIDE the kernel body instead.
+    """
+    return pool.astype(jnp.float32) * scale[:, :, None, None]

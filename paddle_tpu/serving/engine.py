@@ -523,8 +523,26 @@ class ServingEngine:
                  max_queue_wait_s: Optional[float] = None,
                  readmission_backoff_s: float = 0.05,
                  backoff_max_s: float = 5.0,
-                 mesh=None, lora=None, prefix_cache: bool = False):
+                 mesh=None, lora=None, prefix_cache: bool = False,
+                 kv_dtype: Optional[str] = None,
+                 weight_dtype: Optional[str] = None):
         cfg = model.config
+        # quantized serving (docs/serving.md "Quantized serving"):
+        # ``kv_dtype`` is the preferred name for the pool dtype (wins
+        # over the historical ``cache_dtype`` when both are given) —
+        # "int8" stores pool pages quantized with per-(page, head)
+        # absmax scale buffers; ``weight_dtype="int8"`` PTQs the model's
+        # decode projections in place before the steps compile.
+        if kv_dtype is not None:
+            cache_dtype = kv_dtype
+        if weight_dtype is not None:
+            if str(weight_dtype) != "int8":
+                raise ValueError(
+                    f"weight_dtype={weight_dtype!r}: only 'int8' (or None "
+                    "for the model's own weights) is supported")
+            from ..quantization.int8 import quantize_for_serving
+
+            quantize_for_serving(model)
         # multi-tenant LoRA (serving/lora.py): per-request adapter-page
         # ids ride the packed step input; the pool's slab Tensors are
         # captured step state (register/evict never retrace)

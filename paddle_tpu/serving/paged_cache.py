@@ -18,6 +18,18 @@ The pool tensors are plain framework Tensors so in-place updates are
 mutation-logged — ``jit.to_static`` donates them and the compiled serving
 step aliases each write into the same HBM (docs/decoding.md donation
 contract, unchanged).
+
+``dtype="int8"`` selects the QUANTIZED pool regime (docs/serving.md
+"Quantized serving"): pages store int8 payloads and a parallel fp32
+``[num_pages, H]`` scale buffer per layer (``[L, num_pages, H]``
+stacked) holds one absmax scale per (page, head).  The scale buffers
+are indexed BY PAGE ID, so they ride the same BlockAllocator ledger as
+the pages themselves — alloc/free/share/spec-reserve/refcount semantics
+are untouched and prefix-cache COW, speculative rollback, and the
+4-term accounting invariant compose with quantization by construction.
+Writes quantize in-graph at scatter time
+(quantization/kv.quantize_kv_write); reads dequantize INSIDE the
+attention kernels right after each page DMA.
 """
 from __future__ import annotations
 
@@ -86,14 +98,30 @@ class PagedKVCache(_KVBuffers):
         self.head_dim = head_dim
         self.dtype = str(dtype)
         self.stacked = stacked
+        # quantized regime: int8 pages + per-(page, head) fp32 absmax
+        # scales.  Scale buffers are keyed by POOL PAGE ID so they need
+        # no allocator of their own — a page's scale travels with it
+        # through every ledger transition (free/used/spec/shared).
+        self.quantized = self.dtype == "int8"
+        self.k_scale = self.v_scale = None
         if stacked:
             shape = (num_layers, num_pages, num_heads, page_size, head_dim)
             self.k = Tensor(jnp.zeros(shape, jd))
             self.v = Tensor(jnp.zeros(shape, jd))
+            if self.quantized:
+                ss = (num_layers, num_pages, num_heads)
+                self.k_scale = Tensor(jnp.zeros(ss, jnp.float32))
+                self.v_scale = Tensor(jnp.zeros(ss, jnp.float32))
         else:
             shape = (num_pages, num_heads, page_size, head_dim)
             self.k = [Tensor(jnp.zeros(shape, jd)) for _ in range(num_layers)]
             self.v = [Tensor(jnp.zeros(shape, jd)) for _ in range(num_layers)]
+            if self.quantized:
+                ss = (num_pages, num_heads)
+                self.k_scale = [Tensor(jnp.zeros(ss, jnp.float32))
+                                for _ in range(num_layers)]
+                self.v_scale = [Tensor(jnp.zeros(ss, jnp.float32))
+                                for _ in range(num_layers)]
 
     def layer(self, i: int):
         """(k, v) pool Tensors for layer ``i`` (layered layout only)."""
@@ -101,6 +129,28 @@ class PagedKVCache(_KVBuffers):
             raise ValueError("layer() is for the per-layer pool layout; "
                              "the stacked pool is scanned whole")
         return self.k[i], self.v[i]
+
+    def layer_scales(self, i: int):
+        """(k_scale, v_scale) Tensors for layer ``i`` — ``(None, None)``
+        outside the quantized regime (layered layout only)."""
+        if self.stacked:
+            raise ValueError("layer_scales() is for the per-layer pool "
+                             "layout; the stacked pool is scanned whole")
+        if not self.quantized:
+            return None, None
+        return self.k_scale[i], self.v_scale[i]
+
+    def _tensors(self):
+        """All device buffers, INCLUDING the scale buffers — so
+        ``nbytes`` counts scale bytes, ``release`` frees them, and the
+        watchdog's zombie cleanup orphans them with the pages."""
+        ts = super()._tensors()
+        if self.quantized:
+            if self.stacked:
+                ts = ts + [self.k_scale, self.v_scale]
+            else:
+                ts = ts + list(self.k_scale) + list(self.v_scale)
+        return ts
 
 
 class BlockAllocator:

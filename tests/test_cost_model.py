@@ -575,3 +575,53 @@ def test_op_cache_no_overflow_below_cap():
     st = op_cache.stats()
     assert st["add"]["shape_keys_overflow"] is False
     op_cache.reset_stats()
+
+
+# ---------------------------------------------------------------------------
+# quantized-serving byte accounting (ISSUE-17): per-dtype pool/decode-step
+# goldens — the capacity math serving_bench's fixed-byte sweeps stand on
+# ---------------------------------------------------------------------------
+
+def test_paged_pool_bytes_golden_per_dtype():
+    # the serving gate's geometry: gpt_tiny (H=4, D=16, L=2), ps=16, 6 pages
+    fp32 = cm.paged_pool_bytes(6, 4, 16, 16, num_layers=2, dtype="float32")
+    bf16 = cm.paged_pool_bytes(6, 4, 16, 16, num_layers=2, dtype="bfloat16")
+    int8 = cm.paged_pool_bytes(6, 4, 16, 16, num_layers=2, dtype="int8")
+    assert fp32 == 2 * 2 * 6 * 4 * 16 * 16 * 4 == 98304
+    assert bf16 == fp32 // 2
+    # int8 pages are 1/4 the fp32 bytes; the fp32 [P, H] scale sidecars
+    # (K + V, per layer) ride on top and stay a rounding error
+    assert int8 == fp32 // 4 + 2 * 2 * 6 * 4 * 4 == 24960
+    assert int8 < fp32 // 3          # >= 3x the pages at equal bytes
+
+
+def test_paged_pool_bytes_matches_real_pool():
+    from paddle_tpu.models import GPTForPretraining, gpt_tiny
+
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    m = GPTForPretraining(cfg)
+    for dtype in ("float32", "bfloat16", "int8"):
+        cache = m.new_paged_kv_cache(6, 16, dtype=dtype)
+        want = cm.paged_pool_bytes(6, cfg.num_heads, 16, cfg.head_dim,
+                                   num_layers=cfg.num_layers, dtype=dtype)
+        assert cache.nbytes == want, (dtype, cache.nbytes, want)
+        cache.release()
+
+
+def test_decode_step_kv_bytes_int8_at_most_half_fp32():
+    # ISSUE-17 acceptance: the decode step is memory-bound and int8 pages
+    # must at least halve its HBM-upper bound vs fp32 at ANY context
+    for ctx in (64, 128, 500, 4096):
+        f = cm.decode_step_kv_bytes(ctx, 16, 128, 128, num_layers=24,
+                                    dtype="float32")
+        b = cm.decode_step_kv_bytes(ctx, 16, 128, 128, num_layers=24,
+                                    dtype="bfloat16")
+        i = cm.decode_step_kv_bytes(ctx, 16, 128, 128, num_layers=24,
+                                    dtype="int8")
+        assert f == 2 * 24 * ctx * 16 * 128 * 4
+        assert b == f // 2
+        assert i <= f // 2 and i < b
+    # golden at one point, scale reads included: ceil(500/128)=4 pages
+    assert cm.decode_step_kv_bytes(500, 16, 128, 128, num_layers=24,
+                                   dtype="int8") \
+        == 2 * 24 * 500 * 16 * 128 + 2 * 24 * 4 * 16 * 4

@@ -159,6 +159,22 @@ def _lint_serve(pt, np):
         eng.run_until_idle()
     finally:
         eng.close()
+    # quantized step variant (ISSUE-17): int8 KV pages (in-kernel dequant
+    # epilogue) + int8 weight projections.  The dequant is an explicit
+    # astype+scale and the matmuls re-quantize per row, so GL001 must stay
+    # silent — any finding here means a silent promotion crept into the
+    # quantized hot path.
+    model_q = _build_model(pt, cfg)
+    model_q.eval()
+    eng = ServingEngine(model_q, num_slots=_SRV_SLOTS, page_size=_SRV_PAGE,
+                        max_context=_SRV_CTX, kv_dtype="int8",
+                        weight_dtype="int8")
+    try:
+        for plen in _SRV_PROMPTS:
+            eng.submit(rng.randint(0, cfg.vocab_size, (plen,)), _SRV_NEW)
+        eng.run_until_idle()
+    finally:
+        eng.close()
     # speculative + multi-tenant LoRA step variants (ISSUE-15): the
     # verify program (in-graph accept/reject over gathered k+1 rows) and
     # the draft program lint alongside a LoRA-pooled step whose gathered

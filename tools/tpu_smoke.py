@@ -112,6 +112,54 @@ def main() -> int:
         r = pa.paged_shape_unsupported_reason(100, 48)
         assert r is not None and r.code == "GL002"
 
+    # -- int8 KV pages (docs/serving.md "Quantized serving"): quantize-
+    # on-write into a SHUFFLED pool, fused in-kernel dequant attention vs
+    # the dequantized-pool oracle, and bitwise write determinism (the
+    # property prefix-cache COW page adoption relies on) ------------------
+    def quantized_kv():
+        from paddle_tpu.ops.pallas_kernels import paged_attention as pa
+        from paddle_tpu.quantization.kv import (
+            dequant_pages, quantize_kv_write,
+        )
+        P, H, PS, D = 17, 4, 128, 64
+        S, MP = 4, 4
+        tbl = jnp.array(rng.permutation(P - 1)[:S * MP].reshape(S, MP) + 1,
+                        jnp.int32)
+
+        def build():
+            kp = jnp.zeros((P, H, PS, D), jnp.int8)
+            vp = jnp.zeros((P, H, PS, D), jnp.int8)
+            ks = jnp.zeros((P, H), jnp.float32)
+            vs = jnp.zeros((P, H), jnp.float32)
+            offs = jnp.arange(PS, dtype=jnp.int32)[None]
+            wrng = np.random.RandomState(5)
+            for s in range(S):
+                for j in range(MP):
+                    pid = jnp.full((1, PS), tbl[s, j], jnp.int32)
+                    xk = jnp.array(wrng.randn(1, PS, H, D), jnp.float32)
+                    xv = jnp.array(wrng.randn(1, PS, H, D), jnp.float32)
+                    qk, ks = quantize_kv_write(xk, pid, offs, ks)
+                    qv, vs = quantize_kv_write(xv, pid, offs, vs)
+                    kp = kp.at[tbl[s, j]].set(qk[0].transpose(1, 0, 2))
+                    vp = vp.at[tbl[s, j]].set(qv[0].transpose(1, 0, 2))
+            return kp, vp, ks, vs
+
+        kp, vp, ks, vs = build()
+        q = jnp.array(rng.randn(S, H, D), jnp.float32)
+        ln = jnp.array((128, 200, 256, 384), jnp.int32)
+        got = pa.paged_attention(q, kp, vp, tbl, ln,
+                                 k_scale=ks, v_scale=vs)
+        want = pa._xla_paged_reference(
+            q, dequant_pages(kp, ks), dequant_pages(vp, vs), tbl, ln,
+            0.125).astype(jnp.float32)
+        err = float(jnp.abs(got.astype(jnp.float32) - want).max())
+        assert err < 0.05, f"int8 dequant parity err={err}"
+        # identical write sequence -> bitwise-identical pages AND scales
+        kp2, vp2, ks2, vs2 = build()
+        for a, b in ((kp, kp2), (vp, vp2), (ks, ks2), (vs, vs2)):
+            assert bool(jnp.array_equal(a, b)), \
+                "quantize-on-write must be deterministic"
+
     # -- ragged paged attention (fused mixed prefill/decode step) vs the
     # per-token gather oracle: mixed decode + page-straddling prefill
     # runs, shuffled out-of-order pool pages, boundary positions incl.
@@ -650,6 +698,7 @@ def main() -> int:
     check("train_pipeline", train_pipeline)
     check("decode_attention", decode_attention)
     check("paged_attention", paged_attention)
+    check("quantized_kv", quantized_kv)
     check("ragged_attention", ragged_attention)
     check("fused_adamw", fused_adamw)
     check("rms_norm", rms_norm)
