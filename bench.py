@@ -130,19 +130,52 @@ def _emit_roofline(phase, name, cost_reports_with_counts, spec, seconds,
             return
         intensity = flops / max(nbytes, 1)
         attainable = spec.attainable_flops(intensity)
-        frac = (flops / seconds) / attainable
         progs = ",".join(f"{c.program}x{n}"
                          for c, n in cost_reports_with_counts)
+        # comm-aware denominators (Graph Lint v3): when any program has
+        # modelled collectives, the UNHIDEABLE comm time (comm seconds x
+        # (1 - overlap fraction)) is subtracted from the compute roofline's
+        # wall clock instead of folding it into apparent MFU loss, and the
+        # comm share is emitted as its own *_comm_roofline_fraction line.
+        comm_s = sum(c.comm_seconds(spec) * n
+                     for c, n in cost_reports_with_counts
+                     if getattr(c, "collectives", None))
+        compute_seconds = seconds
+        comm_note = ""
+        if comm_s > 0:
+            ov = sum(c.overlap_fraction(spec) * c.comm_seconds(spec) * n
+                     for c, n in cost_reports_with_counts
+                     if getattr(c, "collectives", None)) / comm_s
+            unhidden = comm_s * (1.0 - ov)
+            compute_seconds = max(seconds - min(unhidden, seconds * 0.99),
+                                  seconds * 0.01)
+            comm_note = (" denominator=wall_minus_unhidden_comm "
+                         f"comm_est_ms={comm_s * 1e3:.3f} "
+                         f"overlap_frac={ov:.2f}")
+        frac = (flops / compute_seconds) / attainable
         _emit(
             f"gpt_{name}_{phase}_roofline_fraction",
             round(frac, 4),
-            f"frac (programs={progs} gflop={flops / 1e9:.1f} "
+            f"frac=compute-roofline (programs={progs} gflop={flops / 1e9:.1f} "
             f"hbm_mib={nbytes / 2**20:.0f} intensity={intensity:.1f} "
             f"bound={'compute' if intensity >= spec.ridge else 'memory'} "
-            f"attainable={attainable / 1e12:.1f}e12 chip={spec.name} "
+            f"attainable={attainable / 1e12:.1f}e12 chip={spec.name}"
+            f"{comm_note} "
             f"on {'tpu' if on_tpu else 'cpu'})",
             0.0,
         )
+        if comm_s > 0:
+            # comm roofline: modelled ICI seconds / measured wall seconds —
+            # how much of the step the static comm model accounts for
+            _emit(
+                f"gpt_{name}_{phase}_comm_roofline_fraction",
+                round(comm_s / seconds, 4),
+                f"frac=comm_est/wall (programs={progs} "
+                f"comm_est_ms={comm_s * 1e3:.3f} wall_ms={seconds * 1e3:.3f} "
+                f"ici_bw={spec.ici_bw / 1e9:.0f}GB/s chip={spec.name} "
+                f"on {'tpu' if on_tpu else 'cpu'})",
+                0.0,
+            )
     except Exception as e:  # noqa: BLE001 — a cost line must never kill a metric
         sys.stderr.write(f"bench: roofline line ({phase}) failed: "
                          f"{type(e).__name__}: {str(e)[:300]}\n")

@@ -1,7 +1,7 @@
 """Static analysis of traced programs (Graph Lint).
 
 ``analysis.lint(fn, *args)`` walks the jaxpr of any traceable function and
-returns findings with stable codes (GL001-GL007), severities, and eqn
+returns findings with stable codes (GL001-GL011), severities, and eqn
 provenance; ``FLAGS_graph_lint`` / ``PADDLE_TPU_GRAPH_LINT=1`` lints every
 ``jit.to_static`` program at compile time; ``tools/graph_lint.py`` is the
 CI gate over the bench models.  See docs/graph_lint.md.
@@ -18,11 +18,16 @@ from .codes import (  # noqa: F401
     padding_waste_elems,
 )
 from .cost_model import (  # noqa: F401
+    COLLECTIVE_PRIMS,
+    CollectiveCost,
     CostReport,
     EqnCost,
     HardwareSpec,
     chip_spec,
     clear_cost_reports,
+    collective_axis_names,
+    collective_hops,
+    collective_wire_bytes,
     cost,
     cost_jaxpr,
     cost_reports,
@@ -46,8 +51,10 @@ __all__ = [
     "CODES", "SEVERITY_RANK", "GateReason", "decode_gate_reason",
     "flash_gate_reason", "misaligned_dims", "padded_shape",
     "padding_waste_elems",
-    "CostReport", "EqnCost", "HardwareSpec", "chip_spec",
-    "clear_cost_reports", "cost", "cost_jaxpr", "cost_reports",
+    "COLLECTIVE_PRIMS", "CollectiveCost", "CostReport", "EqnCost",
+    "HardwareSpec", "chip_spec", "clear_cost_reports",
+    "collective_axis_names", "collective_hops", "collective_wire_bytes",
+    "cost", "cost_jaxpr", "cost_reports",
     "cost_static_program", "autotune",
     "Baseline", "Finding", "LintConfig", "LintReport", "churn_findings",
     "clear_reports", "lint", "lint_jaxpr", "lint_static_program", "reports",

@@ -34,6 +34,11 @@ CODES = {
     "GL005": ("dead-code", "warning"),
     "GL006": ("intermediate-blowup", "warning"),
     "GL007": ("retrace-churn", "warning"),
+    # v3 (SPMD/communication passes — see docs/graph_lint.md "v3"):
+    "GL008": ("unoverlapped-collective", "warning"),
+    "GL009": ("replication-blowup", "warning"),
+    "GL010": ("collective-payload-misalignment", "warning"),
+    "GL011": ("degenerate-collective", "info"),
 }
 
 SEVERITY_RANK = {"error": 3, "warning": 2, "info": 1}

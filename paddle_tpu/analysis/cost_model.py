@@ -34,6 +34,22 @@ mesh axes the body runs manually over — keeping every count in GLOBAL
 (whole-cluster) units like the rest of the program's GSPMD-annotated
 equations.
 
+v3 adds the SPMD/communication model (see docs/graph_lint.md "v3"):
+every collective primitive reachable by the same walk (``psum``,
+``all_gather``, ``reduce_scatter``, ``all_to_all``, ``ppermute`` inside
+``shard_map`` bodies, with mesh-axis sizes resolved from the enclosing
+``shard_map`` eqn's mesh) contributes a :class:`CollectiveCost`: the
+serialized **wire bytes over the slowest ICI link** under the standard
+ring schedules (all-reduce ``2(n-1)/n·B``, all-gather/reduce-scatter
+``(n-1)/n`` of the full payload, all-to-all ``(n-1)/n·B``, ppermute one
+hop of ``B``), hop-latency terms, and a statically computed **overlap
+fraction** — the per-chip FLOPs scheduled between the collective's issue
+point and its first consumer, as a fraction of the collective's
+estimated wire time.  ``CostReport.comm_seconds(spec)`` /
+``comm_seconds_by_axis`` / ``overlap_fraction`` aggregate these;
+collectives are costed per-LINK (never multiplied by the shard count —
+all chips drive their links concurrently), only by loop trip counts.
+
 Entry points mirror the linter: :func:`cost` traces a function
 abstractly, :func:`cost_jaxpr` takes a ClosedJaxpr,
 :func:`cost_static_program` costs one ``jit.to_static`` entry (the
@@ -57,6 +73,7 @@ from .graph_lint import (  # shared jaxpr plumbing — one walker idiom
     _aval,
     _dtype_of,
     _fmt_aval,
+    _is_var,
     _nbytes,
     _provenance,
     _shape_of,
@@ -65,6 +82,8 @@ from .graph_lint import (  # shared jaxpr plumbing — one walker idiom
 
 __all__ = [
     "HardwareSpec", "chip_spec", "EqnCost", "CostReport",
+    "CollectiveCost", "COLLECTIVE_PRIMS",
+    "collective_wire_bytes", "collective_hops", "collective_axis_names",
     "cost", "cost_jaxpr", "cost_static_program",
     "cost_reports", "clear_cost_reports",
     "dot_flops", "eqn_flops", "ragged_padding_waste",
@@ -79,13 +98,19 @@ __all__ = [
 
 @dataclasses.dataclass(frozen=True)
 class HardwareSpec:
-    """One chip's roofline: bf16 peak FLOP/s and HBM bandwidth (bytes/s).
-    ``ridge`` is the arithmetic intensity (flops/byte) above which a
-    program is compute-bound."""
+    """One chip's roofline: bf16 peak FLOP/s and HBM bandwidth (bytes/s),
+    plus the ICI terms the v3 comm model uses — ``ici_bw`` is the ONE-WAY
+    bandwidth of a single ICI link (bytes/s; ring collectives are
+    serialized on the slowest link, so per-link is the time-determining
+    number, not the per-chip aggregate) and ``ici_latency`` the per-hop
+    latency (seconds).  ``ridge`` is the arithmetic intensity
+    (flops/byte) above which a program is compute-bound."""
 
     name: str
     peak_flops: float
     hbm_bw: float
+    ici_bw: float = 5e10
+    ici_latency: float = 1e-6
 
     @property
     def ridge(self) -> float:
@@ -97,14 +122,16 @@ class HardwareSpec:
 
 
 # substring probes in priority order ('v5e'/'lite' must win over bare
-# 'v5'); FLOPs are bf16 peak, BW is HBM per chip
+# 'v5'); FLOPs are bf16 peak, BW is HBM per chip, ICI numbers are
+# approximate public per-link one-way figures (aggregate per-chip ICI
+# divided by the link count of the generation's torus)
 _CHIP_TABLE = (
-    (("v6",), HardwareSpec("v6e", 918e12, 1640e9)),
-    (("v5e", "lite"), HardwareSpec("v5e", 197e12, 819e9)),
-    (("v5",), HardwareSpec("v5p", 459e12, 2765e9)),
-    (("v4",), HardwareSpec("v4", 275e12, 1228e9)),
-    (("v3",), HardwareSpec("v3", 123e12, 900e9)),
-    (("v2",), HardwareSpec("v2", 45e12, 700e9)),
+    (("v6",), HardwareSpec("v6e", 918e12, 1640e9, 112e9, 1e-6)),
+    (("v5e", "lite"), HardwareSpec("v5e", 197e12, 819e9, 50e9, 1e-6)),
+    (("v5",), HardwareSpec("v5p", 459e12, 2765e9, 100e9, 1e-6)),
+    (("v4",), HardwareSpec("v4", 275e12, 1228e9, 50e9, 1e-6)),
+    (("v3",), HardwareSpec("v3", 123e12, 900e9, 82e9, 1e-6)),
+    (("v2",), HardwareSpec("v2", 45e12, 700e9, 62e9, 1e-6)),
 )
 
 _DEFAULT_SPEC = HardwareSpec("v5e", 197e12, 819e9)  # conservative default
@@ -123,6 +150,244 @@ def chip_spec(*probes: str) -> HardwareSpec:
             if any(k in p for k in keys):
                 return spec
     return _DEFAULT_SPEC
+
+
+# ---------------------------------------------------------------------------
+# collectives (the v3 comm model)
+# ---------------------------------------------------------------------------
+
+# the explicit collective primitives our shard_map bodies emit (GSPMD-
+# inserted collectives materialize only after partitioning and are
+# invisible at the jaxpr level — this model covers the manual ones).
+# ``psum2`` is what a checked-replication shard_map body binds psum as;
+# it is normalized to "psum" everywhere downstream so findings and
+# formulas are jax-version-stable.
+COLLECTIVE_PRIMS = frozenset(
+    {"psum", "psum2", "all_gather", "reduce_scatter", "all_to_all",
+     "ppermute"})
+
+
+def _norm_prim(prim: str) -> str:
+    return "psum" if prim == "psum2" else prim
+
+
+def collective_axis_names(eqn) -> Tuple[str, ...]:
+    """Mesh-axis names a collective eqn runs over (``axes`` on psum,
+    ``axis_name`` elsewhere; either may be a bare name or a tuple)."""
+    try:
+        axes = eqn.params.get("axes", None)
+        if axes is None:
+            axes = eqn.params.get("axis_name", ())
+        if isinstance(axes, (str, int)):
+            axes = (axes,)
+        return tuple(str(a) for a in axes)
+    except Exception:  # noqa: BLE001 — cost model must never crash a walk
+        return ()
+
+
+def collective_wire_bytes(prim: str, payload_bytes: int, out_bytes: int,
+                          n: int) -> int:
+    """Serialized bytes over the slowest ICI link for ONE execution of a
+    collective over an ``n``-way axis, under the standard ring schedules:
+    ring all-reduce moves ``2(n-1)/n`` of the payload (reduce-scatter +
+    all-gather halves), all-gather ``(n-1)/n`` of the GATHERED result,
+    reduce-scatter and all-to-all ``(n-1)/n`` of the local payload, and
+    ppermute exactly the payload (one neighbor hop).  ``payload_bytes``
+    is the per-chip input, ``out_bytes`` the per-chip output."""
+    n = max(int(n), 1)
+    if n == 1:
+        return 0
+    if prim == "psum":
+        return int(round(2 * (n - 1) / n * payload_bytes))
+    if prim == "all_gather":
+        return int(round((n - 1) / n * max(out_bytes, payload_bytes)))
+    if prim in ("reduce_scatter", "all_to_all"):
+        return int(round((n - 1) / n * payload_bytes))
+    if prim == "ppermute":
+        return int(payload_bytes)
+    return 0
+
+
+def collective_hops(prim: str, n: int) -> int:
+    """Latency hops of the ring schedule: ``2(n-1)`` for the all-reduce,
+    ``n-1`` for all-gather/reduce-scatter/all-to-all, one for ppermute."""
+    n = max(int(n), 1)
+    if n == 1:
+        return 0
+    if prim == "psum":
+        return 2 * (n - 1)
+    if prim == "ppermute":
+        return 1
+    return n - 1
+
+
+def mesh_axis_sizes(mesh) -> Dict[str, int]:
+    """{axis name: size} of a (possibly abstract) mesh, via the
+    ``core.compat.axis_sizes`` introspection helper (defensive: an
+    unreadable mesh contributes nothing rather than crashing a walk)."""
+    if mesh is None:
+        return {}
+    try:
+        from ..core.compat import axis_sizes as _axis_sizes
+
+        return _axis_sizes(mesh)
+    except Exception:  # noqa: BLE001
+        try:
+            return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+        except Exception:  # noqa: BLE001
+            return {}
+
+
+def _eqn_chip_flops(eqn, depth: int = 0) -> int:
+    """Per-chip FLOPs of one eqn including sub-jaxpr bodies (scan bodies
+    x trip count, cond's most expensive branch, while bodies once).
+    Unlike the global accounting, shard_map bodies are NOT multiplied by
+    the shard count: overlap compares against the time ONE chip spends
+    computing."""
+    if depth > 32:
+        return 0
+    try:
+        subs = list(_sub_jaxprs(eqn.params))
+        if not subs:
+            return eqn_flops(eqn)
+        prim = eqn.primitive.name
+        if prim == "cond":
+            return max((_jaxpr_chip_flops(s, depth + 1) for s in subs),
+                       default=0)
+        mult = 1
+        if prim == "scan":
+            mult = max(int(eqn.params.get("length", 1) or 1), 1)
+        return mult * sum(_jaxpr_chip_flops(s, depth + 1) for s in subs)
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def _jaxpr_chip_flops(jaxpr, depth: int = 0) -> int:
+    return sum(_eqn_chip_flops(e, depth) for e in jaxpr.eqns)
+
+
+def _first_consumer(eqns, i) -> Optional[int]:
+    """Index of the first eqn after ``i`` consuming any of eqn i's
+    outputs, or None when the result is only consumed at the jaxpr
+    boundary (fully overlappable with everything after it)."""
+    outs = {v for v in eqns[i].outvars if _is_var(v)}
+    if not outs:
+        return None
+    for j in range(i + 1, len(eqns)):
+        # sub-jaxpr consumption is visible through the call eqn's own
+        # invars (jaxprs close over explicit operands), so scanning the
+        # flat invars covers call-like eqns too
+        for v in eqns[j].invars:
+            if _is_var(v) and v in outs:
+                return j
+    return None
+
+
+def _pending_indep_flops(eqns, i: int, j: Optional[int]) -> int:
+    """Per-chip FLOPs of eqns after the first consumer ``j`` that do NOT
+    transitively depend on eqn ``i``'s outputs — the independent work
+    still pending when the program blocks on the collective (GL008's
+    quantity; 0 when the result is consumed only at the boundary)."""
+    if j is None:
+        return 0
+    tainted = {v for v in eqns[i].outvars if _is_var(v)}
+    total = 0
+    for k in range(j, len(eqns)):
+        ek = eqns[k]
+        if any(_is_var(v) and v in tainted for v in ek.invars):
+            tainted.update(v for v in ek.outvars if _is_var(v))
+        elif k > j:
+            total += _eqn_chip_flops(ek)
+    return total
+
+
+@dataclasses.dataclass
+class CollectiveCost:
+    """One collective eqn's communication cost.  ``wire_bytes``/``hops``
+    are per ONE execution; ``mult`` is the loop trip multiplier (scan
+    bodies — never the shard count: every chip drives its links
+    concurrently, so per-link serialized bytes are the wall-clock
+    quantity).  ``overlap_flops`` is the per-chip compute statically
+    scheduled between the issue point and the first consumer;
+    ``pending_indep_flops`` the independent per-chip compute still
+    pending AFTER the first consumer (the GL008 smell)."""
+
+    primitive: str
+    axes: Tuple[str, ...]
+    axis_size: int
+    payload_bytes: int
+    wire_bytes: int
+    hops: int
+    mult: int
+    overlap_flops: int
+    pending_indep_flops: int
+    consumed_in_body: bool
+    out: str
+    provenance: str = ""
+
+    def comm_seconds(self, spec: Optional[HardwareSpec] = None) -> float:
+        """Estimated wire seconds of ONE execution."""
+        spec = spec or _DEFAULT_SPEC
+        return (self.wire_bytes / spec.ici_bw
+                + self.hops * spec.ici_latency)
+
+    def overlap_fraction(self, spec: Optional[HardwareSpec] = None) -> float:
+        """min(1, available independent compute time / comm time): 1.0
+        means the wire is fully hideable behind already-scheduled
+        compute, 0.0 means the program blocks for the full transfer."""
+        spec = spec or _DEFAULT_SPEC
+        t = self.comm_seconds(spec)
+        if t <= 0:
+            return 1.0
+        return min(1.0, (self.overlap_flops / spec.peak_flops) / t)
+
+    def render(self, spec: Optional[HardwareSpec] = None) -> str:
+        spec = spec or _DEFAULT_SPEC
+        mult = f" x{self.mult}" if self.mult != 1 else ""
+        where = f" @ {self.provenance}" if self.provenance else ""
+        return (f"{self.primitive}[{','.join(self.axes)}:{self.axis_size}]"
+                f"{mult} -> {self.out}: wire "
+                f"{self.wire_bytes / 2**20:.3f} MiB, est "
+                f"{self.comm_seconds(spec) * 1e3:.4f} ms, overlap "
+                f"{self.overlap_fraction(spec):.3f}" + where)
+
+
+def _collective_cost(eqn, eqns, i: int, axis_sizes: Dict[str, int],
+                     loop_mult: int) -> Optional["CollectiveCost"]:
+    """Build the CollectiveCost of ``eqns[i]`` (or None when its mesh
+    axes cannot be resolved from the enclosing shard_map context)."""
+    try:
+        prim = _norm_prim(eqn.primitive.name)
+        axes = collective_axis_names(eqn)
+        if not axes:
+            return None
+        n = 1
+        for a in axes:
+            s = axis_sizes.get(a)
+            if s is None:
+                return None
+            n *= int(s)
+        payload = sum(_nbytes(v) for v in eqn.invars)
+        out_b = sum(_nbytes(v) for v in eqn.outvars)
+        j = _first_consumer(eqns, i)
+        end = j if j is not None else len(eqns)
+        overlap = sum(_eqn_chip_flops(eqns[k]) for k in range(i + 1, end))
+        return CollectiveCost(
+            primitive=prim,
+            axes=axes,
+            axis_size=n,
+            payload_bytes=payload,
+            wire_bytes=collective_wire_bytes(prim, payload, out_b, n),
+            hops=collective_hops(prim, n),
+            mult=max(int(loop_mult), 1),
+            overlap_flops=int(overlap),
+            pending_indep_flops=_pending_indep_flops(eqns, i, j),
+            consumed_in_body=j is not None,
+            out="/".join(_fmt_aval(v) for v in eqn.outvars),
+            provenance=_provenance(eqn),
+        )
+    except Exception:  # noqa: BLE001 — cost model must never crash a walk
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -359,11 +624,13 @@ class CostReport:
     bound."""
 
     def __init__(self, program: str, eqns: List[EqnCost],
-                 boundary_bytes: int, has_unbounded_loops: bool = False):
+                 boundary_bytes: int, has_unbounded_loops: bool = False,
+                 collectives: Optional[List[CollectiveCost]] = None):
         self.program = program
         self.eqns = eqns
         self.boundary_bytes = int(boundary_bytes)
         self.has_unbounded_loops = has_unbounded_loops
+        self.collectives: List[CollectiveCost] = list(collectives or [])
         self.flops = sum(e.flops for e in eqns)
         self.bytes_upper = sum(e.bytes for e in eqns)
         self.padding_waste_bytes = sum(e.padding_waste_bytes for e in eqns)
@@ -407,10 +674,67 @@ class CostReport:
             return 0.0
         return (self.flops / measured_seconds) / attainable
 
+    # -- communication (the v3 comm model) --------------------------------
+    @property
+    def comm_bytes(self) -> int:
+        """Total per-link ICI wire bytes across every collective, already
+        x loop trips (never x shard count — all links run concurrently)."""
+        return sum(c.wire_bytes * c.mult for c in self.collectives)
+
+    def comm_bytes_by_axis(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for c in self.collectives:
+            key = ",".join(c.axes)
+            out[key] = out.get(key, 0) + c.wire_bytes * c.mult
+        return out
+
+    def comm_seconds(self, spec: Optional[HardwareSpec] = None) -> float:
+        """Modelled serialized ICI time: every collective's wire time +
+        per-hop latency, summed (worst case: nothing overlaps with other
+        collectives)."""
+        spec = spec or _DEFAULT_SPEC
+        return sum(c.comm_seconds(spec) * c.mult for c in self.collectives)
+
+    def comm_seconds_by_axis(self, spec: Optional[HardwareSpec] = None
+                             ) -> Dict[str, float]:
+        spec = spec or _DEFAULT_SPEC
+        out: Dict[str, float] = {}
+        for c in self.collectives:
+            key = ",".join(c.axes)
+            out[key] = out.get(key, 0.0) + c.comm_seconds(spec) * c.mult
+        return out
+
+    def overlap_fraction(self, spec: Optional[HardwareSpec] = None
+                         ) -> float:
+        """Comm-time-weighted fraction of modelled collective time that
+        independent compute between issue point and first consumer can
+        hide.  1.0 = every collective fully overlappable; 0.0 = every
+        result consumed immediately (fully serialized)."""
+        spec = spec or _DEFAULT_SPEC
+        total = 0.0
+        hidden = 0.0
+        for c in self.collectives:
+            t = c.comm_seconds(spec) * c.mult
+            total += t
+            hidden += min(t, (c.overlap_flops / max(spec.peak_flops, 1.0))
+                          * c.mult)
+        if total <= 0:
+            return 1.0
+        return hidden / total
+
+    def comm_roofline_fraction(self, spec: HardwareSpec,
+                               measured_seconds: float) -> float:
+        """Modelled ICI comm seconds / one measured execution — the comm
+        analogue of :meth:`roofline_fraction` (how much of the wall clock
+        the static comm model accounts for)."""
+        if measured_seconds <= 0:
+            return 0.0
+        return self.comm_seconds(spec) / measured_seconds
+
     # -- presentation ------------------------------------------------------
     def summary(self, spec: Optional[HardwareSpec] = None) -> Dict[str, Any]:
         spec = spec or _DEFAULT_SPEC
-        return {
+        out = {
             "program": self.program,
             "gflops": round(self.flops / 1e9, 3),
             "hbm_mib_upper": round(self.bytes_upper / 2**20, 2),
@@ -423,6 +747,13 @@ class CostReport:
             "chip": spec.name,
             "unbounded_loops": self.has_unbounded_loops,
         }
+        if self.collectives:
+            out["comm_mib"] = round(self.comm_bytes / 2**20, 3)
+            out["comm_seconds"] = self.comm_seconds(spec)
+            out["comm_seconds_by_axis"] = self.comm_seconds_by_axis(spec)
+            out["overlap_fraction"] = round(self.overlap_fraction(spec), 4)
+            out["collective_count"] = len(self.collectives)
+        return out
 
     def render(self, spec: Optional[HardwareSpec] = None,
                top: int = 5) -> str:
@@ -447,6 +778,19 @@ class CostReport:
         if heavy:
             lines.append("  heaviest by bytes:")
             lines += ["    " + e.render() for e in heavy if e.bytes]
+        if self.collectives:
+            by_axis = self.comm_seconds_by_axis(spec)
+            axis_txt = ", ".join(
+                f"{k or '?'}: {v * 1e6:.1f} us" for k, v in
+                sorted(by_axis.items()))
+            lines.append(
+                f"  comm: {self.comm_bytes / 2**20:.3f} MiB wire, "
+                f"{self.comm_seconds(spec) * 1e6:.1f} us ICI "
+                f"({axis_txt}), overlap fraction "
+                f"{self.overlap_fraction(spec):.2f}")
+            hot_c = sorted(self.collectives,
+                           key=lambda c: -(c.wire_bytes * c.mult))[:top]
+            lines += ["    " + c.render(spec) for c in hot_c]
         return "\n".join(lines)
 
     __str__ = render
@@ -466,6 +810,7 @@ def _branch_jaxprs(params: Dict[str, Any]):
 class _Acc:
     def __init__(self):
         self.eqns: List[EqnCost] = []
+        self.collectives: List[CollectiveCost] = []
         self.unbounded = False
 
 
@@ -496,10 +841,19 @@ def _eqn_bytes(eqn) -> int:
             + sum(_nbytes(v) for v in eqn.outvars))
 
 
-def _cost_walk(jaxpr, acc: _Acc, mult: int, depth: int = 0):
+def _cost_walk(jaxpr, acc: _Acc, mult: int, depth: int = 0,
+               axis_sizes: Optional[Dict[str, int]] = None,
+               loop_mult: int = 1):
+    """``mult`` keeps flops/bytes in GLOBAL units (loop trips x shard
+    count); ``loop_mult`` is the trips-only multiplier collectives use
+    (per-link wire time is concurrent across shards, never x shards).
+    ``axis_sizes`` carries the enclosing shard_map mesh's axis sizes so
+    collective eqns can resolve their axis names."""
     if depth > 32:  # defensive: malformed/cyclic params
         return
-    for eqn in jaxpr.eqns:
+    axis_sizes = axis_sizes or {}
+    eqns = list(jaxpr.eqns)
+    for i, eqn in enumerate(eqns):
         prim = eqn.primitive.name
         subs = list(_sub_jaxprs(eqn.params))
         if subs:
@@ -508,34 +862,44 @@ def _cost_walk(jaxpr, acc: _Acc, mult: int, depth: int = 0):
             if prim == "scan":
                 length = int(eqn.params.get("length", 1) or 1)
                 for sub in subs:
-                    _cost_walk(sub, acc, mult * max(length, 1), depth + 1)
+                    _cost_walk(sub, acc, mult * max(length, 1), depth + 1,
+                               axis_sizes, loop_mult * max(length, 1))
             elif prim == "shard_map":
                 # per-shard body shapes x shard count = global totals
                 shards = _shard_count(eqn)
+                child_axes = dict(axis_sizes)
+                child_axes.update(mesh_axis_sizes(eqn.params.get("mesh")))
                 for sub in subs:
-                    _cost_walk(sub, acc, mult * shards, depth + 1)
+                    _cost_walk(sub, acc, mult * shards, depth + 1,
+                               child_axes, loop_mult)
             elif prim == "while":
                 acc.unbounded = True
                 for sub in subs:
-                    _cost_walk(sub, acc, mult, depth + 1)
+                    _cost_walk(sub, acc, mult, depth + 1, axis_sizes,
+                               loop_mult)
             elif prim == "cond":
                 # worst case: the most FLOP-expensive branch
-                best: Optional[List[EqnCost]] = None
-                best_unbounded = False
+                best: Optional[_Acc] = None
                 for sub in _branch_jaxprs(eqn.params) or subs:
                     probe = _Acc()
-                    _cost_walk(sub, probe, mult, depth + 1)
+                    _cost_walk(sub, probe, mult, depth + 1, axis_sizes,
+                               loop_mult)
                     if best is None or (sum(e.flops for e in probe.eqns)
-                                        > sum(e.flops for e in best)):
-                        best = probe.eqns
-                        best_unbounded = probe.unbounded
-                if best:
-                    acc.eqns.extend(best)
-                acc.unbounded = acc.unbounded or best_unbounded
+                                        > sum(e.flops for e in best.eqns)):
+                        best = probe
+                if best is not None:
+                    acc.eqns.extend(best.eqns)
+                    acc.collectives.extend(best.collectives)
+                    acc.unbounded = acc.unbounded or best.unbounded
             else:
                 for sub in subs:
-                    _cost_walk(sub, acc, mult, depth + 1)
+                    _cost_walk(sub, acc, mult, depth + 1, axis_sizes,
+                               loop_mult)
             continue
+        if prim in COLLECTIVE_PRIMS:
+            cc = _collective_cost(eqn, eqns, i, axis_sizes, loop_mult)
+            if cc is not None:
+                acc.collectives.append(cc)
         flops = eqn_flops(eqn)
         nbytes = _eqn_bytes(eqn)
         waste = _eqn_padding_waste(eqn)
@@ -564,7 +928,8 @@ def cost_jaxpr(closed, program: str = "<program>") -> CostReport:
     boundary = (sum(_nbytes(v) for v in jaxpr.invars)
                 + sum(_nbytes(v) for v in jaxpr.outvars))
     return CostReport(program, acc.eqns, boundary,
-                      has_unbounded_loops=acc.unbounded)
+                      has_unbounded_loops=acc.unbounded,
+                      collectives=acc.collectives)
 
 
 def cost(fn, *args, static_argnums=(), program: Optional[str] = None,

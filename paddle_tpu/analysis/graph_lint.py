@@ -159,9 +159,19 @@ class LintConfig:
     churn_static_entries: int = 8     # compiled entries per to_static fn
     churn_max_prefill_traces: int = 16
     churn_max_decode_traces: int = 6  # scout+lint+jit per compile =~ 3
+    # GL008: flag a collective whose result is consumed while at least
+    # this many per-chip FLOPs of INDEPENDENT work are still pending
+    # (~50 us of a v5e-class chip — the serialized grad-reduction smell)
+    gl008_min_pending_flops: int = 10_000_000
+    # GL009: per-chip replicated bytes worth a ZeRO-style shard
+    gl009_min_bytes: int = 1 << 20
+    # GL011: degenerate collectives below this payload are ignored (the
+    # `psum(1, axis)` axis-size idiom is intentional dispatch)
+    gl011_min_bytes: int = 1 << 10
     # which jaxpr passes run (GL007 is invoked separately)
     passes: Tuple[str, ...] = ("GL001", "GL002", "GL003", "GL004",
-                               "GL005", "GL006")
+                               "GL005", "GL006", "GL008", "GL009",
+                               "GL010", "GL011")
 
 
 class LintReport:
@@ -316,6 +326,142 @@ def _gl002_cost(eqn, v) -> str:
         return ""
 
 
+def _gl009_pass(eqn, ctx: "_Ctx", prov: str):
+    """GL009 replication-blowup, evaluated AT a shard_map eqn: any large
+    input whose in_names entry omits a manual mesh axis (size > 1) is
+    materialized once per chip along that axis — the optimizer-moment /
+    master-weight hazard ROADMAP item 1's ZeRO shard reclaims.  Shapes
+    here are GLOBAL (the shard_map boundary), so per-chip bytes divide by
+    the axes the input IS sharded over."""
+    from .cost_model import mesh_axis_sizes  # lazy: it imports this module
+
+    cfg = ctx.config
+    try:
+        mesh_axes = mesh_axis_sizes(eqn.params.get("mesh"))
+        if not mesh_axes:
+            return
+        auto = eqn.params.get("auto") or frozenset()
+        manual = {a: s for a, s in mesh_axes.items()
+                  if a not in auto and int(s) > 1}
+        if not manual:
+            return
+        in_names = eqn.params.get("in_names") or ()
+    except Exception:  # noqa: BLE001 — lint must never crash on odd params
+        return
+    for opi, (v, names) in enumerate(zip(eqn.invars, in_names)):
+        try:
+            used: Set[str] = set()
+            for axes in dict(names).values():
+                axes = (axes,) if isinstance(axes, str) else axes
+                used.update(str(a) for a in axes)
+            missing = sorted(a for a in manual if a not in used)
+            if not missing:
+                continue
+            shard = 1
+            for a in used:
+                shard *= int(mesh_axes.get(a, 1))
+            per_chip = _nbytes(v) // max(shard, 1)
+            if per_chip < cfg.gl009_min_bytes:
+                continue
+            repl = 1
+            for a in missing:
+                repl *= int(manual[a])
+            reclaim = per_chip * (1 - 1 / repl)
+            ctx.add(
+                "GL009",
+                f"shard_map input {opi} ({_fmt_aval(v)}, "
+                f"{per_chip / 2**20:.1f} MiB/chip) is replicated over mesh "
+                f"axis(es) {','.join(missing)} (x{repl}) instead of "
+                "sharded — optimizer moments / master weights belong in a "
+                "ZeRO-style shard over the data axis",
+                detail=f"shard_map:invar[{opi}]:{_fmt_aval(v)}:replicated:"
+                       f"{','.join(missing)}",
+                primitive="shard_map", provenance=prov,
+                cost=f"~{reclaim / 2**20:.1f} MiB/chip HBM reclaimable by "
+                     f"sharding over {','.join(missing)}")
+        except Exception:  # noqa: BLE001
+            continue
+
+
+def _collective_pass(eqn, eqns, i: int, ctx: "_Ctx",
+                     axis_sizes: Dict[str, int], prov: str):
+    """GL008/GL010/GL011 at one collective eqn (shapes here are
+    PER-SHARD: we are inside the shard_map body)."""
+    from . import cost_model as _cm  # lazy: it imports this module
+
+    cfg = ctx.config
+    cc = _cm._collective_cost(eqn, eqns, i, axis_sizes, 1)
+    if cc is None:
+        return
+    spec = _cm._DEFAULT_SPEC
+    fmt_axes = ",".join(cc.axes)
+
+    if "GL011" in cfg.passes and cc.axis_size <= 1:
+        if cc.payload_bytes >= cfg.gl011_min_bytes:
+            ctx.add(
+                "GL011",
+                f"'{cc.primitive}' over size-1 axis '{fmt_axes}' moves "
+                f"{cc.payload_bytes / 2**10:.1f} KiB through a degenerate "
+                "collective — pure dispatch overhead; gate it on the axis "
+                "size or drop the collective",
+                detail=f"{cc.primitive}:axis[{fmt_axes}]=1:{cc.out}",
+                primitive=cc.primitive, provenance=prov)
+        return  # n == 1: no wire, nothing below applies
+
+    if ("GL008" in cfg.passes and cc.consumed_in_body
+            and cc.pending_indep_flops >= cfg.gl008_min_pending_flops):
+        ctx.add(
+            "GL008",
+            f"'{cc.primitive}' over '{fmt_axes}' is consumed with "
+            f"~{cc.pending_indep_flops / 1e6:.0f} MFLOP of independent "
+            "work still pending — the program serializes on the wire; "
+            "reorder the consumer after the independent compute (bucketed "
+            "async reduction)",
+            detail=f"{cc.primitive}:{fmt_axes}:{cc.out}",
+            primitive=cc.primitive, provenance=prov,
+            cost=f"~{cc.comm_seconds(spec) * 1e6:.1f} us ICI blocking, "
+                 f"overlap fraction {cc.overlap_fraction(spec):.2f} "
+                 f"(chip={spec.name})")
+
+    if "GL010" in cfg.passes and cc.payload_bytes >= cfg.tile_min_bytes:
+        wire_factor = cc.wire_bytes / max(cc.payload_bytes, 1)
+        for opi, v in enumerate(eqn.invars):
+            nbytes = _nbytes(v)
+            if nbytes < cfg.tile_min_bytes:
+                continue
+            problems = []
+            pad_bytes = 0
+            try:
+                elems = int(np.prod(_shape_of(v), dtype=np.int64))
+                itemsize = nbytes // max(elems, 1)
+            except Exception:  # noqa: BLE001
+                continue
+            n = cc.axis_size
+            # ppermute ships the whole payload one hop — no ring chunking
+            if cc.primitive != "ppermute" and elems % n:
+                chunk_pad = (-(-elems // n) * n - elems) * itemsize
+                pad_bytes += chunk_pad
+                problems.append(
+                    f"{elems} elems % axis size {n} != 0 (ring chunks pad)")
+            bad = misaligned_dims(_shape_of(v))
+            if bad:
+                tile_pad = padding_waste_elems(_shape_of(v)) * itemsize
+                pad_bytes += tile_pad
+                problems.append(", ".join(
+                    f"dim[{ax}]={d} % {tile} != 0" for ax, d, tile in bad))
+            if not problems:
+                continue
+            ctx.add(
+                "GL010",
+                f"'{cc.primitive}' over '{fmt_axes}' payload "
+                f"({_fmt_aval(v)}) is misaligned: {'; '.join(problems)} — "
+                "padded bytes ride the wire every execution",
+                detail=f"{cc.primitive}:operand{opi}:{_fmt_aval(v)}",
+                primitive=cc.primitive, provenance=prov,
+                cost=f"~{pad_bytes * wire_factor / 2**10:.1f} KiB padded "
+                     "ICI wire bytes per execution")
+
+
 # ---------------------------------------------------------------------------
 # the jaxpr passes
 # ---------------------------------------------------------------------------
@@ -338,10 +484,13 @@ class _Ctx:
         self.findings.append(f)
 
 
-def _walk(jaxpr: "_jcore.Jaxpr", ctx: _Ctx, depth: int = 0):
+def _walk(jaxpr: "_jcore.Jaxpr", ctx: _Ctx, depth: int = 0,
+          axis_sizes: Optional[Dict[str, int]] = None):
     cfg = ctx.config
     if depth > 32:  # defensive: malformed/cyclic params
         return
+    axis_sizes = axis_sizes or {}
+    eqns = list(jaxpr.eqns)
 
     # var -> (origin dtype name, provenance of the upcast) for values that
     # were promoted sub-fp32 -> fp32 inside THIS jaxpr (GL001)
@@ -491,8 +640,25 @@ def _walk(jaxpr: "_jcore.Jaxpr", ctx: _Ctx, depth: int = 0):
                          "traffic and residency per execution if it fails "
                          "to fuse")
 
+        # v3 SPMD passes: GL009 at the shard_map boundary, GL008/GL010/
+        # GL011 at the collective eqns inside its body
+        child_axes = axis_sizes
+        if prim == "shard_map" or "mesh" in eqn.params:
+            from .cost_model import mesh_axis_sizes  # lazy (circular)
+
+            child_axes = dict(axis_sizes)
+            child_axes.update(mesh_axis_sizes(eqn.params.get("mesh")))
+            if "GL009" in cfg.passes:
+                _gl009_pass(eqn, ctx, prov)
+        else:
+            from .cost_model import COLLECTIVE_PRIMS  # lazy (circular)
+
+            if prim in COLLECTIVE_PRIMS and (
+                    {"GL008", "GL010", "GL011"} & set(cfg.passes)):
+                _collective_pass(eqn, eqns, i, ctx, axis_sizes, prov)
+
         for sub in _sub_jaxprs(eqn.params):
-            _walk(sub, ctx, depth + 1)
+            _walk(sub, ctx, depth + 1, child_axes)
 
 
 def _donation_pass(jaxpr: "_jcore.Jaxpr", donated: Set[int], ctx: _Ctx):

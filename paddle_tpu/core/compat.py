@@ -9,11 +9,20 @@ complement (the axes left to GSPMD) and whose replication check is called
 """
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional
 
 import jax
 
-__all__ = ["shard_map", "pcast", "axis_size"]
+__all__ = ["shard_map", "pcast", "axis_size", "axis_sizes",
+           "ShardMapCompatError"]
+
+
+class ShardMapCompatError(NotImplementedError):
+    """A collective/construct the old-API fully-manual shard_map path
+    cannot lower.  Typed (instead of a bare NotImplementedError leaking
+    out of jax internals) so callers can catch the COMPAT failure —
+    'this jax version's shard_map cannot express that' — distinctly from
+    a genuine missing feature."""
 
 
 def axis_size(axis_name):
@@ -23,6 +32,28 @@ def axis_size(axis_name):
     if fn is not None:
         return fn(axis_name)
     return jax.lax.psum(1, axis_name)
+
+
+def axis_sizes(mesh) -> Dict[str, int]:
+    """{axis name: size} of a ``Mesh``/``AbstractMesh`` — the one mesh
+    introspection the static analyzer (``analysis/cost_model.py``'s
+    collective model, Graph Lint GL009) needs, tolerant of the
+    ``mesh.shape`` dict vs ``axis_names``/``axis_sizes`` tuple layouts
+    across jax releases.  Unreadable meshes yield {} (analysis degrades,
+    never crashes)."""
+    if mesh is None:
+        return {}
+    try:
+        shape = getattr(mesh, "shape", None)
+        if shape is not None:
+            return {str(k): int(v) for k, v in dict(shape).items()}
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        return {str(n): int(s) for n, s in zip(mesh.axis_names,
+                                               mesh.axis_sizes)}
+    except Exception:  # noqa: BLE001
+        return {}
 
 
 def pcast(x, axis_names, to="varying"):
@@ -66,5 +97,23 @@ def shard_map(f, mesh, in_specs, out_specs, axis_names=None,
     # that do not mention them), so results are identical; the only loss
     # is GSPMD auto-partitioning of the body math over those axes.
     check_rep = False if (check_vma is False or partial_manual) else True
-    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                      check_rep=check_rep, auto=frozenset())
+    mapped = _shard_map(f, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=check_rep,
+                        auto=frozenset())
+
+    def _wrapped(*args, **kwargs):
+        try:
+            return mapped(*args, **kwargs)
+        except NotImplementedError as e:
+            # the experimental fully-manual path has no impl/lowering for
+            # some collectives — surface WHAT failed and WHY instead of a
+            # bare NotImplementedError from deep inside jax
+            raise ShardMapCompatError(
+                "this jax version's experimental shard_map (fully-manual "
+                "fallback, auto=frozenset()) cannot lower a collective "
+                f"used by {getattr(f, '__name__', '<fn>')!r}: {e}. "
+                "Upgrade to a jax with `jax.shard_map`, or rewrite the "
+                "body without the unsupported collective.") from e
+
+    _wrapped.__name__ = getattr(f, "__name__", "shard_map_fn")
+    return _wrapped

@@ -206,11 +206,16 @@ def pipeline_blocks(block_fn: Callable, stacked: Sequence, x_micro, *,
         # zeros are pp-invariant; the scan carry becomes pp-varying (each
         # stage computes different activations), so pcast the initial carry
         varying = lambda z: _compat.pcast(z, (pp_axis,), to="varying")  # noqa: E731
-        state = varying(jnp.zeros_like(x_local[0]))
+        # zeros from shape, not zeros_like(x_local[0]): indexing would
+        # trace a dead slice+squeeze of the input (GL005)
+        state = varying(jnp.zeros(x_local.shape[1:], x_local.dtype))
         outputs = varying(jnp.zeros_like(x_local))
         # phase-wrap buffer (interleave only): device 0 parks activations
         # returning from the last device until their next trip starts
-        inbuf = varying(jnp.zeros_like(x_local)) if V > 1 else jnp.zeros(())
+        # dtype pinned: bare zeros(()) is f64 under x64 mode and would ride
+        # the whole tick-scan carry (GL001 x64-leak)
+        inbuf = (varying(jnp.zeros_like(x_local)) if V > 1
+                 else jnp.zeros((), x_local.dtype))
 
         total_ticks = V * n_micro + n_stages - 1
 

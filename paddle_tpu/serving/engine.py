@@ -543,6 +543,13 @@ class ServingEngine:
             from ..quantization.int8 import quantize_for_serving
 
             quantize_for_serving(model)
+        # quantized engines get a distinct program name ("fused_step_int8")
+        # so the graph-lint / cost registries (tools/graph_lint.py serve
+        # target) report the int8 dequant-epilogue program separately from
+        # the fp32/bf16 one instead of collapsing both under "fused_step"
+        self._program_tag = ("_int8" if (str(cache_dtype) == "int8"
+                                         or weight_dtype is not None)
+                             else "")
         # multi-tenant LoRA (serving/lora.py): per-request adapter-page
         # ids ride the packed step input; the pool's slab Tensors are
         # captured step state (register/evict never retrace)
@@ -877,6 +884,7 @@ class ServingEngine:
                         tok = ops.argmax(rows, axis=-1)
                 return tok, fin
 
+            fused_step.__name__ = "fused_step" + self._program_tag
             return fused_step
 
         self._fused_greedy = to_static(_mk_fused(False))

@@ -5,8 +5,12 @@
 # compiles).  Pass --all to run everything (CI budget), or any pytest args.
 #
 # A graph-lint gate runs first (tools/graph_lint.py --baseline on CPU —
-# the bench-model programs must not grow NEW findings; see
-# docs/graph_lint.md).  PADDLE_TPU_SKIP_LINT_GATE=1 skips it.
+# the bench-model programs must not grow NEW findings; the explicit
+# --targets list includes the v3 `mesh` target, so the SPMD comm passes
+# (GL008-GL011: unoverlapped collectives, replication blowup, payload
+# misalignment, degenerate collectives) gate every run too; see
+# docs/graph_lint.md "v3").  PADDLE_TPU_SKIP_LINT_GATE=1 skips it.
+# Exit codes are unchanged: 0 clean/baselined, 1 new findings, 2 error.
 #
 # A checkpoint crash-injection gate runs next (tools/crash_gate.py —
 # a writer killed at any pipeline stage must never corrupt latest(); see
@@ -71,7 +75,8 @@ unset JAX_COMPILATION_CACHE_DIR
 
 if [ -z "$PADDLE_TPU_SKIP_LINT_GATE" ]; then
     echo "run_tests: graph-lint gate (tools/graph_lint.py --baseline)"
-    python "$(dirname "$0")/tools/graph_lint.py" --baseline || {
+    python "$(dirname "$0")/tools/graph_lint.py" --baseline \
+        --targets train,decode,serve,mesh,churn || {
         rc=$?
         echo "run_tests: graph-lint gate FAILED (rc=$rc)"
         exit $rc

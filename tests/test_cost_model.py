@@ -625,3 +625,146 @@ def test_decode_step_kv_bytes_int8_at_most_half_fp32():
     assert cm.decode_step_kv_bytes(500, 16, 128, 128, num_layers=24,
                                    dtype="int8") \
         == 2 * 24 * 500 * 16 * 128 + 2 * 24 * 4 * 16 * 4
+
+
+# ---------------------------------------------------------------------------
+# v3: golden collective comm costs (bytes exact to the ring formulas,
+# seconds exact to wire/ici_bw + hops * ici_latency)
+# ---------------------------------------------------------------------------
+
+_ICI = cm.HardwareSpec("golden", peak_flops=1e12, hbm_bw=1e12,
+                       ici_bw=1e9, ici_latency=1e-6)
+
+
+def _axis_mesh(n, name="dp"):
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices, host has {len(devs)}")
+    return Mesh(np.asarray(devs[:n]), (name,))
+
+
+def _comm_rep(body, mesh, in_specs, out_specs, *args):
+    from paddle_tpu.core import compat as compat_mod
+
+    fn = compat_mod.shard_map(body, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False)
+    return analysis.cost(fn, *args)
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_psum_golden_bytes_and_seconds(n):
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _axis_mesh(n)
+
+    def body(x):
+        return jax.lax.psum(x, "dp")
+
+    # local payload: f32[1024] = 4096 B per chip
+    rep = _comm_rep(body, mesh, (P("dp"),), P(),
+                    _s((1024 * n,), jnp.float32))
+    assert len(rep.collectives) == 1, rep.render()
+    cc = rep.collectives[0]
+    payload = 1024 * 4
+    assert cc.payload_bytes == payload
+    # ring all-reduce: 2(n-1)/n x payload per link, 2(n-1) hops
+    assert cc.wire_bytes == 2 * (n - 1) * payload // n
+    assert cc.hops == 2 * (n - 1)
+    assert rep.comm_bytes == cc.wire_bytes
+    expect_s = cc.wire_bytes / _ICI.ici_bw + cc.hops * _ICI.ici_latency
+    assert rep.comm_seconds(_ICI) == pytest.approx(expect_s)
+    assert rep.comm_seconds_by_axis(_ICI) == {
+        "dp": pytest.approx(expect_s)}
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_all_gather_golden_bytes(n):
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _axis_mesh(n)
+
+    def body(x):
+        return jax.lax.all_gather(x, "dp")
+
+    rep = _comm_rep(body, mesh, (P("dp"),), P(None, "dp"),
+                    _s((1024 * n,), jnp.float32))
+    assert len(rep.collectives) == 1, rep.render()
+    cc = rep.collectives[0]
+    # each link carries (n-1)/n of the GATHERED bytes (n x 4096)
+    out_bytes = n * 1024 * 4
+    assert cc.wire_bytes == (n - 1) * out_bytes // n
+    assert cc.hops == n - 1
+
+
+@pytest.mark.parametrize("n", [2, 4])
+def test_reduce_scatter_golden_bytes(n):
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _axis_mesh(n)
+
+    def body(x):
+        return jax.lax.psum_scatter(x, "dp", tiled=True)
+
+    rep = _comm_rep(body, mesh, (P(),), P("dp"),
+                    _s((1024 * n,), jnp.float32))
+    assert len(rep.collectives) == 1, rep.render()
+    cc = rep.collectives[0]
+    # input payload (replicated local view): n x 1024 f32
+    payload = n * 1024 * 4
+    assert cc.payload_bytes == payload
+    assert cc.wire_bytes == (n - 1) * payload // n
+    assert cc.hops == n - 1
+
+
+def test_scan_multiplies_comm_bytes():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _axis_mesh(2)
+    trips = 3
+
+    def body(x):
+        def tick(c, _):
+            return jax.lax.psum(c, "dp"), None
+
+        out, _ = jax.lax.scan(tick, x, None, length=trips)
+        return out
+
+    rep = _comm_rep(body, mesh, (P("dp"),), P(),
+                    _s((2048,), jnp.float32))
+    assert len(rep.collectives) == 1, rep.render()
+    cc = rep.collectives[0]
+    assert cc.mult == trips
+    one = 2 * (2 - 1) * (1024 * 4) // 2
+    assert cc.wire_bytes == one          # per execution
+    assert rep.comm_bytes == trips * one  # x scan trips
+
+
+def test_overlap_fraction_golden():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _axis_mesh(2)
+
+    def body(x, w):
+        g = jax.lax.psum(x, "dp")
+        h = x @ w                 # independent: scheduled behind the wire
+        return g.sum() + h.sum()
+
+    rep = _comm_rep(body, mesh, (P("dp", None), P()), P(),
+                    _s((8, 256), jnp.float32), _s((256, 256), jnp.float32))
+    assert len(rep.collectives) == 1, rep.render()
+    cc = rep.collectives[0]
+    # the dot between issue and first consumer is the hideable compute
+    assert cc.overlap_flops == 2 * 4 * 256 * 256
+    t = cc.comm_seconds(_ICI)
+    expect = min(1.0, (cc.overlap_flops / _ICI.peak_flops) / t)
+    assert 0.0 < expect < 1.0    # the spec keeps the golden case interior
+    assert rep.overlap_fraction(_ICI) == pytest.approx(expect)
+
+
+def test_no_collectives_overlap_is_one():
+    rep = analysis.cost(lambda x: x * 2, _s((64,), jnp.float32))
+    assert rep.collectives == []
+    assert rep.comm_bytes == 0
+    assert rep.overlap_fraction(_ICI) == 1.0

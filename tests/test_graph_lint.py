@@ -442,3 +442,199 @@ def test_bf16_decode_program_has_no_promoted_dots():
     finally:
         pt.set_flags({"FLAGS_graph_lint": False})
         analysis.clear_reports()
+
+
+# ---------------------------------------------------------------------------
+# v3 comm passes: GL008-GL011 (one positive + one negative each)
+# ---------------------------------------------------------------------------
+
+def _axis_mesh(n, name="dp"):
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} devices, host has {len(devs)}")
+    return Mesh(np.asarray(devs[:n]), (name,))
+
+
+def _shmap(body, mesh, in_specs, out_specs):
+    from paddle_tpu.core import compat as _compat
+
+    # check_vma off: the toy bodies reduce dp-varying values locally on
+    # purpose (the lint passes care about the collectives, not the rep
+    # typing), and the plain-psum binding keeps the test jax-version-stable
+    return _compat.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+
+
+def test_gl008_unoverlapped_collective_flagged():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _axis_mesh(2)
+
+    def body(x, w):
+        g = jax.lax.psum(x, "dp")
+        r = g.sum()        # blocks on the wire immediately...
+        h = x @ w          # ...with this independent dot still pending
+        return r + h.sum()
+
+    fn = _shmap(body, mesh, (P("dp", None), P()), P())
+    cfg = LintConfig(gl008_min_pending_flops=1000)
+    rep = analysis.lint(fn, _s((8, 64)), _s((64, 64)), config=cfg)
+    gl8 = [f for f in rep.findings if f.code == "GL008"]
+    assert gl8, rep.render()
+    assert "psum" in gl8[0].detail
+
+
+def test_gl008_overlapped_collective_clean():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _axis_mesh(2)
+
+    def body(x, w):
+        g = jax.lax.psum(x, "dp")
+        h = x @ w          # independent dot between issue and consumer:
+        return g.sum() + h.sum()  # the wire hides behind it (overlap)
+
+    fn = _shmap(body, mesh, (P("dp", None), P()), P())
+    cfg = LintConfig(gl008_min_pending_flops=1000)
+    rep = analysis.lint(fn, _s((8, 64)), _s((64, 64)), config=cfg)
+    assert "GL008" not in _codes(rep), rep.render()
+
+
+def test_gl009_replicated_state_flagged_sharded_clean():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _axis_mesh(2)
+
+    def body(x, m):
+        return (x * 2).sum() + m.sum()
+
+    cfg = LintConfig(gl009_min_bytes=1024)
+    # m replicated over the manual dp axis -> fires, quoting the shard win
+    rep = analysis.lint(_shmap(body, mesh, (P("dp", None), P()), P()),
+                        _s((8, 64)), _s((64, 64)), config=cfg)
+    gl9 = [f for f in rep.findings if f.code == "GL009"]
+    assert gl9, rep.render()
+    assert "dp" in gl9[0].detail and "invar[1]" in gl9[0].detail
+    assert gl9[0].cost and "reclaimable" in gl9[0].cost
+    # x sharded over dp never fires; sharding m silences the pass
+    rep2 = analysis.lint(
+        _shmap(body, mesh, (P("dp", None), P("dp", None)), P()),
+        _s((8, 64)), _s((64, 64)), config=cfg)
+    assert "GL009" not in _codes(rep2), rep2.render()
+
+
+def test_gl010_misaligned_collective_payload_flagged():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _axis_mesh(2)
+
+    def body(x):
+        return jax.lax.psum(x, "dp")
+
+    cfg = LintConfig(tile_min_bytes=64)
+    # 3x129 f32: 387 elems don't split into 2 ring chunks AND the
+    # trailing dim breaks (8, 128) tiling
+    rep = analysis.lint(_shmap(body, mesh, (P(),), P()),
+                        _s((3, 129)), config=cfg)
+    gl10 = [f for f in rep.findings if f.code == "GL010"]
+    assert gl10, rep.render()
+    assert "psum" in gl10[0].detail
+    # aligned payload (8x128, evenly chunked): clean
+    rep2 = analysis.lint(_shmap(body, mesh, (P(),), P()),
+                         _s((8, 128)), config=cfg)
+    assert "GL010" not in [f.code for f in rep2.findings], rep2.render()
+
+
+def test_gl011_degenerate_axis_flagged_real_axis_clean():
+    from jax.sharding import PartitionSpec as P
+
+    mesh1 = _axis_mesh(1, "one")
+
+    def body(x):
+        return jax.lax.psum(x, "one")
+
+    rep = analysis.lint(_shmap(body, mesh1, (P(),), P()), _s((512,)))
+    gl11 = [f for f in rep.findings if f.code == "GL011"]
+    assert gl11, rep.render()
+    assert gl11[0].severity == "info"
+
+    mesh2 = _axis_mesh(2)
+
+    def body2(x):
+        return jax.lax.psum(x, "dp")
+
+    rep2 = analysis.lint(_shmap(body2, mesh2, (P(),), P()), _s((512,)))
+    assert "GL011" not in _codes(rep2), rep2.render()
+
+
+def test_gl009_baseline_round_trip():
+    """A GL009 finding suppresses through the fingerprint machinery like
+    any v1 code: same program -> filtered; reshaped state -> NEW."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _axis_mesh(2)
+
+    def body(x, m):
+        return (x * 2).sum() + m.sum()
+
+    cfg = LintConfig(gl009_min_bytes=1024)
+    fn = _shmap(body, mesh, (P("dp", None), P()), P())
+    rep = analysis.lint(fn, _s((8, 64)), _s((64, 64)), config=cfg,
+                        program="rt")
+    gl9 = [f for f in rep.findings if f.code == "GL009"]
+    assert gl9
+    base = Baseline()
+    for f in gl9:
+        base.add(f, "round-trip")
+    assert base.filter_new(gl9) == []
+    rep2 = analysis.lint(fn, _s((8, 128)), _s((128, 128)), config=cfg,
+                         program="rt")
+    new = [f for f in base.filter_new(rep2.findings) if f.code == "GL009"]
+    assert new, "reshaped replicated state must be a NEW finding"
+
+
+def test_cli_inject_gl009_trips_and_baselines(tmp_path, capsys):
+    cli = _cli()
+    assert cli.run(["--targets", "none", "--inject", "gl009"]) == 1
+    out = capsys.readouterr().out
+    assert "GL009" in out and "inject:gl009" in out
+    base = str(tmp_path / "b9.json")
+    assert cli.run(["--targets", "none", "--inject", "gl009",
+                    "--write-baseline", base]) == 0
+    assert cli.run(["--targets", "none", "--inject", "gl009",
+                    "--baseline", base]) == 0
+
+
+def test_int8_fused_step_program_gl001_clean():
+    """Regression pin for the int8 serving variant: the quantized hot
+    path lints under its own program name (fused_step_int8 — explicit
+    dequant + per-row requant must never read as a silent promotion)."""
+    from paddle_tpu.models import GPTStackedForPretraining, gpt_tiny
+    from paddle_tpu.serving import ServingEngine
+
+    analysis.clear_reports()
+    pt.set_flags({"FLAGS_graph_lint": True})
+    try:
+        pt.seed(0)
+        cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+        m = GPTStackedForPretraining(cfg)
+        pt.amp.decorate(m, level="O2", dtype="bfloat16")
+        m.eval()
+        eng = ServingEngine(m, num_slots=2, page_size=16, max_context=32,
+                            kv_dtype="int8", weight_dtype="int8")
+        try:
+            eng.submit(np.arange(5, dtype=np.int64) % cfg.vocab_size, 3)
+            eng.run_until_idle()
+            reps = [r for r in eng.lint_reports()
+                    if r.program == "fused_step_int8"]
+            assert reps, "int8 engine did not lint under fused_step_int8"
+            bad = [f for r in reps for f in r.findings
+                   if f.code == "GL001"]
+            assert bad == [], "\n".join(f.render() for f in bad)
+        finally:
+            eng.close()
+    finally:
+        pt.set_flags({"FLAGS_graph_lint": False})
+        analysis.clear_reports()

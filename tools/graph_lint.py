@@ -7,6 +7,12 @@ GPT) and lints every compiled program:
 
 - ``train``:  the fused fwd+bwd+AdamW train step (jit.to_static)
 - ``decode``: the decode engine's prefill + decode programs (generate())
+- ``serve``:  the paged fused serving steps (fp32/bf16, int8, spec+LoRA,
+  mesh-sharded)
+- ``mesh``:   SPMD programs with jaxpr-visible collectives under a real
+  dp x mp device mesh (``--mesh-shape``): a Megatron-style fused train
+  step, ring attention, the pipeline schedule, and the sharded serving
+  engine — the GL008-GL011 / comm-cost-model targets (Graph Lint v3)
 - ``churn``:  the GL007 runtime pass over dispatch/op-cache/trace counters
 
 Findings are compared against a committed baseline-suppression file
@@ -219,6 +225,172 @@ def _lint_serve(pt, np):
             eng.close()
 
 
+# the dp x mp fused-train-step stand-in (mesh target): Megatron column/
+# row-parallel 2-matmul MLP with a hand-rolled backward, grad psums over
+# 'dp', and an AdamW update on fp32 masters+moments that are REPLICATED
+# over 'dp' (the exact ZeRO hazard GL009 quantifies — ROADMAP item 1).
+# H is sized so the bf16 weights stay under the GL009 floor (the standard
+# DP regime) while the fp32 optimizer state lands above it.
+_MESH_B, _MESH_H, _MESH_F = 8, 384, 2048
+
+
+def _mesh_train_step_fn(jax, jnp):
+    def mesh_train_step(x, w1, w2, m1, v1, mw1, m2, v2, mw2):
+        # forward: column-parallel w1, row-parallel w2 (psum over 'mp')
+        h = jnp.maximum(x @ w1, 0)
+        y = jax.lax.psum(h @ w2, "mp")
+        yf = y.astype(jnp.float32)
+        # hand-rolled backward (shape-correct; values are irrelevant to a
+        # static lint — what matters is the graph: two big grads, two
+        # all-reduces, an update chain).  Dots stay on the bf16 MXU path
+        # with fp32 grads cast AFTER the contraction (GL001 discipline).
+        gy = (yf * (2.0 / yf.size)).astype(jnp.bfloat16)
+        g2 = (h.T @ gy).astype(jnp.float32)
+        gh = ((gy @ w2.T).astype(jnp.float32)
+              * (h > 0)).astype(jnp.bfloat16)
+        g1 = (x.T @ gh).astype(jnp.float32)
+        # grad all-reduce over 'dp' — the bucketed-async candidate.  w2's
+        # whole update sits between psum(g1) and g1's first consumer, so
+        # the overlap fraction of the g1 reduction is statically nonzero.
+        g1r = jax.lax.psum(g1, "dp")
+        g2r = jax.lax.psum(g2, "dp")
+        b1, b2, lr, eps = 0.9, 0.999, 1e-4, 1e-8
+        m2n = b1 * m2 + (1 - b1) * g2r
+        v2n = b2 * v2 + (1 - b2) * g2r * g2r
+        mw2n = mw2 - lr * m2n / (jnp.sqrt(v2n) + eps)
+        m1n = b1 * m1 + (1 - b1) * g1r
+        v1n = b2 * v1 + (1 - b2) * g1r * g1r
+        mw1n = mw1 - lr * m1n / (jnp.sqrt(v1n) + eps)
+        # loss reduced LAST: a pmean before the backward would block the
+        # program on a collective with the whole backward still pending
+        # (its own GL008 finding — the linter caught exactly that in an
+        # earlier draft of this stand-in)
+        loss = jax.lax.pmean((yf ** 2).mean(), "dp")
+        return (loss, mw1n.astype(jnp.bfloat16), mw2n.astype(jnp.bfloat16),
+                m1n, v1n, mw1n, m2n, v2n, mw2n)
+
+    return mesh_train_step
+
+
+def _lint_mesh(analysis, mesh_shape, with_cost):
+    """The ``mesh`` target: jaxpr-visible-collective programs linted and
+    (optionally) costed under a real dp x mp device mesh.  Returns
+    (lint_reports, cost_reports); skips with a note when the host has too
+    few devices (the jaxpr needs a concrete mesh)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.core import compat as _compat
+
+    dp, mp = mesh_shape
+    need = dp * mp
+    devs = jax.devices()
+    if need < 2 or len(devs) < need:
+        print(f"graph_lint: mesh target skipped (needs {max(need, 2)} "
+              f"devices for --mesh-shape {dp},{mp}; have {len(devs)})")
+        return [], []
+    lint_reports, cost_reports = [], []
+
+    def _one(fn, args, program, donate=()):
+        lint_reports.append(analysis.lint(fn, *args, program=program,
+                                          donate_argnums=donate))
+        if with_cost:
+            cost_reports.append(analysis.cost(fn, *args, program=program))
+
+    # (a) the dp x mp fused train-step stand-in
+    mesh = Mesh(np.array(devs[:need]).reshape(dp, mp), ("dp", "mp"))
+    B, H, F = _MESH_B, _MESH_H, _MESH_F
+    col, row = P(None, "mp"), P("mp", None)
+    specs = (P("dp", None), col, row,
+             col, col, col, row, row, row)
+    out_specs = (P(), col, row, col, col, col, row, row, row)
+    step = _compat.shard_map(_mesh_train_step_fn(jax, jnp), mesh,
+                             in_specs=specs, out_specs=out_specs)
+    sds = jax.ShapeDtypeStruct
+    args = (sds((B, H), jnp.bfloat16),
+            sds((H, F), jnp.bfloat16), sds((F, H), jnp.bfloat16),
+            sds((H, F), jnp.float32), sds((H, F), jnp.float32),
+            sds((H, F), jnp.float32),
+            sds((F, H), jnp.float32), sds((F, H), jnp.float32),
+            sds((F, H), jnp.float32))
+    # weights + optimizer state donated, as the real fused step does
+    _one(step, args, f"mesh_train_step[dp{dp}xmp{mp}]",
+         donate=tuple(range(1, 9)))
+
+    # (b) ring attention over a sequence-parallel axis (the ppermute ring)
+    from functools import partial
+
+    from paddle_tpu.nn.functional.ring_attention import ring_attention_raw
+
+    sp = 2
+    sp_mesh = Mesh(np.array(devs[:sp]), ("sp",))
+    qspec = P(None, "sp", None, None)
+    ring = _compat.shard_map(
+        partial(ring_attention_raw, causal=True, axis_name="sp"),
+        sp_mesh, in_specs=(qspec, qspec, qspec), out_specs=qspec,
+        check_vma=False)
+    qkv = sds((2, 256, 4, 64), jnp.float32)
+    _one(ring, (qkv, qkv, qkv), f"mesh_ring_attention[sp{sp}]")
+
+    # (c) the SPMD pipeline schedule (ppermute ticks + final psum)
+    from paddle_tpu.distributed import mesh as _mesh_mod
+    from paddle_tpu.distributed.fleet.meta_parallel.pp_spmd import (
+        pipeline_blocks,
+    )
+
+    prev_mesh = _mesh_mod.get_mesh() if _mesh_mod.has_mesh() else None
+    pp_mesh = _mesh_mod.build_mesh({"pp": 2}, devs[:2])
+    _mesh_mod.set_mesh(pp_mesh)
+    try:
+        def pp_step(stacked_w, x_micro):
+            def block(params, h):
+                (w,) = params
+                return jnp.maximum(h @ w, 0)
+
+            return pipeline_blocks(block, (stacked_w,), x_micro,
+                                   layers_per_stage=1)
+
+        _one(pp_step,
+             (sds((2, 128, 128), jnp.float32),
+              sds((2, 2, 128), jnp.float32)),
+             "mesh_pipeline_blocks[pp2]")
+    finally:
+        if prev_mesh is not None:
+            _mesh_mod.set_mesh(prev_mesh)
+
+    return lint_reports, cost_reports
+
+
+def _lint_mesh_serve(pt, np, mesh_shape):
+    """The sharded serving engine at the requested mesh shape: its fused
+    step compiles through the FLAGS_graph_lint hook (reports land in
+    ``analysis.reports()``)."""
+    import jax
+
+    dp, mp = mesh_shape
+    if dp * mp < 2 or len(jax.devices()) < dp * mp:
+        return
+    from paddle_tpu.models import gpt_tiny
+    from paddle_tpu.serving import ShardedServingEngine
+
+    cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+    model = _build_model(pt, cfg)
+    model.eval()
+    rng = np.random.RandomState(3)
+    eng = ShardedServingEngine(model, dp=dp, mp=mp,
+                               num_slots=_SRV_SLOTS, page_size=_SRV_PAGE,
+                               max_context=_SRV_CTX,
+                               cache_dtype="bfloat16")
+    try:
+        for plen in _SRV_PROMPTS:
+            eng.submit(rng.randint(0, cfg.vocab_size, (plen,)), _SRV_NEW)
+        eng.run_until_idle()
+    finally:
+        eng.close()
+
+
 def _inject(analysis, code: str):
     """A deliberately-hazardous test model per code: proves the gate exits
     1 with the right GL code and eqn provenance."""
@@ -247,8 +419,37 @@ def _inject(analysis, code: str):
             jax.ShapeDtypeStruct((4, 8, 128, 64), jnp.float32),  # 1 MiB
             jax.ShapeDtypeStruct((4, 8, 64), jnp.float32),
             program="inject:gl004")
+    if code == "gl009":
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from paddle_tpu.core import compat as _compat
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            raise ValueError("--inject gl009 needs >= 2 devices "
+                             "(XLA_FLAGS=--xla_force_host_platform_"
+                             "device_count=8)")
+        mesh = Mesh(np.array(devs[:2]), ("dp",))
+
+        def replicated_moment_step(x, w, m):
+            # the hazard under test: a 4 MiB optimizer moment REPLICATED
+            # over 'dp' instead of ZeRO-sharded
+            g = jax.lax.psum(x.T @ (x @ w), "dp")
+            m_new = 0.9 * m + 0.1 * g
+            return w - 0.01 * m_new, m_new
+
+        fn = _compat.shard_map(replicated_moment_step, mesh,
+                               in_specs=(P("dp", None), P(), P()),
+                               out_specs=(P(), P()))
+        return analysis.lint(
+            fn,
+            jax.ShapeDtypeStruct((256, 1024), jnp.float32),
+            jax.ShapeDtypeStruct((1024, 1024), jnp.float32),  # 4 MiB
+            jax.ShapeDtypeStruct((1024, 1024), jnp.float32),  # 4 MiB
+            program="inject:gl009")
     raise ValueError(f"unknown --inject code {code!r} "
-                     "(supported: gl001, gl004)")
+                     "(supported: gl001, gl004, gl009)")
 
 
 def run(argv=None) -> int:
@@ -264,9 +465,13 @@ def run(argv=None) -> int:
                     default=None, metavar="PATH",
                     help="write current gate-relevant findings to PATH "
                          "(keeps existing justifications) and exit 0")
-    ap.add_argument("--targets", default="train,decode,serve,churn",
-                    help="comma list of train,decode,serve,churn,none "
+    ap.add_argument("--targets", default="train,decode,serve,mesh,churn",
+                    help="comma list of train,decode,serve,mesh,churn,none "
                          "(default: all)")
+    ap.add_argument("--mesh-shape", default="2,2", metavar="DP,MP",
+                    help="device mesh for the mesh target (default 2,2; "
+                         "skipped with a note when the host has fewer "
+                         "devices)")
     ap.add_argument("--cost", action="store_true",
                     help="also compute static roofline cost reports "
                          "(FLAGS_graph_cost) and print a per-program "
@@ -279,7 +484,7 @@ def run(argv=None) -> int:
                          "falling back to v5e)")
     ap.add_argument("--inject", action="append", default=[],
                     metavar="CODE", help="add a deliberately-hazardous test "
-                    "model (gl001|gl004); the gate must exit 1")
+                    "model (gl001|gl004|gl009); the gate must exit 1")
     ap.add_argument("--fail-on", default="warning",
                     choices=("info", "warning", "error"),
                     help="minimum severity that fails the gate")
@@ -303,19 +508,30 @@ def run(argv=None) -> int:
         analysis.clear_reports()
 
         targets = [t for t in args.targets.split(",") if t]
-        known = {"train", "decode", "serve", "churn", "none"}
+        known = {"train", "decode", "serve", "mesh", "churn", "none"}
         for t in targets:
             if t not in known:
                 raise ValueError(f"unknown target {t!r} (expected "
                                  f"{sorted(known - {'none'})})")
+        try:
+            mesh_shape = tuple(int(d) for d in args.mesh_shape.split(","))
+            dp_, mp_ = mesh_shape
+        except Exception:
+            raise ValueError(f"--mesh-shape {args.mesh_shape!r}: expected "
+                             "DP,MP (e.g. 2,2)")
         if "train" in targets:
             _lint_train(pt, np)
         if "decode" in targets:
             _lint_decode(pt, np)
         if "serve" in targets:
             _lint_serve(pt, np)
+        mesh_lint_reports, mesh_cost_reports = [], []
+        if "mesh" in targets:
+            mesh_lint_reports, mesh_cost_reports = _lint_mesh(
+                analysis, (dp_, mp_), args.cost)
+            _lint_mesh_serve(pt, np, (dp_, mp_))
 
-        all_reports = list(analysis.reports())
+        all_reports = list(analysis.reports()) + mesh_lint_reports
         if "churn" in targets:
             all_reports.append(analysis.churn_findings())
         for code in args.inject:
@@ -361,7 +577,7 @@ def run(argv=None) -> int:
             spec = analysis.chip_spec(
                 args.chip or "",
                 getattr(jax.devices()[0], "device_kind", ""))
-            creps = analysis.cost_reports()
+            creps = analysis.cost_reports() + mesh_cost_reports
             if args.json:
                 for c in creps:
                     print(json.dumps({"cost": c.summary(spec)}))

@@ -280,6 +280,50 @@ def main() -> int:
         r = shape_unsupported_reason(100, 48)
         assert r is not None and r.code == "GL002"
 
+    # -- mesh lint (v3): the static SPMD comm passes on a REAL device
+    # mesh — GL009 must fire on dp-replicated fp32 optimizer state, the
+    # psum wire bytes must match the ring formula exactly, and the
+    # overlap fraction must be sane -------------------------------------
+    def mesh_lint():
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from paddle_tpu import analysis
+        from paddle_tpu.core import compat as _compat
+
+        devs = jax.devices()
+        if len(devs) < 2:
+            print("tpu_smoke: mesh_lint: single chip; dp mesh skipped")
+            return
+        mesh = Mesh(np.asarray(devs[:2]), ("dp",))
+
+        def step(x, w, m):
+            g = jax.lax.psum((x.T @ (x @ w)).astype(jnp.float32), "dp")
+            m2 = 0.9 * m + g
+            return (w - 1e-3 * m2).astype(w.dtype), m2
+
+        fn = _compat.shard_map(
+            step, mesh=mesh, in_specs=(P("dp", None), P(), P()),
+            out_specs=(P(), P()))
+        x = jnp.zeros((256, 1024), jnp.bfloat16)
+        w = jnp.zeros((1024, 1024), jnp.bfloat16)
+        m = jnp.zeros((1024, 1024), jnp.float32)
+        rep = analysis.lint(fn, x, w, m, program="smoke_mesh_lint")
+        gl9 = [f for f in rep.findings if f.code == "GL009"]
+        # w (2 MiB bf16) and m (4 MiB fp32) are both dp-replicated and
+        # above the 1 MiB floor; x is dp-sharded and must NOT fire
+        assert len(gl9) == 2, f"expected 2 GL009, got {rep.render()}"
+        assert all("dp" in f.detail for f in gl9), gl9
+        assert not any("invar[0]" in f.detail for f in gl9), \
+            "GL009 fired on the dp-sharded input"
+        crep = analysis.cost(fn, x, w, m, program="smoke_mesh_lint")
+        assert len(crep.collectives) == 1, crep.render()
+        cc = crep.collectives[0]
+        # ring all-reduce wire bytes: 2(n-1)/n x 4 MiB payload at n=2
+        payload = 1024 * 1024 * 4
+        assert cc.wire_bytes == payload, (cc.wire_bytes, payload)
+        ov = crep.overlap_fraction()
+        assert 0.0 <= ov <= 1.0, ov
+
     # -- checkpoint: save -> corrupt -> fallback -> resume ON-CHIP (the
     # sentry's fused all-finite reduction and the device_get snapshot
     # boundary both run against real TPU arrays here) --------------------
@@ -703,6 +747,7 @@ def main() -> int:
     check("fused_adamw", fused_adamw)
     check("rms_norm", rms_norm)
     check("graph_lint", graph_lint)
+    check("mesh_lint", mesh_lint)
     check("checkpoint", checkpoint)
     check("serving_faults", serving_faults)
     check("sharded_serving", sharded_serving)
