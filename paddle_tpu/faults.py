@@ -79,6 +79,16 @@ KIND_POINTS = {
     "beat_skip": ("heartbeat",),
     "exchange_stall": ("exchange",),
     "exchange_error": ("exchange",),
+    # cluster (sharded serving / traffic-driver hook points — PR 19):
+    # ``replica_kill`` fires at ShardedServingEngine's per-tick
+    # ``cluster_step`` point and appends ``plan.slots`` (replica indices,
+    # default [0]) to ``ctx["kill"]``; the cluster closes those replicas
+    # and re-homes their live requests.  ``load_spike`` fires at a
+    # traffic driver's ``traffic`` point and multiplies
+    # ``ctx["multiplier"]`` by ``plan.duration`` (the spike factor) —
+    # the driver submits that many times its baseline arrivals.
+    "replica_kill": ("cluster_step",),
+    "load_spike": ("traffic",),
 }
 
 KINDS = tuple(KIND_POINTS)
@@ -108,9 +118,12 @@ class FaultPlan:
     at: int                        # 0-based occurrence index of the point
     kind: str                      # one of KINDS
     times: int = 1                 # consecutive occurrences to fire on
-    duration: float = 0.0          # step_stall/exchange_stall: sleep seconds
+    duration: float = 0.0          # step_stall/exchange_stall: sleep
+    #                                seconds; load_spike: spike multiplier
     slots: Optional[Sequence[int]] = None   # nan_logits: slot indices (None
-    #                                         = every active slot)
+    #                                         = every active slot);
+    #                                         replica_kill: replica indices
+    #                                         (None = replica 0)
     state_intact: bool = True      # step_exception: pre-dispatch fault?
 
     def __post_init__(self):
@@ -211,6 +224,16 @@ class FaultInjector:
         if plan.kind == "exchange_error":
             raise InjectedFault(
                 f"injected collective fault at {plan.point}#{n}")
+        if plan.kind == "replica_kill":
+            if ctx is not None:
+                ctx.setdefault("kill", []).extend(
+                    plan.slots if plan.slots is not None else [0])
+            return
+        if plan.kind == "load_spike":
+            if ctx is not None:
+                ctx["multiplier"] = (ctx.get("multiplier", 1.0)
+                                     * max(plan.duration, 1.0))
+            return
 
     # -- introspection -----------------------------------------------------
     def fired(self, kind: Optional[str] = None) -> int:

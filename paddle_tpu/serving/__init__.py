@@ -41,8 +41,26 @@ request mix (PAPERS.md: "Ragged Paged Attention", arxiv 2604.15464).
   gathered per token inside the step — one compiled program serves
   many fine-tuned tenants, register/evict at runtime without retraces.
 
+- :mod:`elastic` — ``ElasticServingController``: the closed loop over
+  all of the above — windowed SLO sensing from the telemetry registry,
+  deterministic hysteresis/cooldown policy emitting typed
+  ScaleUp/ScaleDown/Brownout/Recover actions, graceful replica drain
+  with token-prefix checkpoint re-homing, and the ordered brownout
+  ladder — docs/serving.md "Elasticity & degradation ladder".
+
 See docs/serving.md (incl. the "Failure model & SLOs" section).
 """
+from .elastic import (  # noqa: F401
+    BROWNOUT_RUNGS,
+    Brownout,
+    ClusterSignals,
+    ElasticConfig,
+    ElasticServingController,
+    Recover,
+    ScaleDown,
+    ScaleUp,
+    SLOTargets,
+)
 from .engine import (  # noqa: F401
     DeadlineExceeded,
     NaNLogitsError,
@@ -99,4 +117,7 @@ __all__ = [
     "AdmissionScheduler", "Scheduler", "Slot",
     "PlacementScheduler", "LeastLoadedPlacement",
     "PrefixLocalityPlacement", "replica_load",
+    "ElasticServingController", "ElasticConfig", "ClusterSignals",
+    "SLOTargets", "ScaleUp", "ScaleDown", "Brownout", "Recover",
+    "BROWNOUT_RUNGS",
 ]

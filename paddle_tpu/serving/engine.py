@@ -207,6 +207,14 @@ class Request:
         self._t_last_token: Optional[float] = None   # ITL bookkeeping
         self.error: Optional[BaseException] = None
         self.callback_error: Optional[BaseException] = None
+        # drain/re-home bookkeeping (docs/serving.md "Elasticity &
+        # degradation ladder"): how many generated tokens were folded
+        # into ``prompt`` by checkpoint_seated (output_ids() is invariant
+        # across the fold), the sampling RNG state captured at the
+        # checkpoint, and which replica last queued the request
+        self.rehomed = 0
+        self.rng_state = None
+        self.replica: Optional[int] = None
         self._cancelled = False
         self._cb_warned = False
         self._done = threading.Event()
@@ -615,6 +623,11 @@ class ServingEngine:
         self.queue = RequestQueue(max_depth=max_queue_depth)
         self._lock = threading.RLock()
         self._closed = False
+        # drain lifecycle (docs/serving.md "Elasticity & degradation
+        # ladder"): while draining, admission stops and submit sheds
+        # typed; seated requests keep stepping until completion or a
+        # checkpoint_seated() eviction re-homes them elsewhere
+        self._draining = False
 
         # fixed fused-step geometry: the flat token axis, block count, and
         # work-list length are engine constants (retrace-freedom); the
@@ -741,7 +754,11 @@ class ServingEngine:
                         # fault-containment counters (admission path SLOs)
                         "failed": 0, "cancelled": 0, "timed_out": 0,
                         "shed": 0, "quarantined": 0, "step_retries": 0,
-                        "recoveries": 0, "rebuilds": 0},
+                        "recoveries": 0, "rebuilds": 0,
+                        # requests checkpointed off this engine by a
+                        # drain / replica loss (they terminate on the
+                        # replica that re-seats them, not here)
+                        "drained": 0},
             labels=self._engine_label)
         # per-request SLO histograms (seconds, log-bucketed): TTFT and
         # e2e are measured FROM SUBMISSION (queue time included — the
@@ -904,6 +921,12 @@ class ServingEngine:
         seated, it is retired TIMED_OUT at the first step boundary past
         the deadline."""
         self._check_open()
+        if self._draining:
+            # typed, not counted as a capacity shed: the placement layer
+            # skips draining replicas before probing their submit, so a
+            # direct hit here is a client racing the drain
+            raise Overloaded(
+                "engine draining: admission stopped — submit elsewhere")
         prompt = np.asarray(prompt, np.int64).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -1304,6 +1327,107 @@ class ServingEngine:
                 f"not complete ({detail})") from bad[0].error
         return [r.output_ids() for r in reqs]
 
+    # -- drain lifecycle (docs/serving.md "Elasticity & degradation
+    # ladder"): scale-down and replica-loss re-homing both go through
+    # begin_drain -> [keep stepping] -> checkpoint_seated -----------------
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        """True once a draining engine holds no work at all."""
+        return (self._draining and self.queue.depth == 0
+                and self.scheduler.active_slots == 0)
+
+    def begin_drain(self) -> List[Request]:
+        """Stop admission and hand back every QUEUED (never-seated)
+        request for re-routing via the placement layer.  Seated requests
+        are untouched — ``step()`` keeps decoding them to completion; a
+        caller that cannot wait evicts the stragglers with
+        ``checkpoint_seated()`` once its drain deadline passes."""
+        with self._lock:
+            self._check_open()
+            self._draining = True
+            return self.queue.remove_where(lambda r: True)
+
+    def resume_admission(self):
+        """Reverse ``begin_drain``: the engine admits again (scale-up of
+        a previously drained replica)."""
+        with self._lock:
+            self._check_open()
+            self._draining = False
+
+    def checkpoint_seated(self) -> List[Request]:
+        """Evict every seated request as a re-admittable token-prefix
+        checkpoint and return them (drain deadline passed, or the replica
+        is being killed).  The generated continuation folds into the
+        prompt — ``output_ids()`` is INVARIANT across the fold and tokens
+        already streamed through ``on_token`` are never re-emitted
+        (exactly-once) — and the remaining ``max_new_tokens`` budget
+        shrinks by what was already emitted, so a survivor re-admits the
+        request at exactly the position the drained replica left it.
+        Greedy continuations are bitwise-identical to an undrained run
+        (greedy decode is a pure function of the context); sampling
+        requests additionally carry the engine's RNG state on
+        ``Request.rng_state`` (the continuation resumes the documented
+        distribution — the survivor draws from its own stream).  Pages,
+        LoRA references and prefix-cache reader references all release
+        here, so the 4-term page-accounting invariant holds immediately
+        after."""
+        with self._lock:
+            self._check_open()
+            return [self._checkpoint_slot(i)
+                    for i, _slot in self.scheduler.seated()]
+
+    def _checkpoint_slot(self, idx: int) -> Request:
+        slot = self.scheduler.slots[idx]
+        req = slot.request
+        if req.sampling.do_sample:
+            req.rng_state = self._rng_checkpoint()
+        self.scheduler.retire(idx)         # pages + cache refs free NOW
+        self._clear_slot_mirrors(idx)      # LoRA reference drops here
+        n_emitted = len(req.tokens)
+        req.prompt = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int64)])
+        req.max_new_tokens -= n_emitted
+        req.tokens = []
+        req.rehomed += n_emitted
+        req.state = RequestState.SUBMITTED
+        self._totals.inc("drained")
+        return req
+
+    def _rng_checkpoint(self):
+        """The sampling generator's state at the checkpoint (engine-own
+        stream for mesh-sharded engines, the global one otherwise)."""
+        gen = self._generator
+        if gen is None:
+            from ..ops.random import default_generator as gen
+        try:
+            return np.asarray(gen._state.numpy()).copy()
+        except Exception:  # noqa: BLE001 — state is advisory metadata
+            return None
+
+    def requeue(self, req: Request) -> Request:
+        """Queue an EXISTING request object (placement-layer re-homing
+        after a drain or replica loss).  Prompt/budget validation
+        happened at the original submit and the checkpoint fold preserves
+        the total; the bounded-queue check still applies (typed
+        ``Overloaded``).  The absolute monotonic ``deadline`` carries
+        over unchanged; ``submit_t`` resets to NOW — queue-wait shedding
+        measures time in THIS queue, not lifetime (the deadline already
+        bounds that)."""
+        self._check_open()
+        if self._draining:
+            raise Overloaded(
+                f"engine draining: request {req.id} not requeued")
+        if req.adapter is not None and self.lora is None:
+            raise Overloaded(
+                f"request {req.id} needs adapter {req.adapter!r} but this "
+                "replica has no LoRA pool")
+        req.submit_t = time.monotonic()
+        return self.queue.submit(req)
+
     # -- internals ---------------------------------------------------------
     @contextmanager
     def _eval_mode(self):
@@ -1398,6 +1522,8 @@ class ServingEngine:
         step starts consuming the prompt under the token budget (no
         per-request prefill dispatch: the PR-5 ``[1, chunk]`` program is
         retired)."""
+        if self._draining:
+            return                        # drain: no new admissions, ever
         if now < self._admit_after:
             return                        # re-admission backoff after recovery
         sched = self.scheduler
@@ -1628,6 +1754,7 @@ class ServingEngine:
         out.update(self._last_metrics)
         out["queue_depth"] = self.queue.depth
         out["active_slots"] = self.scheduler.active_slots
+        out["draining"] = self._draining
         out["pages_used"] = self.allocator.used_pages
         out["pages_capacity"] = self.allocator.capacity
         out["occupancy"] = self.scheduler.occupancy

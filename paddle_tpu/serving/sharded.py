@@ -29,17 +29,27 @@ tests/test_sharded_serving.py pins it for (dp, mp) in
 """
 from __future__ import annotations
 
+import itertools
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from ..distributed import serving_mesh as _srv_mesh
-from .engine import Request, RequestState, ServingEngine, ServingError
+from ..telemetry import metrics as _tmetrics
+from .engine import (
+    Overloaded,
+    Request,
+    RequestState,
+    ServingEngine,
+    ServingError,
+)
 from .placement import LeastLoadedPlacement, PlacementScheduler
 
 __all__ = ["ShardedServingEngine"]
+
+_CLUSTER_SEQ = itertools.count()
 
 
 class ShardedServingEngine:
@@ -91,24 +101,97 @@ class ShardedServingEngine:
         self._pool = (ThreadPoolExecutor(
             max_workers=dp, thread_name_prefix="sharded-serving-step")
             if dp > 1 else None)
+        # -- elastic lifecycle (PR 19, docs/serving.md "Elasticity") ----
+        # Each replica index is in exactly one state:
+        #   active   — stepping, accepting new admissions
+        #   draining — stepping (seated work must finish) but admission
+        #              stopped; queued work already re-homed
+        #   parked   — drained and NOT stepping (scale-down complete;
+        #              its chips cost nothing until activate_replica)
+        #   dead     — killed/closed; never comes back
+        self._parked: set = set()
+        self._dead: set = set()
+        self._drain_deadline: Dict[int, Optional[float]] = {}
+        # chip accounting for the elasticity win: one unit per replica
+        # actually stepped per tick — chip-seconds ∝ replica_steps * mp
+        self._replica_steps = 0
+        # cluster-level fault hook (faults.py `replica_kill` fires at the
+        # per-tick "cluster_step" point)
+        self._fault_hook = None
+        # brownout actuators (driven by serving/elastic.py, LIFO order)
+        self.max_new_cap: Optional[int] = None   # rung 1: clamp admissions
+        self.shedding = False                    # rung 4: refuse work
+        self._orig_prefill_budget = [e.prefill_token_budget
+                                     for e in self.replicas]
+        label = {"cluster": str(next(_CLUSTER_SEQ))}
+        self._cluster_label = label
+        reg = _tmetrics.registry()
+        self._rehomed_counter = reg.counter(
+            "serving_rehomed_requests_total",
+            "requests re-homed onto a survivor after a drain or replica "
+            "loss").labels(**label)
+        self._rehomed_synced = 0
+        self._brownout_shed = reg.counter(
+            "serving_brownout_shed_total",
+            "requests refused at the brownout ladder's shed rung",
+        ).labels(**label)
 
     # -- submission (placement layer) --------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32, **kwargs) -> Request:
         """Place the request on the least-loaded replica and queue it
         there.  Typed ``Overloaded`` only when ALL replicas shed; the
-        seated replica's index rides on ``request.replica``."""
+        seated replica's index rides on ``request.replica``.
+
+        Brownout rungs act here (elastic.py): rung 1 clamps ``max_new``
+        for NEW admissions (seated requests keep their grant), the shed
+        rung refuses work outright — both typed, both counted."""
+        if self.shedding:
+            self._brownout_shed.inc()
+            raise Overloaded(
+                "cluster browned out to shedding: offered load exceeds "
+                "maximum degraded capacity — back off and retry")
+        if self.max_new_cap is not None:
+            max_new_tokens = min(int(max_new_tokens), self.max_new_cap)
         return self.placement.submit(prompt, max_new_tokens, **kwargs)
 
     # -- the serving loop --------------------------------------------------
+    _IDLE_ROW = {"active_slots": 0, "queue_depth": 0, "pages_used": 0,
+                 "pages_capacity": 0, "occupancy": 0.0,
+                 "tokens_this_step": 0}
+
     def step(self) -> dict:
-        """One cluster tick: every replica runs its own fused step (its
-        own admission, pool and fault containment), concurrently across
-        replicas when dp > 1.  Returns aggregate step metrics plus the
-        per-replica list (replica order preserved)."""
-        if self._pool is not None:
-            per = list(self._pool.map(lambda e: e.step(), self.replicas))
+        """One cluster tick: every live replica runs its own fused step
+        (its own admission, pool and fault containment), concurrently
+        across replicas when dp > 1.  Returns aggregate step metrics plus
+        the per-replica list (replica order preserved; parked/dead
+        replicas contribute an all-zero placeholder row).
+
+        Elastic upkeep rides the tick boundary: the ``cluster_step``
+        fault hook may kill replicas first (their live work re-homes),
+        drains whose replica emptied — or whose deadline passed — are
+        finalized, and the placement layer's held re-home queue is swept
+        (terminal requests reaped) and retried against freed capacity.
+        """
+        hook = self._fault_hook
+        if hook is not None:
+            ctx: dict = {"kill": []}
+            hook("cluster_step", ctx)
+            for i in ctx["kill"]:
+                self.kill_replica(i)
+        self._check_drains()
+        live = [i for i in range(len(self.replicas)) if self._stepping(i)]
+        if self._pool is not None and len(live) > 1:
+            stepped = dict(zip(live, self._pool.map(
+                lambda i: self.replicas[i].step(), live)))
         else:
-            per = [eng.step() for eng in self.replicas]
+            stepped = {i: self.replicas[i].step() for i in live}
+        self._replica_steps += len(live)
+        per = [stepped.get(i, dict(self._IDLE_ROW))
+               for i in range(len(self.replicas))]
+        self.placement.sweep()
+        if self.placement.held:
+            self.placement.flush_held()
+        self._sync_rehomed()
         pages_used = sum(m["pages_used"] for m in per)
         pages_cap = sum(m["pages_capacity"] for m in per)
         agg = {
@@ -153,6 +236,176 @@ class ShardedServingEngine:
                 f"not complete ({detail})") from bad[0].error
         return [r.output_ids() for r in reqs]
 
+    # -- elastic replica lifecycle (PR 19) ---------------------------------
+    def _stepping(self, i: int) -> bool:
+        """Does replica ``i`` burn a replica-step this tick?  Active and
+        draining replicas do (seated work must run to completion);
+        parked and dead ones don't — that difference IS the chip-seconds
+        saving the chaos trace measures."""
+        return i not in self._dead and i not in self._parked
+
+    @property
+    def active_dp(self) -> int:
+        """Replicas currently stepping (active + draining)."""
+        return sum(1 for i in range(len(self.replicas))
+                   if self._stepping(i))
+
+    def replica_states(self) -> List[str]:
+        out = []
+        for i, e in enumerate(self.replicas):
+            if i in self._dead:
+                out.append("dead")
+            elif i in self._parked:
+                out.append("parked")
+            elif getattr(e, "draining", False):
+                out.append("draining")
+            else:
+                out.append("active")
+        return out
+
+    def _rehome(self, reqs: List[Request]) -> int:
+        """Re-seat harvested live requests on survivors via the placement
+        walk; the unseatable remainder parks in ``placement.held`` (still
+        live) and is retried every tick.  Returns requests seated now."""
+        seated = sum(1 for r in reqs if self.placement.resubmit(r))
+        self.placement.sweep()
+        self._sync_rehomed()
+        return seated
+
+    def _sync_rehomed(self):
+        cur = self.placement.rehomed_total
+        if cur > self._rehomed_synced:
+            self._rehomed_counter.inc(cur - self._rehomed_synced)
+            self._rehomed_synced = cur
+
+    def begin_drain_replica(self, i: int,
+                            deadline_s: Optional[float] = None) -> int:
+        """Start draining replica ``i``: admission stops immediately, its
+        queued requests re-home via placement NOW, and its seated
+        requests keep running.  With a ``deadline_s``, seated work still
+        unfinished when it expires is checkpointed (token-prefix + RNG
+        state folded into the request) and re-homed too; without one the
+        drain completes whenever the last seated request finishes.
+        Returns the number of queued requests harvested."""
+        if i in self._dead:
+            raise ServingError(f"replica {i} is dead; cannot drain")
+        queued = self.replicas[i].begin_drain()
+        self._drain_deadline[i] = (None if deadline_s is None
+                                   else time.monotonic() + deadline_s)
+        self._rehome(queued)
+        return len(queued)
+
+    def _check_drains(self, now: Optional[float] = None):
+        for i in list(self._drain_deadline):
+            e = self.replicas[i]
+            deadline = self._drain_deadline[i]
+            if e.drained:
+                self.finish_drain_replica(i)
+            elif deadline is not None and (
+                    now if now is not None else time.monotonic()
+            ) >= deadline:
+                # deadline: fold the stragglers and re-home them — the
+                # drained replica parks THIS tick, not eventually
+                self._rehome(e.checkpoint_seated())
+                self.finish_drain_replica(i)
+
+    def finish_drain_replica(self, i: int):
+        """Park a drained replica: it stops stepping (chip-seconds stop
+        accruing) but keeps its pool — ``activate_replica`` brings it
+        back without recompilation or weight reload."""
+        self._drain_deadline.pop(i, None)
+        self._parked.add(i)
+
+    def drain_replica(self, i: int, *,
+                      deadline_s: Optional[float] = None,
+                      max_steps: int = 500) -> int:
+        """Synchronous convenience: begin the drain and step the cluster
+        until replica ``i`` parks (tests and the smoke case).  Seated
+        work elsewhere advances normally during the wait."""
+        harvested = self.begin_drain_replica(i, deadline_s=deadline_s)
+        steps = 0
+        while i not in self._parked:
+            self.step()
+            steps += 1
+            if steps >= max_steps:
+                raise ServingError(
+                    f"replica {i} failed to drain within {max_steps} "
+                    "cluster steps")
+        return harvested
+
+    def activate_replica(self, i: int):
+        """Scale-up: bring a parked (or mid-drain) replica back to
+        active.  Its pool, program and weights never left, so the only
+        cost is the placement layer seeing it eligible again."""
+        if i in self._dead:
+            raise ServingError(f"replica {i} is dead; cannot activate")
+        self._parked.discard(i)
+        self._drain_deadline.pop(i, None)
+        self.replicas[i].resume_admission()
+
+    def kill_replica(self, i: int) -> int:
+        """Replica loss (fault path, `replica_kill`): close replica ``i``
+        NOW and re-home its live work onto survivors — queued requests
+        re-route directly, seated ones are checkpointed off the host
+        mirrors (tokens emitted so far live host-side, so a chip loss
+        does not lose them).  Requests no survivor can seat park in the
+        held queue; they only go FAILED when no eligible replica remains
+        (placement.sweep).  Returns the number of live requests
+        harvested."""
+        if i in self._dead:
+            return 0
+        e = self.replicas[i]
+        self._dead.add(i)
+        self._parked.discard(i)
+        self._drain_deadline.pop(i, None)
+        live = e.begin_drain()          # stops admission + harvests queue
+        live += e.checkpoint_seated()
+        e.close()
+        self._rehome(live)
+        return len(live)
+
+    # -- brownout actuators (elastic.py drives these, LIFO on recovery) ----
+    def set_max_new_cap(self, cap: Optional[int]):
+        """Rung 1: clamp ``max_new_tokens`` for NEW admissions (None
+        restores).  Seated requests keep their original grant."""
+        self.max_new_cap = None if cap is None else max(1, int(cap))
+
+    def set_speculation(self, enabled: bool) -> int:
+        """Rung 2: toggle speculative decoding on every replica that has
+        it (SpeculativeEngine.speculation_enabled).  Returns how many
+        replicas were toggled — 0 means the rung is a no-op here."""
+        n = 0
+        for idx, e in enumerate(self.replicas):
+            if idx in self._dead:
+                continue
+            if hasattr(e, "speculation_enabled"):
+                e.speculation_enabled = bool(enabled)
+                n += 1
+        return n
+
+    def shrink_prefill_budget(self, frac: float = 0.5):
+        """Rung 3: shrink every replica's per-step prefill token budget.
+        Shrinking is retrace-free (plans stay within the compiled
+        ``t_max`` geometry); growing past the construction-time budget
+        would overflow it, so restore only ever returns to the original."""
+        if not 0.0 < frac <= 1.0:
+            raise ValueError(f"frac={frac} must be in (0, 1]")
+        for idx, e in enumerate(self.replicas):
+            if idx in self._dead:
+                continue
+            e.prefill_token_budget = max(
+                1, int(self._orig_prefill_budget[idx] * frac))
+
+    def restore_prefill_budget(self):
+        for idx, e in enumerate(self.replicas):
+            if idx in self._dead:
+                continue
+            e.prefill_token_budget = self._orig_prefill_budget[idx]
+
+    def set_shedding(self, on: bool):
+        """Rung 4 (last resort): refuse new work with typed Overloaded."""
+        self.shedding = bool(on)
+
     # -- observability -----------------------------------------------------
     def metrics(self) -> dict:
         """Cluster metrics: summed counters/capacities (aggregate slots
@@ -192,6 +445,17 @@ class ShardedServingEngine:
         out["cache_bytes_per_chip"] = (per[0]["cache_bytes_per_chip"]
                                        if per else 0)
         out["routed"] = list(self.placement.routed)
+        # elastic lifecycle observability (PR 19)
+        out["replica_states"] = self.replica_states()
+        out["active_dp"] = self.active_dp
+        out["replica_steps"] = self._replica_steps
+        # chip-seconds proxy: every stepped replica burns its mp chips
+        # for one tick — the quantity the chaos trace minimizes
+        out["replica_step_chip_ticks"] = self._replica_steps * self.mp
+        out["rehomed"] = self.placement.rehomed_total
+        out["held"] = len(self.placement.held)
+        out["brownout_shed"] = int(self._brownout_shed.value)
+        out["shed"] += out["brownout_shed"]
         out["per_replica"] = per
         return out
 
@@ -207,3 +471,6 @@ class ShardedServingEngine:
             eng.close()
         if self._pool is not None:
             self._pool.shutdown(wait=False)
+        # same hygiene as the engine: recycled clusters must not grow
+        # the Prometheus exposition forever (handles keep working)
+        _tmetrics.registry().drop_labels(**self._cluster_label)

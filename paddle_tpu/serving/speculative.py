@@ -459,6 +459,12 @@ class SpeculativeEngine(ServingEngine):
         if spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {spec_k}")
         self.spec_k = int(spec_k)
+        # brownout actuator (serving/elastic.py "disable_speculation"
+        # rung): False skips the draft phase entirely — verify runs carry
+        # zero proposals (plain decode, greedy output unchanged) and the
+        # shadow's skipped tokens join its catch-up backlog, drained
+        # through the normal lag path when speculation re-enables
+        self.speculation_enabled = True
         self._draft_model = draft_model
         self.draft: Optional[_DraftShadow] = None
         self._draft_num_pages_arg = draft_num_pages
@@ -667,11 +673,24 @@ class SpeculativeEngine(ServingEngine):
         sched = self.scheduler
         sampling = bool(self._do_sample.any())
         k = self.spec_k
+        spec_on = self.speculation_enabled
         it1: List[Tuple[int, np.ndarray, int]] = []
         decode: List[Tuple[StepWork, int]] = []      # (work, k_s)
         live = set()
         for w in work:
             slot = sched.slots[w.slot]
+            if not spec_on:
+                # speculation browned out: no draft dispatch at all; the
+                # committed token joins the shadow's backlog at harvest
+                if w.kind == "prefill":
+                    self._spec_totals.inc("draft_skips")
+                    self._spec_last[w.slot] = {"prefill_ran": False}
+                else:
+                    self._spec_last[w.slot] = {"consumed": 0,
+                                               "wrote_input": False,
+                                               "n_draft": 0}
+                    decode.append((w, 0))
+                continue
             dpos = int(self.draft.pos[w.slot])
             if w.kind == "prefill":
                 # the shadow runs the same prefill run only while it is

@@ -59,6 +59,14 @@
 # storms must be absorbed by the bounded retry, and kill -> elastic
 # restart -> resume must be bitwise-equal to the uninterrupted run; see
 # docs/distributed_faults.md).  PADDLE_TPU_SKIP_DIST_FAULT_GATE=1 skips it.
+#
+# An elastic-serving gate runs ninth (tools/elastic_gate.py — scripted
+# load through the SLO-driven controller: scale-up on a load spike,
+# scale-down on idle with a BITWISE token-prefix drain, replica-kill
+# re-homing with exactly-once streams, the brownout ladder engaging in
+# order and releasing LIFO with every actuator restored, and anti-flap
+# under adversarial oscillation; see docs/serving.md "Elasticity &
+# degradation ladder").  PADDLE_TPU_SKIP_ELASTIC_GATE=1 skips it.
 export JAX_PLATFORMS=cpu
 export PYTHONPATH=$(python - << 'PY'
 import os
@@ -142,6 +150,15 @@ if [ -z "$PADDLE_TPU_SKIP_DIST_FAULT_GATE" ]; then
     python "$(dirname "$0")/tools/dist_fault_gate.py" || {
         rc=$?
         echo "run_tests: distributed fault gate FAILED (rc=$rc)"
+        exit $rc
+    }
+fi
+
+if [ -z "$PADDLE_TPU_SKIP_ELASTIC_GATE" ]; then
+    echo "run_tests: elastic serving gate (tools/elastic_gate.py)"
+    python "$(dirname "$0")/tools/elastic_gate.py" || {
+        rc=$?
+        echo "run_tests: elastic serving gate FAILED (rc=$rc)"
         exit $rc
     }
 fi

@@ -1002,6 +1002,154 @@ def chaos(n_requests: int = 36, lengths: str = "fixed") -> int:
     return 0
 
 
+def trace(ttft_budget_s: float = 5.0) -> int:
+    """Elasticity A/B under ONE chaos traffic trace (--trace): a diurnal
+    arrival ramp with a 4x load spike (faults.py ``load_spike``) and a
+    mid-run replica kill (``replica_kill``), replayed arrival-for-arrival
+    through two dp=2 clusters —
+
+      - ``elastic``: starts scaled down to one replica with an
+        ElasticServingController closing the loop (queue-driven policy,
+        tick clock);
+      - ``static``: both replicas active the whole run, no controller
+        (the provisioned-for-peak baseline).
+
+    Prints one ``{"metric": "serving_trace", "mode": ...}`` line per run
+    and asserts the elasticity win the ISSUE-19 acceptance names: the
+    elastic run holds p99 TTFT within ``ttft_budget_s`` while spending
+    STRICTLY fewer replica-step chip-seconds than static max-dp, every
+    admitted request reaches a typed terminal state, and every completed
+    output (re-homed ones included) is bitwise a prefix of the
+    single-shot greedy oracle."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.serving import (
+        ElasticConfig, ElasticServingController, FaultInjector, Overloaded,
+        ShardedServingEngine, SLOTargets,
+    )
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    if len(jax.devices()) < 2:
+        print("serving_trace: <2 devices, dp=2 A/B skipped")
+        return 0
+    # the scripted trace: per-tick base arrivals (diurnal ramp), a 4x
+    # spike over ticks 12-15, a replica kill at cluster-step 28
+    base = [1] * 8 + [2] * 16 + [1] * 16 + [0] * 24
+    ref_model, cfg, kw, prompt_lens, max_new = _build(on_tpu)
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)) for n in prompt_lens]
+    refs = [np.asarray(
+        ref_model.generate(pt.to_tensor(p[None, :], dtype="int64"),
+                           max_new_tokens=max_new,
+                           max_seq_len=kw["max_context"],
+                           cache_dtype=kw["cache_dtype"]).numpy())[0]
+        for p in prompts]
+
+    def run(mode: str) -> dict:
+        model = _build(on_tpu)[0]
+        cluster = ShardedServingEngine(model, dp=2, mp=1, **kw)
+        warm = [cluster.submit(p, 2) for p in prompts[:2]]
+        cluster.run_until_idle(max_steps=200)      # compile both replicas
+        assert all(r.terminal for r in warm)
+        clk_t = [0.0]
+        ctl = None
+        if mode == "elastic":
+            cluster.drain_replica(1, deadline_s=0.0)   # start scaled down
+            ctl = ElasticServingController(
+                cluster,
+                ElasticConfig(targets=SLOTargets(queue_high=3.0,
+                                                 queue_low=0.5),
+                              min_samples=10**9, cooldown_s=3.0,
+                              overload_sustain_s=1e9,
+                              underload_sustain_s=2.0,
+                              drain_deadline_s=0.0, min_dp=1),
+                clock=lambda: clk_t[0])
+        inj = FaultInjector()
+        inj.inject("traffic", at=12, times=4, kind="load_spike",
+                   duration=4.0)
+        inj.inject("cluster_step", at=28, kind="replica_kill", slots=[1])
+        inj.install(cluster)
+        reqs, shed, k = [], 0, 0
+
+        def tick_once():
+            if ctl is not None:
+                ctl.tick()
+            cluster.step()
+            clk_t[0] += 1.0
+
+        for t, b in enumerate(base):
+            ctx = {"multiplier": 1.0}
+            inj.hook("traffic", ctx)
+            for _ in range(int(round(b * ctx["multiplier"]))):
+                try:
+                    r = cluster.submit(prompts[k % len(prompts)], max_new)
+                    reqs.append((r, k % len(prompts)))
+                    k += 1
+                except Overloaded:
+                    shed += 1
+            tick_once()
+        # drain the tail (controller keeps scaling down as it empties)
+        for _ in range(600):
+            if (all(r.terminal for r, _ in reqs)
+                    and cluster.placement.pending() == 0):
+                break
+            tick_once()
+        mets = cluster.metrics()
+        ttfts = [r.t_first_token - r.t_submitted for r, _ in reqs
+                 if r.t_first_token is not None and r.t_submitted is not None]
+        rec = {
+            "metric": "serving_trace", "mode": mode,
+            "ticks": len(base), "requests": len(reqs), "shed": shed,
+            "done": sum(r.state == "DONE" for r, _ in reqs),
+            "rehomed": mets["rehomed"],
+            "replica_steps": mets["replica_steps"],
+            "chip_ticks": mets["replica_step_chip_ticks"],
+            "replica_states": mets["replica_states"],
+            "ttft_ms_p99": round(float(np.percentile(ttfts, 99)) * 1000.0,
+                                 2) if ttfts else 0.0,
+            "scale_actions": len(ctl.actions) if ctl else 0,
+        }
+        print(json.dumps(rec))
+        sys.stdout.flush()
+        for r, i in reqs:
+            if not r.terminal:
+                raise AssertionError(f"{mode}: request {r.id} non-terminal")
+            out = np.asarray(r.output_ids())
+            if not np.array_equal(out, refs[i][:out.size]):
+                raise AssertionError(
+                    f"{mode}: request {r.id} diverged from the oracle")
+        if ctl is not None:
+            ctl.close()
+        cluster.close()
+        return rec
+
+    try:
+        el = run("elastic")
+        st = run("static")
+    except AssertionError as e:
+        print(f"serving_trace: FAIL {e}")
+        return 1
+    budget_ms = ttft_budget_s * 1000.0
+    if el["ttft_ms_p99"] > budget_ms:
+        print(f"serving_trace: FAIL elastic p99 TTFT {el['ttft_ms_p99']}ms "
+              f"over the {budget_ms:.0f}ms budget")
+        return 1
+    if el["replica_steps"] >= st["replica_steps"]:
+        print(f"serving_trace: FAIL no chip-seconds win: elastic "
+              f"{el['replica_steps']} vs static {st['replica_steps']} "
+              "replica-steps")
+        return 1
+    if el["rehomed"] < 1:
+        print("serving_trace: FAIL the kill/drain re-homed nothing")
+        return 1
+    print(f"serving_trace: OK (elastic p99 TTFT {el['ttft_ms_p99']}ms <= "
+          f"{budget_ms:.0f}ms, {el['replica_steps']} vs "
+          f"{st['replica_steps']} static replica-steps, "
+          f"{el['rehomed']} re-homed bitwise)")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--gate", action="store_true",
@@ -1009,6 +1157,14 @@ def main() -> int:
     ap.add_argument("--chaos", action="store_true",
                     help="fault storm under offered load: assert graceful "
                          "degradation + recovery")
+    ap.add_argument("--trace", action="store_true",
+                    help="elasticity A/B on one chaos traffic trace "
+                         "(diurnal ramp + 4x spike + replica kill): the "
+                         "elastic run must hold p99 TTFT within "
+                         "--ttft-budget at STRICTLY fewer replica-step "
+                         "chip-seconds than static max-dp, bitwise")
+    ap.add_argument("--ttft-budget", type=float, default=5.0,
+                    help="--trace p99 TTFT budget in seconds")
     ap.add_argument("--requests", type=int, default=24)
     ap.add_argument("--loads", type=str, default="0.5,1,2,4",
                     help="comma-separated offered loads (requests/step)")
@@ -1065,6 +1221,8 @@ def main() -> int:
     if args.chaos:
         return chaos(max(args.requests, 36) if args.requests != 24
                      else 36, lengths=args.lengths)
+    if args.trace:
+        return trace(ttft_budget_s=args.ttft_budget)
     if args.prefix_dist:
         return prefix_sweep(args.prefix_dist, args.requests)
     try:

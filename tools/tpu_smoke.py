@@ -693,6 +693,94 @@ def main() -> int:
         assert dist_fault_gate.scenario_kill_rank(verbose=False), \
             "kill-and-recover scenario failed (see output above)"
 
+    # -- elastic serving: the closed loop on the REAL chips — a parked
+    # replica scales up under a queue spike (typed ScaleUp), then the
+    # idle scale-down drains it through the deadline-0 token-prefix
+    # checkpoint path, and every request (re-homed ones included) must
+    # stay bitwise-equal to the single-chip greedy oracle
+    # (docs/serving.md "Elasticity & degradation ladder") ----------------
+    def elastic_serving():
+        import paddle_tpu as pt
+        from paddle_tpu.models import GPTForPretraining, gpt_tiny
+        from paddle_tpu.serving import (
+            ElasticConfig, ElasticServingController, ScaleDown, ScaleUp,
+            ShardedServingEngine, SLOTargets,
+        )
+
+        if len(jax.devices()) < 2:
+            print("tpu_smoke: elastic_serving: single-chip host, skipped")
+            return
+        pt.seed(0)
+        cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+        m = GPTForPretraining(cfg)
+        m.eval()
+        erng = np.random.RandomState(5)
+        prompts = [erng.randint(0, cfg.vocab_size, (s,))
+                   for s in (6, 15, 9, 21, 12, 18)]
+        refs = [np.asarray(
+            m.generate(pt.to_tensor(p[None, :], dtype="int64"),
+                       max_new_tokens=8, max_seq_len=128,
+                       cache_dtype="bfloat16").numpy())[0]
+            for p in prompts]
+        eng = ShardedServingEngine(m, dp=2, mp=1, num_slots=2,
+                                   page_size=128, max_context=128,
+                                   cache_dtype="bfloat16")
+        warm = [eng.submit(p, 2) for p in prompts[:2]]
+        eng.run_until_idle(max_steps=200)          # compile both replicas
+        assert all(r.terminal for r in warm)
+        t = [0.0]
+        ctl = ElasticServingController(
+            eng, ElasticConfig(targets=SLOTargets(queue_high=2.0,
+                                                  queue_low=0.5),
+                               min_samples=10**9, cooldown_s=2.0,
+                               overload_sustain_s=1e9,
+                               underload_sustain_s=2.0,
+                               drain_deadline_s=0.0, min_dp=1),
+            clock=lambda: t[0])
+        eng.drain_replica(1, deadline_s=0.0)       # start scaled down
+        reqs = [eng.submit(p, 8) for p in prompts]  # the spike
+        for _ in range(60):
+            ctl.tick()
+            eng.step()
+            t[0] += 1.0
+            if (all(r.terminal for r in reqs)
+                    and eng.placement.pending() == 0
+                    and eng.replica_states() == ["active", "parked"]):
+                break
+        acts = [type(a).__name__ for a in ctl.actions]
+        assert any(isinstance(a, ScaleUp) for a in ctl.actions), acts
+        assert any(isinstance(a, ScaleDown) for a in ctl.actions), acts
+        assert eng.replica_states() == ["active", "parked"], \
+            eng.replica_states()
+        for r, ref in zip(reqs, refs):
+            assert r.finished and np.array_equal(r.output_ids(), ref), \
+                f"request {r.id} diverged from the single-chip oracle " \
+                f"(rehomed={r.rehomed})"
+        # the checkpoint path, deterministically: seat work on replica 1,
+        # then force a deadline-0 drain mid-generation — the seated
+        # requests fold their emitted prefix, re-home to replica 0, and
+        # must STILL match the oracle bitwise
+        before = eng.metrics()["rehomed"]
+        eng.activate_replica(1)
+        reqs2 = [eng.submit(p, 8) for p in prompts[:4]]
+        for _ in range(2):
+            eng.step()
+        eng.drain_replica(1, deadline_s=0.0, max_steps=200)
+        eng.run_until_idle(max_steps=300)
+        for r, ref in zip(reqs2, refs[:4]):
+            assert r.finished and np.array_equal(r.output_ids(), ref), \
+                f"re-homed request {r.id} diverged (rehomed={r.rehomed})"
+        mets = eng.metrics()
+        assert mets["rehomed"] - before >= 1, \
+            "the deadline-0 drain checkpointed nothing"
+        for i, rep in enumerate(eng.replicas):
+            assert rep.allocator.used_pages == 0, f"replica {i} leaked"
+        ctl.close()
+        print(f"tpu_smoke: elastic_serving: {acts} "
+              f"rehomed={mets['rehomed']} "
+              f"replica_steps={mets['replica_steps']} (bitwise)")
+        eng.close()
+
     # -- train pipeline: ONE on-chip fused train step (fwd+bwd+AdamW with
     # fp32 masters, donated) fed through the device prefetcher — proves
     # the donated program + the async input pipeline + the stall
@@ -751,6 +839,7 @@ def main() -> int:
     check("checkpoint", checkpoint)
     check("serving_faults", serving_faults)
     check("sharded_serving", sharded_serving)
+    check("elastic_serving", elastic_serving)
     check("speculative_serving", speculative_serving)
     check("prefix_cache", prefix_cache)
     check("autotune_sweep", autotune_sweep)

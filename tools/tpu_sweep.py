@@ -37,7 +37,7 @@ def probe(timeout=300.0) -> bool:
 def run_case(name, env_extra, timeout=1200.0):
     env = dict(os.environ)
     env.update(env_extra)
-    t0 = time.time()
+    t0 = time.monotonic()   # duration: immune to wall-clock jumps
     try:
         p = subprocess.run(
             [sys.executable, BENCH, "--child"], env=env, cwd=REPO,
@@ -47,7 +47,7 @@ def run_case(name, env_extra, timeout=1200.0):
     line = next((ln for ln in (p.stdout or "").splitlines()
                  if ln.strip().startswith("{") and '"metric"' in ln), None)
     rec = {"case": name, "ok": p.returncode == 0 and line is not None,
-           "wall_s": round(time.time() - t0, 1)}
+           "wall_s": round(time.monotonic() - t0, 1)}
     if line:
         rec["result"] = json.loads(line)
     elif p.returncode != 0:
