@@ -88,6 +88,7 @@ __all__ = [
     "cost_reports", "clear_cost_reports",
     "dot_flops", "eqn_flops", "ragged_padding_waste",
     "paged_pool_bytes", "decode_step_kv_bytes",
+    "page_transfer_bytes", "page_transfer_cost",
 ]
 
 
@@ -564,6 +565,54 @@ def paged_pool_bytes(num_pages: int, num_heads: int, page_size: int,
         # fp32 [P, H] scale buffer per pool, per layer, for K and V
         total += 2 * int(num_layers) * int(num_pages) * int(num_heads) * 4
     return total
+
+
+def page_transfer_bytes(num_pages: int, num_heads: int, page_size: int,
+                        head_dim: int, num_layers: int = 1,
+                        dtype="bfloat16") -> int:
+    """Exact wire bytes of a disaggregated page hand-off moving
+    ``num_pages`` FILLED pool pages between two replicas
+    (serving/disagg.py PageTransfer): K + V for every page across
+    layers, plus — in the int8 regime — the per-(page, head) fp32 absmax
+    scale sidecars that ride along (a dequantizable page is page bytes
+    AND its scales; shipping one without the other is a wrong answer).
+    The geometry is identical to a ``num_pages``-page pool, so this
+    delegates to :func:`paged_pool_bytes` — one formula, no drift."""
+    return paged_pool_bytes(num_pages, num_heads, page_size, head_dim,
+                            num_layers=num_layers, dtype=dtype)
+
+
+def page_transfer_cost(num_pages: int, num_heads: int, page_size: int,
+                       head_dim: int, num_layers: int = 1,
+                       dtype="bfloat16",
+                       provenance: str = "serving/disagg.PageTransfer"
+                       ) -> "CollectiveCost":
+    """The hand-off as ICI traffic, in the mesh-lint cost vocabulary: a
+    point-to-point ``ppermute``-shaped transfer (wire == payload, one
+    hop), so ``comm_seconds``/``overlap_fraction`` and the GL008/GL010
+    overlap machinery apply to it exactly as to a compiled collective —
+    serving_bench reports transfer seconds vs decode compute from this.
+    The copy runs OUTSIDE any compiled step program (device-to-device
+    gather/scatter between two pools), so there is no in-graph consumer:
+    ``consumed_in_body=False`` and the decode work both replicas keep
+    dispatching meanwhile is the overlap budget callers may add."""
+    payload = page_transfer_bytes(num_pages, num_heads, page_size,
+                                  head_dim, num_layers=num_layers,
+                                  dtype=dtype)
+    return CollectiveCost(
+        primitive="ppermute",
+        axes=("dp",),
+        axis_size=2,                    # source chip -> destination chip
+        payload_bytes=payload,
+        wire_bytes=collective_wire_bytes("ppermute", payload, payload, 2),
+        hops=collective_hops("ppermute", 2),
+        mult=1,
+        overlap_flops=0,
+        pending_indep_flops=0,
+        consumed_in_body=False,
+        out=f"{int(num_pages)} pages x{int(num_layers)}L {dtype}",
+        provenance=provenance,
+    )
 
 
 def decode_step_kv_bytes(context_tokens: int, num_heads: int,

@@ -28,6 +28,22 @@ kind                    hook point             effect
                                                ``on_token`` invocation
 ======================  =====================  ==============================
 
+Disaggregated hand-off points (serving/disagg.py — PR 20):
+
+======================  =====================  ==============================
+``transfer_stall``      page_transfer          ``time.sleep(duration)`` in
+                                               the middle of a page hand-off
+``transfer_error``      page_transfer          raise :class:`InjectedFault`
+                                               mid-transfer — the destination
+                                               reservation must roll back and
+                                               the source retain ownership
+``transfer_partial``    page_transfer          ``ctx["partial"] = True`` —
+                                               only part of the page set
+                                               lands; the transfer layer
+                                               treats it as failed (rollback
+                                               + source keeps the request)
+======================  =====================  ==============================
+
 Distributed points (docs/distributed_faults.md):
 
 ======================  =====================  ==============================
@@ -65,7 +81,8 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 __all__ = ["InjectedFault", "FaultPlan", "FaultInjector", "random_schedule",
-           "random_store_schedule", "KINDS", "KIND_POINTS"]
+           "random_store_schedule", "random_transfer_schedule",
+           "KINDS", "KIND_POINTS"]
 
 KIND_POINTS = {
     # serving (engine/allocator hook points)
@@ -89,6 +106,15 @@ KIND_POINTS = {
     # the driver submits that many times its baseline arrivals.
     "replica_kill": ("cluster_step",),
     "load_spike": ("traffic",),
+    # disaggregated hand-off (serving/disagg.py — PR 20): all three fire
+    # at the PageTransfer's ``page_transfer`` point, between the
+    # destination-side reservation and the commit, so every schedule
+    # exercises the mid-transfer ownership protocol.  Plans naming any
+    # other point are rejected by FaultPlan validation (the PR 8
+    # retired-point discipline).
+    "transfer_stall": ("page_transfer",),
+    "transfer_error": ("page_transfer",),
+    "transfer_partial": ("page_transfer",),
 }
 
 KINDS = tuple(KIND_POINTS)
@@ -193,7 +219,7 @@ class FaultInjector:
             raise InjectedFault(
                 f"injected step exception at {plan.point}#{n}",
                 state_intact=plan.state_intact)
-        if plan.kind in ("step_stall", "exchange_stall"):
+        if plan.kind in ("step_stall", "exchange_stall", "transfer_stall"):
             time.sleep(plan.duration)
             return
         if plan.kind == "nan_logits":
@@ -233,6 +259,13 @@ class FaultInjector:
             if ctx is not None:
                 ctx["multiplier"] = (ctx.get("multiplier", 1.0)
                                      * max(plan.duration, 1.0))
+            return
+        if plan.kind == "transfer_error":
+            raise InjectedFault(
+                f"injected transfer fault at {plan.point}#{n}")
+        if plan.kind == "transfer_partial":
+            if ctx is not None:
+                ctx["partial"] = True
             return
 
     # -- introspection -----------------------------------------------------
@@ -277,6 +310,32 @@ def random_schedule(rng: np.random.RandomState, *, horizon: int = 40,
                        times=int(rng.randint(1, 6)))
         else:
             inj.inject("callback", at=at, kind=kind)
+    return inj
+
+
+def random_transfer_schedule(rng: np.random.RandomState, *,
+                             horizon: int = 12, n_faults: int = 3,
+                             include_stalls: bool = False,
+                             stall_duration: float = 0.05) -> FaultInjector:
+    """Randomized mid-transfer fault schedule for the disaggregated
+    hand-off (serving/disagg.py): ``transfer_error`` / ``transfer_partial``
+    shots at random occurrences of the ``page_transfer`` point.  The
+    property tests assert that under ANY seed both pools' 4-term page
+    accounting stays exact and every request still reaches a typed
+    terminal state — transfers may fail, ownership may not leak."""
+    kinds = ["transfer_error", "transfer_partial"]
+    if include_stalls:
+        kinds.append("transfer_stall")
+    inj = FaultInjector()
+    for _ in range(n_faults):
+        kind = kinds[rng.randint(len(kinds))]
+        at = int(rng.randint(0, max(horizon, 1)))
+        if kind == "transfer_stall":
+            inj.inject("page_transfer", at=at, kind=kind,
+                       duration=stall_duration)
+        else:
+            inj.inject("page_transfer", at=at, kind=kind,
+                       times=int(rng.randint(1, 3)))
     return inj
 
 

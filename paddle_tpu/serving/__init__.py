@@ -46,7 +46,15 @@ request mix (PAPERS.md: "Ragged Paged Attention", arxiv 2604.15464).
   deterministic hysteresis/cooldown policy emitting typed
   ScaleUp/ScaleDown/Brownout/Recover actions, graceful replica drain
   with token-prefix checkpoint re-homing, and the ordered brownout
-  ladder — docs/serving.md "Elasticity & degradation ladder".
+  ladder — docs/serving.md "Elasticity & degradation ladder";
+- :mod:`disagg` — ``DisaggServingEngine``: disaggregated serving —
+  dedicated prefill and decode replica roles with page-granular KV
+  hand-off (``PageTransfer``: destination reservation -> batched
+  device-to-device page copy -> atomic commit -> source release, exact
+  on both allocators under mid-transfer faults), role-aware admission
+  (``RolePlacement``) and per-role elastic scaling
+  (``DisaggElasticController``: TTFT drives the prefill pool, ITL the
+  decode pool) — docs/serving.md "Disaggregated prefill/decode".
 
 See docs/serving.md (incl. the "Failure model & SLOs" section).
 """
@@ -60,6 +68,16 @@ from .elastic import (  # noqa: F401
     ScaleDown,
     ScaleUp,
     SLOTargets,
+)
+from .disagg import (  # noqa: F401
+    ROLE_COLOCATED,
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    DisaggElasticController,
+    DisaggServingEngine,
+    PageTransfer,
+    PageTransferAborted,
+    RolePlacement,
 )
 from .engine import (  # noqa: F401
     DeadlineExceeded,
@@ -76,7 +94,13 @@ from .engine import (  # noqa: F401
     serve_trace_counts,
     reset_serve_trace_counts,
 )
-from .faults import FaultInjector, FaultPlan, InjectedFault, random_schedule  # noqa: F401,E501
+from .faults import (  # noqa: F401
+    FaultInjector,
+    FaultPlan,
+    InjectedFault,
+    random_schedule,
+    random_transfer_schedule,
+)
 from .lora import (  # noqa: F401
     AdapterError,
     AdapterInUse,
@@ -112,6 +136,10 @@ __all__ = [
     "ServingError", "Overloaded", "DeadlineExceeded", "RequestCancelled",
     "StepStalledError", "NaNLogitsError",
     "FaultInjector", "FaultPlan", "InjectedFault", "random_schedule",
+    "random_transfer_schedule",
+    "DisaggServingEngine", "DisaggElasticController", "RolePlacement",
+    "PageTransfer", "PageTransferAborted",
+    "ROLE_PREFILL", "ROLE_DECODE", "ROLE_COLOCATED",
     "NULL_PAGE", "BlockAllocator", "PagedKVCache", "pages_for_tokens",
     "PrefixCache",
     "AdmissionScheduler", "Scheduler", "Slot",

@@ -196,6 +196,33 @@ class AdmissionScheduler:
         self.positions[idx] = int(n_cached)
         return idx
 
+    def adopt(self, request, pages: List[int], pos: int) -> Optional[int]:
+        """Seat a request whose pages were transferred in from another
+        replica (serving/disagg.py hand-off).  The pages must ALREADY sit
+        in this pool's allocated ledger — the transfer commits its
+        destination-side reservation (``commit_spec``) before seating, so
+        adoption touches no allocator state; it only writes the slot and
+        the table/position mirrors.  Seats at ``pos`` (every KV position
+        the source wrote) with no pending prompt: the slot decodes from
+        its first step here.  None when no slot is free (caller rolls the
+        transfer back)."""
+        free = self.free_slot_indices()
+        if not free:
+            return None
+        if len(pages) > self.max_pages_per_slot:
+            raise ValueError(
+                f"transferred request holds {len(pages)} pages but a slot "
+                f"holds at most {self.max_pages_per_slot}")
+        idx = free[0]
+        self.slots[idx] = Slot(request, list(pages), pos=int(pos),
+                               seq=self._admit_seq, shared=0, nodes=[])
+        self._admit_seq += 1
+        row = np.full((self.max_pages_per_slot,), NULL_PAGE, np.int32)
+        row[:len(pages)] = pages
+        self.tables[idx] = row
+        self.positions[idx] = int(pos)
+        return idx
+
     def retire(self, idx: int):
         """Release slot ``idx``: private pages back to the pool NOW,
         reader references on shared (prefix-cache) pages dropped, table

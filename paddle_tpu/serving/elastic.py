@@ -119,6 +119,7 @@ class SLOTargets:
     signal from oscillating the controller."""
 
     ttft_p99_s: float = 0.5
+    itl_p99_s: float = 0.2        # decode-pool target (signal="itl")
     queue_high: float = 4.0       # queued requests per ACTIVE replica
     queue_low: float = 0.5
     recover_frac: float = 0.5     # underload: p99 < recover_frac * target
@@ -140,6 +141,8 @@ class ClusterSignals:
     active_dp: int                # stepping replicas (active + draining)
     parked: Tuple[int, ...]       # replica indices available to scale up
     scalable: Tuple[int, ...]     # active non-draining indices (may drain)
+    itl_window_count: int = 0     # ITL samples inside the window (the
+    #                               primary count when signal="itl")
 
 
 def _bucket_quantile(bounds: Sequence[float], counts: Sequence[float],
@@ -184,6 +187,18 @@ class ElasticConfig:
     min_dp: int = 1                  # never drain below this many active
     brownout_max_new: int = 8        # rung 1: max_new clamp
     brownout_prefill_frac: float = 0.5  # rung 3: prefill budget factor
+    # disaggregated role pools (serving/disagg.py): which latency SLO
+    # this controller regulates — "ttft" (the prefill/colocated promise)
+    # or "itl" (the decode-pool promise).  A pool whose actuators are
+    # owned by ANOTHER controller disables its brownout ladder so two
+    # controllers never duel over the shared cluster-wide rungs.
+    signal: str = "ttft"
+    brownout_enabled: bool = True
+
+    def __post_init__(self):
+        if self.signal not in ("ttft", "itl"):
+            raise ValueError(
+                f"signal={self.signal!r}: expected 'ttft' or 'itl'")
 
 
 # ---------------------------------------------------------------------------
@@ -271,7 +286,7 @@ class ElasticServingController:
         now = self.clock()
         ttft_p99, n = self._windowed_p99(
             self._ttft_ring, "serving_ttft_seconds", now)
-        itl_p99, _ = self._windowed_p99(
+        itl_p99, n_itl = self._windowed_p99(
             self._itl_ring, "serving_itl_seconds", now)
         cl = self.cluster
         queue = occ = 0.0
@@ -292,19 +307,28 @@ class ElasticServingController:
             now=now, ttft_p99=ttft_p99, itl_p99=itl_p99, window_count=n,
             queue_per_replica=queue / max(active, 1), occupancy=occ,
             active_dp=active, parked=tuple(parked),
-            scalable=tuple(scalable))
+            scalable=tuple(scalable), itl_window_count=n_itl)
 
     # -- decide ------------------------------------------------------------
-    def _overloaded(self, sig: ClusterSignals) -> bool:
+    def _primary(self, sig: ClusterSignals) -> Tuple[float, int, float]:
+        """(windowed p99, sample count, target) of the configured SLO
+        signal — TTFT for prefill/colocated pools, ITL for a decode pool
+        (serving/disagg.py runs one controller per role pool)."""
         t = self.config.targets
-        slo_breach = (sig.window_count >= self.config.min_samples
-                      and sig.ttft_p99 > t.ttft_p99_s)
-        return slo_breach or sig.queue_per_replica > t.queue_high
+        if self.config.signal == "itl":
+            return sig.itl_p99, sig.itl_window_count, t.itl_p99_s
+        return sig.ttft_p99, sig.window_count, t.ttft_p99_s
+
+    def _overloaded(self, sig: ClusterSignals) -> bool:
+        p99, n, target = self._primary(sig)
+        slo_breach = n >= self.config.min_samples and p99 > target
+        return slo_breach or sig.queue_per_replica > \
+            self.config.targets.queue_high
 
     def _underloaded(self, sig: ClusterSignals) -> bool:
         t = self.config.targets
-        slo_ok = (sig.window_count < self.config.min_samples
-                  or sig.ttft_p99 < t.recover_frac * t.ttft_p99_s)
+        p99, n, target = self._primary(sig)
+        slo_ok = n < self.config.min_samples or p99 < t.recover_frac * target
         return sig.queue_per_replica < t.queue_low and slo_ok
 
     def decide(self, sig: ClusterSignals) -> List[Action]:
@@ -327,6 +351,7 @@ class ElasticServingController:
                     reason=f"overload: ttft_p99={sig.ttft_p99:.3f}s "
                            f"queue/replica={sig.queue_per_replica:.1f}"))
             elif (not sig.parked
+                  and cfg.brownout_enabled
                   and over_age >= cfg.overload_sustain_s
                   and self.brownout_level < len(BROWNOUT_RUNGS)
                   and sig.now >= self._rung_cooldown_until):

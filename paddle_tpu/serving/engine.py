@@ -533,8 +533,16 @@ class ServingEngine:
                  backoff_max_s: float = 5.0,
                  mesh=None, lora=None, prefix_cache: bool = False,
                  kv_dtype: Optional[str] = None,
-                 weight_dtype: Optional[str] = None):
+                 weight_dtype: Optional[str] = None,
+                 role: Optional[str] = None):
         cfg = model.config
+        # disaggregated serving (serving/disagg.py): the replica's role
+        # ("prefill" | "decode" | "colocated").  Passing it explicitly
+        # adds a ``role`` label to every per-engine metric child (the
+        # per-role SLO breakdown the observability docs table lists);
+        # None keeps the historical label set for standalone engines.
+        self.role = role or "colocated"
+        self._role_label = {} if role is None else {"role": str(role)}
         # quantized serving (docs/serving.md "Quantized serving"):
         # ``kv_dtype`` is the preferred name for the pool dtype (wins
         # over the historical ``cache_dtype`` when both are given) —
@@ -735,7 +743,8 @@ class ServingEngine:
         # ``serving_<key>`` counter labeled with this engine's id, and
         # the CounterSet facade keeps the historical ``+=``/``dict()``
         # idiom bit-compatible (metrics() reads the same ints as ever)
-        self._engine_label = {"engine": str(next(_ENGINE_SEQ))}
+        self._engine_label = {"engine": str(next(_ENGINE_SEQ)),
+                              **self._role_label}
         self._totals = _tmetrics.CounterSet(
             "serving", {"steps": 0, "tokens": 0, "admitted": 0,
                         "completed": 0,
@@ -758,7 +767,12 @@ class ServingEngine:
                         # requests checkpointed off this engine by a
                         # drain / replica loss (they terminate on the
                         # replica that re-seats them, not here)
-                        "drained": 0},
+                        "drained": 0,
+                        # disaggregated hand-off (serving/disagg.py):
+                        # requests whose filled pages left this replica
+                        # for a decode replica / arrived from a prefill
+                        # replica via PageTransfer
+                        "transferred_out": 0, "transferred_in": 0},
             labels=self._engine_label)
         # per-request SLO histograms (seconds, log-bucketed): TTFT and
         # e2e are measured FROM SUBMISSION (queue time included — the
@@ -1427,6 +1441,64 @@ class ServingEngine:
                 "replica has no LoRA pool")
         req.submit_t = time.monotonic()
         return self.queue.submit(req)
+
+    # -- disaggregated hand-off (serving/disagg.py) ------------------------
+    def adopt_transferred(self, req: Request, pages: List[int], pos: int,
+                          last_token: int) -> Optional[int]:
+        """Seat a mid-decode request whose KV pages were copied into this
+        replica's pool by a :class:`~.disagg.PageTransfer`.  ``pages``
+        must ALREADY be committed in this allocator's ledger (the
+        transfer's destination-side reservation went spec → allocated
+        before this call); ``pos`` is every KV position the source wrote
+        and ``last_token`` the source's most recent sampled token — the
+        next decode step feeds it at ``positions[idx] == pos`` exactly as
+        the source would have, which is what makes the greedy
+        continuation bitwise-identical to an untransferred run.  None
+        (nothing changed) when this replica cannot seat it right now —
+        draining, no free slot, or a missing LoRA adapter — and the
+        caller rolls the transfer back."""
+        with self._lock:
+            self._check_open()
+            if self._draining:
+                return None
+            page = 0
+            if req.adapter is not None:
+                if self.lora is None:
+                    return None
+                try:
+                    page = self.lora.acquire(req.adapter)
+                except ServingError:
+                    return None
+            idx = self.scheduler.adopt(req, pages, pos)
+            if idx is None:
+                if req.adapter is not None:
+                    self.lora.release(req.adapter)
+                return None
+            self._adapter[idx] = page
+            self._adapter_name[idx] = req.adapter
+            sp = req.sampling
+            self._temp[idx] = np.float32(sp.temperature)
+            self._top_p[idx] = np.float32(sp.top_p)
+            self._top_k[idx] = np.int32(sp.top_k)
+            self._do_sample[idx] = bool(sp.do_sample)
+            self._tokens[idx] = np.int64(last_token)
+            self._sampling_cache = None
+            req.state = RequestState.DECODE
+            self._totals.inc("transferred_in")
+            return idx
+
+    def release_transferred(self, idx: int):
+        """Source side of a committed hand-off: the request now lives on
+        the destination replica, so release slot ``idx`` WITHOUT a
+        terminal transition — pages back to this pool, prefix-cache
+        reader references dropped, LoRA reference released.  Called only
+        after the destination committed its copy (the ownership rule that
+        keeps both pools' 4-term invariant exact through faults: until
+        commit, this slot still owns the request)."""
+        with self._lock:
+            self.scheduler.retire(idx)
+            self._clear_slot_mirrors(idx)
+            self._totals.inc("transferred_out")
 
     # -- internals ---------------------------------------------------------
     @contextmanager

@@ -18,7 +18,8 @@ from ..faults import (  # noqa: F401
     FaultPlan,
     InjectedFault,
     random_schedule,
+    random_transfer_schedule,
 )
 
 __all__ = ["InjectedFault", "FaultPlan", "FaultInjector", "random_schedule",
-           "KINDS"]
+           "random_transfer_schedule", "KINDS"]

@@ -256,7 +256,15 @@ class BlockAllocator:
     def reserve_spec(self, n: int) -> Optional[List[int]]:
         """Reserve ``n`` pages speculatively (all-or-nothing, like
         ``alloc``).  None when fewer than ``n`` are free — the caller
-        degrades (proposes fewer tokens) instead of corrupting state."""
+        degrades (proposes fewer tokens) instead of corrupting state.
+
+        The disaggregated hand-off (serving/disagg.py) reuses this exact
+        ledger as its DESTINATION-side transfer reservation: pages sit in
+        ``spec`` while the copy is in flight, ``commit_spec`` lands them
+        atomically at harvest, ``rollback_spec`` returns them on a
+        mid-transfer fault — so free+used+spec+shared==capacity is exact
+        on both pools at every step boundary, transfers in flight
+        included."""
         if n < 0:
             raise ValueError(f"reserve_spec({n})")
         if self._fault_hook is not None:

@@ -34,7 +34,16 @@ from .engine import (
 )
 
 __all__ = ["LeastLoadedPlacement", "PrefixLocalityPlacement",
-           "PlacementScheduler", "replica_load", "replica_signals"]
+           "PlacementScheduler", "replica_load", "replica_role",
+           "replica_signals"]
+
+
+def replica_role(engine) -> str:
+    """The replica's disaggregation role ("prefill" | "decode" |
+    "colocated" — serving/disagg.py).  Engines built outside a
+    :class:`~.disagg.DisaggServingEngine` read as "colocated": they both
+    prefill and decode, so every policy treats them as admittable."""
+    return getattr(engine, "role", "colocated")
 
 
 def replica_load(engine) -> Tuple[int, float, int]:

@@ -38,6 +38,15 @@ evictor is installed as the allocator's ``reclaimer``: under pool
 pressure, cache-held pages are reclaimed BEFORE admission backpressures,
 and never while referenced (``BlockAllocator.reclaim`` refuses
 refcount > 0).
+
+**Disaggregation.**  The index is per replica and transport-agnostic:
+under prefill/decode disaggregation (serving/disagg.py) prefill replicas
+keep their own caches — prefix-locality routing sends sibling prompts to
+the replica that already holds their prefix — and a hand-off copies a
+request's matched SHARED pages into private destination pages (the
+reader reference pins them for the copy's duration; the source drops it
+at release).  The decode side re-registers completed pages into its own
+index at harvest, so transferred siblings dedup storage there too.
 """
 from __future__ import annotations
 

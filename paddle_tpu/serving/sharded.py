@@ -179,12 +179,23 @@ class ShardedServingEngine:
             for i in ctx["kill"]:
                 self.kill_replica(i)
         self._check_drains()
+        return self._pooled_step()
+
+    def _replica_step(self, i: int) -> dict:
+        """One replica's work for this cluster tick — the subclass seam
+        serving/disagg.py uses to run decode-role replicas for several
+        sub-steps INSIDE the pooled barrier (their dispatches overlap
+        the prefill replicas' longer steps instead of serializing after
+        them)."""
+        return self.replicas[i].step()
+
+    def _pooled_step(self) -> dict:
         live = [i for i in range(len(self.replicas)) if self._stepping(i)]
         if self._pool is not None and len(live) > 1:
-            stepped = dict(zip(live, self._pool.map(
-                lambda i: self.replicas[i].step(), live)))
+            stepped = dict(zip(live, self._pool.map(self._replica_step,
+                                                    live)))
         else:
-            stepped = {i: self.replicas[i].step() for i in live}
+            stepped = {i: self._replica_step(i) for i in live}
         self._replica_steps += len(live)
         per = [stepped.get(i, dict(self._IDLE_ROW))
                for i in range(len(self.replicas))]
@@ -426,7 +437,11 @@ class ShardedServingEngine:
                     "prefix_hits", "prefix_partial_hits", "prefix_misses",
                     "prefix_evictions", "prefix_cached_tokens",
                     "prefix_cache_pages", "prefix_cache_nodes",
-                    "shared_pages")
+                    "shared_pages",
+                    # disaggregated hand-off (serving/disagg.py): both
+                    # sides of every committed PageTransfer — equal sums
+                    # cluster-wide when every transfer commits
+                    "transferred_out", "transferred_in")
         out = {k: sum(int(m.get(k, 0)) for m in per) for k in sum_keys}
         looked = (out["prefix_hits"] + out["prefix_partial_hits"]
                   + out["prefix_misses"])

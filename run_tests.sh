@@ -67,6 +67,13 @@
 # order and releasing LIFO with every actuator restored, and anti-flap
 # under adversarial oscillation; see docs/serving.md "Elasticity &
 # degradation ladder").  PADDLE_TPU_SKIP_ELASTIC_GATE=1 skips it.
+#
+# A disaggregated-serving gate runs tenth (tools/disagg_gate.py —
+# prefill/decode role parity vs the colocated cluster and the oracle,
+# mid-transfer kills in BOTH directions with exact page audits on both
+# pools, and independent per-role elastic scaling under a long-prompt
+# spike; see docs/serving.md "Disaggregated prefill/decode").
+# PADDLE_TPU_SKIP_DISAGG_GATE=1 skips it.
 export JAX_PLATFORMS=cpu
 export PYTHONPATH=$(python - << 'PY'
 import os
@@ -159,6 +166,15 @@ if [ -z "$PADDLE_TPU_SKIP_ELASTIC_GATE" ]; then
     python "$(dirname "$0")/tools/elastic_gate.py" || {
         rc=$?
         echo "run_tests: elastic serving gate FAILED (rc=$rc)"
+        exit $rc
+    }
+fi
+
+if [ -z "$PADDLE_TPU_SKIP_DISAGG_GATE" ]; then
+    echo "run_tests: disaggregated serving gate (tools/disagg_gate.py)"
+    python "$(dirname "$0")/tools/disagg_gate.py" || {
+        rc=$?
+        echo "run_tests: disaggregated serving gate FAILED (rc=$rc)"
         exit $rc
     }
 fi

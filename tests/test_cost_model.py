@@ -595,6 +595,37 @@ def test_paged_pool_bytes_golden_per_dtype():
     assert int8 < fp32 // 3          # >= 3x the pages at equal bytes
 
 
+def test_page_transfer_bytes_golden_exact_to_page_geometry():
+    # ISSUE-20 acceptance: the disaggregated hand-off's wire bytes are
+    # EXACT to the page geometry — n=3 pages, H=4, ps=16, D=8, L=2
+    fp32 = cm.page_transfer_bytes(3, 4, 16, 8, num_layers=2,
+                                  dtype="float32")
+    int8 = cm.page_transfer_bytes(3, 4, 16, 8, num_layers=2, dtype="int8")
+    assert fp32 == 2 * 2 * 3 * 4 * 16 * 8 * 4 == 24576
+    # int8 pages at 1 byte/elem + the fp32 [page, head] scale sidecars
+    # (K + V, per layer) — the sidecars MUST ride the transfer
+    assert int8 == 2 * 2 * 3 * 4 * 16 * 8 * 1 + 2 * 2 * 3 * 4 * 4 == 6336
+    # one formula with the pool: a full-pool transfer is the pool's bytes
+    assert cm.page_transfer_bytes(6, 4, 16, 16, num_layers=2,
+                                  dtype="int8") == \
+        cm.paged_pool_bytes(6, 4, 16, 16, num_layers=2, dtype="int8")
+    assert cm.page_transfer_bytes(0, 4, 16, 8, num_layers=2) == 0
+
+
+def test_page_transfer_cost_is_gl_compatible_ppermute():
+    # the hand-off models as a point-to-point ppermute between the two
+    # replicas: payload == wire bytes (no reduction factor), one hop,
+    # and it never claims in-body overlap (it runs between steps)
+    c = cm.page_transfer_cost(3, 4, 16, 8, num_layers=2, dtype="int8")
+    assert c.primitive == "ppermute" and c.axis_size == 2
+    assert c.payload_bytes == c.wire_bytes == 6336
+    assert c.hops == 1 and c.mult == 1
+    assert not c.consumed_in_body and c.overlap_fraction() == 0.0
+    spec = cm.HardwareSpec("x", peak_flops=1e12, hbm_bw=1e11)
+    assert c.comm_seconds(spec) > 0
+    assert "disagg" in c.provenance
+
+
 def test_paged_pool_bytes_matches_real_pool():
     from paddle_tpu.models import GPTForPretraining, gpt_tiny
 

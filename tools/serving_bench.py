@@ -299,6 +299,208 @@ def sweep(loads=(0.5, 1.0, 2.0, 4.0), n_requests: int = 24,
     return 0
 
 
+def _hist_snap(engines, which: str):
+    """Summed cumulative (bucket_counts, count) of one SLO histogram
+    across ``engines`` — per-replica children don't merge as quantiles;
+    summed COUNTS do (the elastic controller's sensing arithmetic)."""
+    from paddle_tpu.telemetry import metrics as _tm
+
+    fam = _tm.registry().get(f"serving_{which}_seconds")
+    total, count = [0] * (len(_tm.LATENCY_BUCKETS) + 1), 0
+    for e in engines:
+        counts, _s, c, _mn, _mx = fam.labels(**e._engine_label).snapshot()
+        total = [a + b for a, b in zip(total, counts)]
+        count += c
+    return total, count
+
+
+def _role_slo(engines, which: str, base=None) -> dict:
+    """Per-role SLO percentiles over the measured window: the delta
+    between now and the post-warmup snapshot ``base`` (compiles inside
+    warmup ITL gaps would otherwise pollute the tail)."""
+    from paddle_tpu.serving.elastic import _bucket_quantile
+    from paddle_tpu.telemetry import metrics as _tm
+
+    total, count = _hist_snap(engines, which)
+    if base is not None:
+        b_total, b_count = base
+        total = [a - b for a, b in zip(total, b_total)]
+        count -= b_count
+    out = {f"{which}_count": int(count)}
+    for q in (0.5, 0.95, 0.99):
+        v = _bucket_quantile(_tm.LATENCY_BUCKETS, total, count, q)
+        out[f"{which}_ms_p{int(q * 100)}"] = round(v * 1000.0, 2)
+    return out
+
+
+def disagg_sweep(n_prefill: int, n_decode: int, n_requests: int = 24,
+                 loads=(1.0, 2.0)) -> int:
+    """``--disagg P,D``: disaggregated vs colocated at the SAME total
+    replica count on a long/short mixed prompt distribution — ONE JSON
+    line per (engine, load):
+
+      {"metric": "serving_disagg_sweep", "mode": "disagg"|"colocated",
+       "offered_load": ..., "tokens_per_sec": ...,
+       "prefill": {"ttft_ms_p99": ..., "itl_ms_p99": ...},   # per role
+       "decode":  {...},                                     # (disagg)
+       "itl_ms_p99": ...,                                    # cluster
+       "transfers": ..., "transfer_pages": ..., ...}
+
+    The acceptance claim (ISSUE 20): decode-role ITL p99 STRICTLY better
+    than the colocated cluster's at equal replica count.  Mechanism: a
+    colocated replica's fused dispatch mixes long prefill runs into the
+    same step as its seated decoders, stretching every inter-token gap;
+    disaggregated decode replicas run small decode-only dispatches at
+    ``decode_steps_per_tick`` cadence, never behind a prompt."""
+    import jax
+
+    from paddle_tpu.serving import (
+        DisaggServingEngine, ROLE_DECODE, ROLE_PREFILL,
+        ShardedServingEngine,
+    )
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    total = n_prefill + n_decode
+    if total > len(jax.devices()):
+        print(f"serving_bench: --disagg {n_prefill},{n_decode} needs "
+              f"{total} devices, host has {len(jax.devices())}",
+              file=sys.stderr)
+        return 1
+    model, cfg, kw, prompt_lens, max_new = _build(on_tpu)
+    if not on_tpu:
+        # the disaggregation regime needs prompts that dwarf the decode
+        # program (production: thousands of prompt tokens vs a handful
+        # of decode rows) — the tiny-model sweep widens the context so
+        # the long prompts are ~10x the decode-only geometry
+        kw = dict(kw, max_context=128)
+        max_new = 8
+    rng = np.random.RandomState(0)
+    # long/short mix: half the requests near the context cap (prefill
+    # heavy), half short (decode dominated) — the mixed regime where
+    # colocation hurts ITL most
+    max_prompt = kw["max_context"] - max_new
+    plens = [(max_prompt if i % 2 == 0 else max(3, max_prompt // 16))
+             for i in range(n_requests)]
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)) for n in plens]
+
+    def warmup(eng):
+        # compiles every replica's fused step outside the timed region:
+        # one long + one short prompt per replica
+        for i in range(total):
+            eng.submit(prompts[i % 2], 3)
+        eng.run_until_idle()
+        if not isinstance(eng, DisaggServingEngine):
+            return
+        # pre-compile every power-of-two bucket of the hand-off
+        # gather/scatter (copy_pages pads to these shapes); pools are
+        # idle here, so scribbling over free pages is harmless — every
+        # future owner fully rewrites its pages before reading
+        src = eng.replicas[eng.role_indices(ROLE_PREFILL)[0]]
+        for di in eng.role_indices(ROLE_DECODE):
+            dst = eng.replicas[di]
+            cap = min(src.allocator.capacity, dst.allocator.capacity)
+            b = 1
+            while b <= min(cap, 32):
+                pages = list(range(b))
+                eng._page_transfer.copy_pages(src.cache, dst.cache,
+                                              pages, pages)
+                b *= 2
+
+    def drive(eng, load):
+        t0, injected, steps, reqs = time.perf_counter(), 0.0, 0, []
+        while True:
+            injected += load
+            while len(reqs) < min(int(injected), n_requests):
+                reqs.append(eng.submit(prompts[len(reqs)], max_new))
+            eng.step()
+            steps += 1
+            if len(reqs) >= n_requests and not eng.placement.pending():
+                break
+            if steps > 100000:
+                break
+        dt = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in reqs)
+        return reqs, steps, dt, toks
+
+    worse = []
+    for load in loads:
+        results = {}
+        for mode in ("colocated", "disagg"):
+            # equal capability on the admitting path: BOTH clusters run
+            # the TTFT-optimal whole-prompt budget (a long prompt admits
+            # in ONE fused step).  Colocated replicas pay that program
+            # size on EVERY decode token; disagg decode replicas run the
+            # budget-1 geometry — the decoupling under measurement
+            budget = kw["max_context"]
+            if mode == "disagg":
+                eng = DisaggServingEngine(
+                    model, roles=(ROLE_PREFILL,) * n_prefill
+                    + (ROLE_DECODE,) * n_decode,
+                    mp=1, decode_steps_per_tick=4,
+                    prefill_kw=dict(prefill_token_budget=budget), **kw)
+            else:
+                eng = ShardedServingEngine(model, dp=total, mp=1,
+                                           prefill_token_budget=budget,
+                                           **kw)
+            warmup(eng)
+            # measured-window bases: warmup's compile-inflated samples
+            # must not pollute the sweep's tail percentiles
+            pools = {"all": list(eng.replicas)}
+            if mode == "disagg":
+                pools["prefill"] = [eng.replicas[i]
+                                    for i in eng.role_indices(ROLE_PREFILL)]
+                pools["decode"] = [eng.replicas[i]
+                                   for i in eng.role_indices(ROLE_DECODE)]
+            bases = {(p, w): _hist_snap(engs, w)
+                     for p, engs in pools.items()
+                     for w in ("ttft", "itl")}
+            reqs, steps, dt, toks = drive(eng, load)
+            line = {
+                "metric": "serving_disagg_sweep", "mode": mode,
+                "offered_load": load, "replicas": total,
+                "tokens_per_sec": round(toks / dt, 1),
+                "completed": sum(r.finished for r in reqs),
+                "steps": steps,
+                "platform": "tpu" if on_tpu else "cpu",
+            }
+            cluster_itl = _role_slo(pools["all"], "itl",
+                                    base=bases[("all", "itl")])
+            line["itl_ms_p99"] = cluster_itl["itl_ms_p99"]
+            if mode == "disagg":
+                m = eng.metrics()
+                line["prefill"] = {
+                    **_role_slo(pools["prefill"], "ttft",
+                                base=bases[("prefill", "ttft")]),
+                    **_role_slo(pools["prefill"], "itl",
+                                base=bases[("prefill", "itl")])}
+                line["decode"] = {
+                    **_role_slo(pools["decode"], "ttft",
+                                base=bases[("decode", "ttft")]),
+                    **_role_slo(pools["decode"], "itl",
+                                base=bases[("decode", "itl")])}
+                line.update({
+                    "transfers": m["transfers_total"],
+                    "transfer_pages": m["transfer_pages"],
+                    "transfer_bytes": m["transfer_bytes"],
+                    "transfers_failed": m["transfers_failed"],
+                })
+                results["disagg_itl"] = line["decode"]["itl_ms_p99"]
+            else:
+                results["colocated_itl"] = line["itl_ms_p99"]
+            print(json.dumps(line))
+            sys.stdout.flush()
+            eng.close()
+        if results["disagg_itl"] >= results["colocated_itl"]:
+            worse.append((load, results))
+    if worse:
+        print(f"serving_bench: --disagg decode ITL p99 NOT better than "
+              f"colocated at {worse}", file=sys.stderr)
+        return 1
+    print(json.dumps({"metric": "serving_disagg_verdict",
+                      "decode_itl_strictly_better": True}))
+    return 0
+
+
 def prefix_sweep(prefix_spec: str, n_requests: int = 24,
                  families: int = 2) -> int:
     """``--prefix-dist``: shared-prefix traffic through the prefix cache
@@ -1209,6 +1411,13 @@ def main() -> int:
                     help="PTQ the decode-path weights to int8 before "
                          "serving (quantize_for_serving): int8 matmuls "
                          "with per-out-channel scales on the hot path")
+    ap.add_argument("--disagg", type=str, default=None, metavar="P,D",
+                    help="disaggregated sweep: P prefill + D decode "
+                         "replicas vs a colocated cluster of P+D on a "
+                         "long/short mixed workload. Emits per-role "
+                         "TTFT/ITL percentiles + transfer traffic and "
+                         "FAILS unless decode-role ITL p99 beats the "
+                         "colocated cluster's (ISSUE-20 acceptance)")
     ap.add_argument("--mesh", type=str, default="1,1", metavar="DP,MP",
                     help="serving mesh geometry dp,mp (sweep mode): dp "
                          "replica engines x mp tensor-parallel chips "
@@ -1225,6 +1434,16 @@ def main() -> int:
         return trace(ttft_budget_s=args.ttft_budget)
     if args.prefix_dist:
         return prefix_sweep(args.prefix_dist, args.requests)
+    if args.disagg:
+        try:
+            p, d = (int(x) for x in args.disagg.split(","))
+            assert p >= 1 and d >= 1
+        except Exception:
+            ap.error(f"--disagg {args.disagg!r}: expected P,D "
+                     f"(two ints >= 1)")
+        return disagg_sweep(p, d, args.requests,
+                            tuple(float(x)
+                                  for x in args.loads.split(",")))
     try:
         mesh = tuple(int(x) for x in args.mesh.split(","))
         assert len(mesh) == 2 and mesh[0] >= 1 and mesh[1] >= 1

@@ -781,6 +781,56 @@ def main() -> int:
               f"replica_steps={mets['replica_steps']} (bitwise)")
         eng.close()
 
+    # -- disaggregated serving: prefill/decode roles with REAL page
+    # hand-offs between two on-chip pools (the copy path goes
+    # device-to-device on TPU — no host staging); disagg greedy must be
+    # bitwise the single-chip oracle, every request must actually move,
+    # and both pools' ledgers must drain to zero ------------------------
+    def disagg_serving():
+        import paddle_tpu as pt
+        from paddle_tpu.models import GPTForPretraining, gpt_tiny
+        from paddle_tpu.serving import DisaggServingEngine
+
+        n_dev = len(jax.devices())
+        if n_dev < 2:
+            print("tpu_smoke: disagg_serving: single-chip host, skipped")
+            return
+        pt.seed(0)
+        cfg = gpt_tiny(hidden_dropout=0.0, attention_dropout=0.0)
+        m = GPTForPretraining(cfg)
+        m.eval()
+        drng = np.random.RandomState(13)
+        prompts = [drng.randint(0, cfg.vocab_size, (s,))
+                   for s in (7, 19, 11, 24)]
+        refs = [np.asarray(
+            m.generate(pt.to_tensor(p[None, :], dtype="int64"),
+                       max_new_tokens=6, max_seq_len=128,
+                       cache_dtype="bfloat16").numpy())[0]
+            for p in prompts]
+        eng = DisaggServingEngine(m, roles=("prefill", "decode"), mp=1,
+                                  num_slots=2, page_size=128,
+                                  max_context=128,
+                                  cache_dtype="bfloat16")
+        reqs = [eng.submit(p, 6) for p in prompts]
+        eng.run_until_idle(max_steps=500)
+        for r, ref in zip(reqs, refs):
+            assert r.finished and np.array_equal(r.output_ids(), ref), \
+                f"request {r.id} diverged across the page hand-off"
+        mets = eng.metrics()
+        assert mets["transfers_total"] >= 1, "no hand-off happened"
+        assert mets["transferred_in"] == mets["transferred_out"] == \
+            mets["transfers_total"], mets
+        assert mets["transfer_pages"] >= mets["transfers_total"]
+        for i, rep in enumerate(eng.replicas):
+            a = rep.allocator
+            assert a.used_pages == 0 and a.spec_pages == 0, \
+                f"replica {i} ({eng.roles[i]}) leaked pages"
+        print(f"tpu_smoke: disagg_serving: "
+              f"{mets['transfers_total']} hand-offs, "
+              f"{mets['transfer_pages']} pages / "
+              f"{mets['transfer_bytes']}B device-to-device (bitwise)")
+        eng.close()
+
     # -- train pipeline: ONE on-chip fused train step (fwd+bwd+AdamW with
     # fp32 masters, donated) fed through the device prefetcher — proves
     # the donated program + the async input pipeline + the stall
@@ -840,6 +890,7 @@ def main() -> int:
     check("serving_faults", serving_faults)
     check("sharded_serving", sharded_serving)
     check("elastic_serving", elastic_serving)
+    check("disagg_serving", disagg_serving)
     check("speculative_serving", speculative_serving)
     check("prefix_cache", prefix_cache)
     check("autotune_sweep", autotune_sweep)
